@@ -1,0 +1,158 @@
+"""Device abstraction.
+
+TPU-native equivalent of the reference's Place/Backend layer
+(reference: paddle/phi/common/place.h, paddle/phi/common/backend.h:40,
+python/paddle/device/). Instead of a DeviceContext pool with hand-managed
+streams, JAX/XLA owns per-device execution; this layer provides device
+identity (`Place`), enumeration, selection and placement utilities with the
+reference's Python API surface (`set_device`, `get_device`, `is_compiled_with_*`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Union
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "XPUPlace", "CUDAPlace",
+    "set_device", "get_device", "get_all_device_type", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_tpu",
+    "get_default_device", "jax_device", "synchronize",
+]
+
+
+class Place:
+    """Device identity: (device_type, device_id)."""
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_matches(d.platform, self.device_type)]
+        if not devs:
+            raise RuntimeError(f"No {self.device_type} devices visible to JAX")
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class XPUPlace(Place):
+    device_type = "xpu"
+
+
+class CUDAPlace(Place):
+    # Compat alias: on this framework "gpu" requests resolve to the accelerator
+    # backend if present (reference users porting scripts keep working).
+    device_type = "gpu"
+
+
+_TPU_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform name
+
+
+def _platform_matches(platform: str, device_type: str) -> bool:
+    platform = platform.lower()
+    if device_type in ("tpu", "gpu", "xpu"):
+        # Any accelerator platform satisfies an accelerator request.
+        return platform in _TPU_PLATFORMS or platform in ("gpu", "cuda", "rocm")
+    return platform == device_type
+
+
+_current_device: List[Optional[str]] = [None]
+
+
+@functools.lru_cache(maxsize=None)
+def _accelerator_present() -> bool:
+    return any(d.platform.lower() != "cpu" for d in jax.devices())
+
+
+def get_all_device_type() -> List[str]:
+    return sorted({("tpu" if d.platform.lower() in _TPU_PLATFORMS else d.platform.lower()) for d in jax.devices()})
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return jax.device_count()
+    return len([d for d in jax.devices() if _platform_matches(d.platform, device_type)])
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """paddle.set_device equivalent: 'tpu', 'tpu:0', 'cpu', 'gpu:1'."""
+    if isinstance(device, Place):
+        place = device
+    else:
+        parts = device.split(":")
+        dtype_, idx = parts[0], int(parts[1]) if len(parts) > 1 else 0
+        cls = {"cpu": CPUPlace, "tpu": TPUPlace, "xpu": XPUPlace, "gpu": CUDAPlace}.get(dtype_)
+        if cls is None:
+            raise ValueError(f"Unknown device type: {dtype_}")
+        place = cls(idx)
+    _current_device[0] = f"{place.device_type}:{place.device_id}"
+    return place
+
+
+def get_device() -> str:
+    if _current_device[0] is None:
+        if _accelerator_present():
+            return "tpu:0"
+        return "cpu"
+    return _current_device[0]
+
+
+def get_default_device() -> Place:
+    name = get_device()
+    parts = name.split(":")
+    cls = {"cpu": CPUPlace, "tpu": TPUPlace, "xpu": XPUPlace, "gpu": CUDAPlace}[parts[0]]
+    return cls(int(parts[1]) if len(parts) > 1 else 0)
+
+
+def jax_device(place: Optional[Union[str, Place]] = None):
+    """Resolve a Place (or current device) to a concrete jax.Device."""
+    if place is None:
+        place = get_default_device()
+    elif isinstance(place, str):
+        saved = _current_device[0]
+        try:
+            place = set_device(place)
+        finally:
+            _current_device[0] = saved
+    return place.jax_device()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform.lower() in _TPU_PLATFORMS for d in jax.devices())
+
+
+def synchronize(place=None):
+    """Block until all dispatched work on the device is complete."""
+    (jax.device_put(0.0, jax_device(place)) + 0).block_until_ready()

@@ -1,0 +1,100 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (reference: paddle/phi/common/data_type.h;
+python/paddle/framework/dtype.py) on top of numpy/jax dtypes. On TPU the
+first-class compute dtype is bfloat16 (MXU-native); float32 is the accumulation
+and reference dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import ml_dtypes
+
+__all__ = [
+    "bfloat16", "float16", "float32", "float64", "float8_e4m3fn", "float8_e5m2",
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+    "dtype", "convert_np_dtype_to_dtype_", "is_floating_point", "is_integer",
+    "get_default_dtype", "set_default_dtype", "finfo", "iinfo", "promote_types",
+]
+
+dtype = np.dtype
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+float8_e4m3fn = ml_dtypes.float8_e4m3fn
+float8_e5m2 = ml_dtypes.float8_e5m2
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "bool": "bool_",
+    "int": "int32",
+    "long": "int64",
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_np_dtype_to_dtype_(d):
+    """Normalize any dtype-like (str, np.dtype, jnp scalar type) to np.dtype."""
+    if isinstance(d, str):
+        d = _ALIASES.get(d, d)
+        if d == "bool_":
+            return np.dtype(bool)
+        return np.dtype(getattr(jnp, d, d))
+    return np.dtype(d)
+
+
+def is_floating_point(d) -> bool:
+    d = convert_np_dtype_to_dtype_(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(d) -> bool:
+    d = convert_np_dtype_to_dtype_(d)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(d):
+    d = convert_np_dtype_to_dtype_(d)
+    if not is_floating_point(d):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _DEFAULT_DTYPE[0] = jnp.dtype(d).type
+
+
+def finfo(d):
+    return jnp.finfo(convert_np_dtype_to_dtype_(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_np_dtype_to_dtype_(d))
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_np_dtype_to_dtype_(a), convert_np_dtype_to_dtype_(b))

@@ -1,0 +1,106 @@
+"""Runtime flag system.
+
+TPU-native equivalent of the reference's exported flag registry
+(reference: paddle/common/flags.cc — 179 ``PHI_DEFINE_EXPORTED_*`` flags,
+overridable via ``FLAGS_*`` environment variables and ``paddle.set_flags``).
+
+Design: a plain Python registry (no C++ global state needed — XLA owns the
+device runtime) with env-var override at definition time, type coercion and
+a public ``get_flags``/``set_flags`` API mirroring the reference's
+``paddle.get_flags``/``paddle.set_flags``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+_LOCK = threading.RLock()
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "value", "help", "env_name")
+
+    def __init__(self, name: str, type_: type, default: Any, help_: str):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.env_name = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+        env = os.environ.get(self.env_name)
+        self.value = self._coerce(env) if env is not None else default
+
+    def _coerce(self, raw: Any) -> Any:
+        if raw is None or isinstance(raw, self.type):
+            return raw
+        if self.type is bool:
+            if isinstance(raw, str):
+                return raw.strip().lower() in ("1", "true", "yes", "on")
+            return bool(raw)
+        return self.type(raw)
+
+    def set(self, v: Any) -> None:
+        self.value = self._coerce(v)
+
+
+def _canon(name: str) -> str:
+    return name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+
+
+def define_flag(name: str, default: Any, help_: str = "", type_: Optional[type] = None) -> None:
+    """Register a flag. Env var FLAGS_<name> overrides the default."""
+    with _LOCK:
+        name = _canon(name)
+        if name in _REGISTRY:
+            return
+        _REGISTRY[name] = _Flag(name, type_ or type(default), default, help_)
+
+
+def flag(name: str) -> Any:
+    """Read a flag's current value."""
+    f = _REGISTRY.get(_canon(name))
+    if f is None:
+        raise KeyError(f"Unknown flag: {name}")
+    return f.value
+
+
+def get_flags(names: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    with _LOCK:
+        if names is None:
+            return {k: f.value for k, f in _REGISTRY.items()}
+        if isinstance(names, str):
+            names = [names]
+        return {_canon(n): flag(n) for n in names}
+
+
+def set_flags(flags_map: Dict[str, Any]) -> None:
+    with _LOCK:
+        for k, v in flags_map.items():
+            k = _canon(k)
+            if k not in _REGISTRY:
+                raise KeyError(f"Unknown flag: {k}")
+            _REGISTRY[k].set(v)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (TPU-relevant subset of the reference's flag surface).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Check NaN/Inf after each op (debug mode).")
+define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; higher: log only.")
+define_flag("benchmark", False, "Per-op timing instrumentation.")
+define_flag("seed", 0, "Global random seed (0 = nondeterministic).")
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation inputs on TPU.")
+define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA owns TPU HBM).")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "Compat flag; maps to XLA memory fraction.")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("enable_pallas_kernels", True, "Use Pallas fused kernels where available.")
+define_flag("log_level", "WARNING", "Framework log level.")
+define_flag("comm_timeout_s", 600, "Collective watchdog timeout in seconds.")
+define_flag("embedding_deterministic", False, "Deterministic (slower) embedding grad.")
+define_flag("cudnn_deterministic", False, "Compat: deterministic ops.")
+define_flag("low_precision_op_list", 0, "Collect AMP op statistics.")

@@ -1,0 +1,42 @@
+"""Single-process save/load (reference: python/paddle/framework/io.py
+paddle.save/paddle.load — pickle + protobuf).
+
+Format: a pickle file where jax arrays are stored as numpy (portable,
+device-free); nested dicts/lists/tuples and scalars pass through.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _to_host(obj: Any) -> Any:
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    from ..nn.layer.layers import Parameter
+    if isinstance(obj, Parameter):
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

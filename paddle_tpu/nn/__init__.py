@@ -1,0 +1,25 @@
+"""paddle.nn equivalent namespace."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from .layer.layers import Layer, Parameter, functional_call, functional_train_graph  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from .layer import (activation, common, container, conv, loss, norm, pooling,
+                    transformer)
+
+__all__ = (
+    ["Layer", "Parameter", "functional_call", "functional_train_graph",
+     "ParamAttr", "functional", "initializer"]
+    + list(common.__all__) + list(conv.__all__) + list(norm.__all__)
+    + list(activation.__all__) + list(container.__all__)
+    + list(pooling.__all__) + list(loss.__all__) + list(transformer.__all__)
+)

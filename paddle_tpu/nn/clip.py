@@ -1,0 +1,68 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+Clip objects are callables over a gradient pytree — pure and jit-safe, so
+they compose with optimizer.apply under pjit. The distributed variant
+(global norm across model-parallel shards) lives in
+distributed/fleet/meta_parallel/hybrid_parallel_optimizer.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "global_norm"]
+
+
+def global_norm(grads) -> jax.Array:
+    leaves = [g for g in jax.tree.leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return jax.tree.map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        n = global_norm(grads)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """Eager helper over Parameter.grad slots (torch-compat surface)."""
+    from .layer.layers import Parameter
+    params = [p for p in parameters if isinstance(p, Parameter) and p.grad is not None]
+    if not params:
+        return jnp.zeros(())
+    total = global_norm([p.grad for p in params])
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    for p in params:
+        p.grad = p.grad * scale
+    return total

@@ -1,0 +1,23 @@
+"""paddle.nn.functional equivalent namespace."""
+
+from . import activation as _activation
+from . import common as _common
+from . import conv as _conv
+from . import pooling as _pooling
+from . import norm as _norm
+from . import loss as _loss
+from . import flash_attention as _flash_attention
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .flash_attention import *  # noqa: F401,F403
+
+__all__ = (
+    list(_activation.__all__) + list(_common.__all__) + list(_conv.__all__)
+    + list(_pooling.__all__) + list(_norm.__all__) + list(_loss.__all__)
+    + list(_flash_attention.__all__)
+)

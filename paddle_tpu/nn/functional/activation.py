@@ -1,0 +1,178 @@
+"""Activation functions (reference: python/paddle/nn/functional/activation.py).
+All are jnp/lax compositions — XLA fuses them into surrounding matmuls, which
+replaces the reference's hand-fused CUDA epilogues (fused_bias_act etc.)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "relu", "relu6", "relu_", "leaky_relu", "prelu", "elu", "selu", "celu", "gelu",
+    "sigmoid", "hardsigmoid", "log_sigmoid", "tanh", "hardtanh", "tanhshrink",
+    "softshrink", "hardshrink", "softplus", "softsign", "swish", "silu",
+    "hardswish", "mish", "glu", "swiglu", "softmax", "log_softmax", "gumbel_softmax",
+    "maxout", "thresholded_relu", "rrelu",
+]
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+relu_ = relu
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW"):
+    w = jnp.asarray(weight)
+    if w.size > 1:
+        if data_format == "NCHW":
+            shape = [1, -1] + [1] * (x.ndim - 2)
+        else:
+            shape = [1] * (x.ndim - 1) + [-1]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    # clamp the untaken branch so exp never overflows (NaN-safe gradients
+    # through jnp.where)
+    safe = jnp.minimum(scaled, threshold)
+    return jnp.where(scaled > threshold, x, jnp.log1p(jnp.exp(safe)) / beta)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+silu = swish
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def mish(x):
+    return x * jnp.tanh(softplus(x))
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """SwiGLU (reference: python/paddle/incubate/nn/functional/swiglu.py):
+    silu(x) * y; single-input form splits x in half on the last axis."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ...random import next_key
+    g = jax.random.gumbel(next_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    assert c % groups == 0
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    from ...random import next_key
+    if training:
+        a = jax.random.uniform(next_key(), x.shape, dtype=x.dtype,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)

@@ -1,0 +1,168 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py →
+paddle/phi/kernels/gpudnn/conv_kernel.cu via cuDNN).
+
+TPU design: all convs lower to lax.conv_general_dilated, which XLA maps onto
+the MXU as implicit GEMM. Both NCHW (paddle default, kept for API parity) and
+NHWC (TPU-preferred layout — channels on the 128-lane minor dim) are
+supported via dimension_numbers; no layout transposes are inserted here, XLA
+picks the layout under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v) if len(v) == n else tuple(v) * n
+    return (v,) * n
+
+
+def _padding(padding, n):
+    """paddle padding: int, list of ints, list of pairs, or SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    if len(padding) == n:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad padding: {padding}")
+
+
+def _dim_numbers(ndim_spatial, data_format):
+    if ndim_spatial == 1:
+        io = ("NCL", "NLC")
+    elif ndim_spatial == 2:
+        io = ("NCHW", "NHWC")
+    else:
+        io = ("NCDHW", "NDHWC")
+    lhs = data_format if data_format in io else io[0]
+    # kernel layout is always [out_c, in_c/groups, *spatial] (paddle OIHW)
+    rhs = "OI" + "HWD"[:ndim_spatial] if ndim_spatial != 3 else "OIDHW"
+    if ndim_spatial == 1:
+        rhs = "OIL"
+    elif ndim_spatial == 2:
+        rhs = "OIHW"
+    return lax.conv_dimension_numbers((1,) * (ndim_spatial + 2),
+                                      (1,) * (ndim_spatial + 2),
+                                      (lhs, rhs, lhs))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    w = jnp.asarray(weight)
+    stride = _ntuple(stride, n)
+    dilation = _ntuple(dilation, n)
+    pad = _padding(padding, n)
+    dn = _dim_numbers(n, data_format)
+    out = lax.conv_general_dilated(
+        jnp.asarray(x), w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        b = jnp.asarray(bias)
+        if data_format.endswith("C"):
+            out = out + b.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + b.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    del name
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    del name
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    del name
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n, output_size=None):
+    w = jnp.asarray(weight)  # paddle layout: [in_c, out_c/groups, *spatial]
+    stride = _ntuple(stride, n)
+    dilation = _ntuple(dilation, n)
+    opad = _ntuple(output_padding, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * n if pad == "VALID" else None
+        if pad is None:
+            raise ValueError("SAME padding unsupported for conv_transpose")
+    dn = _dim_numbers(n, data_format)
+    # gradient-of-conv formulation: lhs_dilation = stride
+    trans_pad = []
+    for i in range(n):
+        k_eff = dilation[i] * (w.shape[2 + i] - 1) + 1
+        lo = k_eff - 1 - pad[i][0]
+        hi = k_eff - 1 - pad[i][1] + opad[i]
+        trans_pad.append((lo, hi))
+    # kernel: [in, out/groups, *s] -> flip spatial, swap to [out/groups*g? ...]
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ic, ocg = w_flip.shape[0], w_flip.shape[1]
+        w_flip = w_flip.reshape(groups, ic // groups, ocg, *w_flip.shape[2:])
+        w_flip = jnp.swapaxes(w_flip, 1, 2)
+        w_flip = w_flip.reshape(groups * ocg, ic // groups, *w.shape[2:])
+    else:
+        w_flip = jnp.swapaxes(w_flip, 0, 1)
+    out = lax.conv_general_dilated(
+        jnp.asarray(x), w_flip, window_strides=(1,) * n, padding=trans_pad,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if output_size is not None:
+        sizes = _ntuple(output_size, n)
+        sl = [slice(None)] * out.ndim
+        spatial_axes = range(2, 2 + n) if not data_format.endswith("C") else range(1, 1 + n)
+        for ax, s in zip(spatial_axes, sizes):
+            sl[ax] = slice(0, s)
+        out = out[tuple(sl)]
+    if bias is not None:
+        b = jnp.asarray(bias)
+        if data_format.endswith("C"):
+            out = out + b.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + b.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    del name
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    del name
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    del name
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

@@ -1,0 +1,155 @@
+"""Attention ops.
+
+Reference surface: python/paddle/nn/functional/flash_attention.py
+(flash_attention :242, scaled_dot_product_attention :976, flashmask_attention
+:1098) backed by paddle/phi/kernels/gpu/flash_attn_kernel.cu (CUDA
+FlashAttention-2).
+
+TPU design: the reference implementation below is a pure XLA composition
+(softmax(QK^T)V) — already MXU-bound and fused by XLA for moderate sequence
+lengths. The memory-optimal tiled kernel lives in
+paddle_tpu.kernels.pallas.flash_attention and is dispatched through the op
+registry when running on TPU (O(S) VMEM instead of O(S^2) HBM for the scores
+matrix). All layouts are [batch, seq, heads, head_dim] (paddle convention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import get_op, register_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel", "flashmask_attention"]
+
+
+def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
+                    is_causal=False, scale=None, training=True):
+    """XLA-composed attention. q,k,v: [B, S, H, D]."""
+    q = jnp.swapaxes(query, 1, 2)  # [B, H, S, D]
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    # logits in fp32 for numerical stability (bf16 inputs stay on the MXU)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if is_causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((q_len, k_len), bool), k_len - q_len)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from ...random import next_key
+        keep = 1.0 - dropout_p
+        m = jax.random.bernoulli(next_key(), keep, probs.shape)
+        probs = jnp.where(m, probs / keep, 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)  # [B, S, H, D]
+
+
+@register_op("scaled_dot_product_attention", tags=["attention", "fusion"],
+             dispatch=True)
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    del name
+    return _sdpa_reference(query, key, value, attn_mask, dropout_p, is_causal,
+                           training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity:
+    returns (out, softmax) tuple."""
+    del fixed_seed_offset, rng_name, name
+    out = get_op("scaled_dot_product_attention").dispatch(
+        query, key, value, None, dropout, causal, training)
+    return out, None if not return_softmax else None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True):
+    """Varlen attention over packed sequences. q: [total_q, H, D].
+
+    Implemented by segment-masking within one attention call: position i may
+    attend to j iff they fall in the same cu_seqlens segment (and j<=i for
+    causal). This keeps static shapes for XLA."""
+    tq = query.shape[0]
+    tk = key.shape[0]
+    seg_q = jnp.searchsorted(cu_seqlens_q, jnp.arange(tq), side="right")
+    seg_k = jnp.searchsorted(cu_seqlens_k, jnp.arange(tk), side="right")
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos_q = jnp.arange(tq) - jnp.take(cu_seqlens_q, seg_q - 1)
+        pos_k = jnp.arange(tk) - jnp.take(cu_seqlens_k, seg_k - 1)
+        mask = mask & (pos_k[None, :] <= pos_q[:, None])
+    out = _sdpa_reference(query[None], key[None], value[None],
+                          attn_mask=mask[None, None], dropout_p=dropout,
+                          is_causal=False, scale=scale, training=training)[0]
+    return (out, None) if return_softmax else (out, None)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=True, window_size=None):
+    """Sparse-mask attention (reference: flash_attention.py:1098).
+
+    startend_row_indices: [B, H_mask, S, 1] (causal LT mask) or richer forms;
+    row r of the mask column j means keys j are masked for queries >= r.
+    Composed as an additive mask over the reference kernel."""
+    B, S = query.shape[0], query.shape[1]
+    Sk = key.shape[1]
+    mask = None
+    if startend_row_indices is not None:
+        idx = startend_row_indices
+        rows = jnp.arange(S)[None, None, :, None]  # query positions
+        if idx.shape[-1] == 1:
+            # causal LT: key j masked for queries >= idx[..., j, 0]
+            start = jnp.swapaxes(idx, -2, -1)  # [B, H, 1, Sk]
+            mask = rows < start  # allowed where query_row < start
+        elif idx.shape[-1] == 2:
+            start = idx[..., 0][:, :, None, :]
+            end = idx[..., 1][:, :, None, :]
+            mask = (rows < start) | (rows >= end)
+        else:
+            raise NotImplementedError("4-column flashmask not yet supported")
+    if causal:
+        cm = jnp.tril(jnp.ones((S, Sk), bool), Sk - S)[None, None]
+        mask = cm if mask is None else (mask & cm)
+    if window_size is not None:
+        w = window_size if isinstance(window_size, int) else window_size[0]
+        rows = jnp.arange(S)[:, None]
+        cols = jnp.arange(Sk)[None, :]
+        wm = (cols >= rows - w)[None, None]
+        mask = wm if mask is None else (mask & wm)
+    out = get_op("scaled_dot_product_attention").dispatch(
+        query, key, value, mask, dropout, False, True)
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (API parity shim)."""
+
+    def __init__(self, enable_math=True, enable_flash=True, enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        from ...flags import flag, set_flags
+        self._saved = flag("enable_pallas_kernels")
+        set_flags({"enable_pallas_kernels": self.enable_flash})
+        return self
+
+    def __exit__(self, *a):
+        from ...flags import set_flags
+        set_flags({"enable_pallas_kernels": self._saved})
+        return False

@@ -1,0 +1,289 @@
+"""Loss functions (reference: python/paddle/nn/functional/loss.py;
+cross_entropy → paddle/phi/kernels/gpu/cross_entropy_kernel.cu)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import register_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
+    "sigmoid_focal_loss", "log_loss", "huber_loss", "poisson_nll_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("cross_entropy", tags=["loss"])
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross-entropy, computed in fp32 with the log-sum-exp trick
+    (numerics match the reference's hard/soft label + ignore_index + weight
+    surface)."""
+    del name
+    logits = input.astype(jnp.float32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+
+    n_classes = input.shape[axis]
+    if soft_label or (not jnp.issubdtype(jnp.asarray(label).dtype, jnp.integer)
+                      and jnp.asarray(label).ndim == input.ndim):
+        soft = jnp.asarray(label, dtype=jnp.float32)
+        if label_smoothing > 0.0:
+            soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(soft * jnp.asarray(weight), axis=axis)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(loss, reduction)
+
+    label = jnp.asarray(label)
+    if label.ndim == input.ndim and label.shape[axis] == 1:
+        label = jnp.squeeze(label, axis=axis)
+    valid = label != ignore_index
+    safe_label = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_label, axis), axis=axis)
+    loss = -jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth_loss = -jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+    w_per = jnp.ones_like(loss)
+    if weight is not None:
+        w_per = jnp.take(jnp.asarray(weight, jnp.float32), safe_label)
+    w_per = jnp.where(valid, w_per, 0.0)
+    loss = loss * w_per
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w_per), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    del numeric_stable_mode
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits.astype(jnp.float32), axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1.0 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log(1 - x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    z = logit.astype(jnp.float32)
+    lbl = jnp.asarray(label, jnp.float32)
+    if pos_weight is not None:
+        pw = jnp.asarray(pos_weight, jnp.float32)
+        log_w = (pw - 1.0) * lbl + 1.0
+        loss = (1 - lbl) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z))
+                                        + jnp.maximum(-z, 0.0))
+    else:
+        loss = jnp.maximum(z, 0.0) - z * lbl + jnp.logaddexp(0.0, -jnp.abs(z))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    picked = -jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+    w = jnp.ones_like(picked)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), safe)
+    w = jnp.where(valid, w, 0.0)
+    picked = picked * w
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(picked, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss * delta, reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.clip(label, 1e-12, None)
+        loss = label * (jnp.log(safe) - input)
+        loss = jnp.where(label > 0, loss, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1), 1.0 / p)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -(label * jnp.log(input + epsilon)
+             + (1 - label) * jnp.log(1 - input + epsilon))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + 0.5 * jnp.log(
+            2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward (log-domain dynamic program via lax.scan).
+    log_probs: [T, B, C] (paddle layout); labels: [B, S]."""
+    del norm_by_times
+    logp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    T, B, C = logp.shape
+    S = labels.shape[1]
+    # extended label seq: blank, l1, blank, l2, ... blank  (len 2S+1)
+    ext = jnp.full((B, 2 * S + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(2 * S + 1)[None, :] < (2 * label_lengths[:, None] + 1)
+    NEG = -1e30
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def get_lp(t_lp, idx):
+        return jnp.take_along_axis(t_lp, idx, axis=1)
+
+    alpha0 = jnp.full((B, 2 * S + 1), NEG)
+    alpha0 = alpha0.at[:, 0].set(get_lp(logp[0], ext[:, :1])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(S > 0, get_lp(logp[0], ext[:, 1:2])[:, 0], NEG))
+
+    def step(alpha, t_lp):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, NEG, a_shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        new = merged + get_lp(t_lp, ext)
+        new = jnp.where(ext_valid, new, NEG)
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, 2S+1]
+    # pick alpha at t = input_length-1, positions 2*label_len and 2*label_len-1
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    final = jnp.take_along_axis(alphas, t_idx[None, :, None], axis=0)[0]  # [B, 2S+1]
+    p1 = jnp.take_along_axis(final, (2 * label_lengths)[:, None], axis=1)[:, 0]
+    p2 = jnp.take_along_axis(final, jnp.maximum(2 * label_lengths - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(p1, jnp.where(label_lengths > 0, p2, NEG))
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)

@@ -1,0 +1,168 @@
+"""Pooling ops (reference: python/paddle/nn/functional/pooling.py →
+paddle/phi/kernels/gpudnn/pool_kernel.cu). TPU: lax.reduce_window."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v) if len(v) == n else tuple(v) * n
+    return (v,) * n
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode,
+          count_include_pad=True, is_avg=False):
+    kernel = _ntuple(kernel, n)
+    stride = _ntuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad = _ntuple(padding, n) if not isinstance(padding, int) else (padding,) * n
+        pads = [(p, p) for p in pad]
+        pad_mode = None
+    channels_last = data_format.endswith("C")
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        full_pads = ([(0, 0)] + pads + [(0, 0)]) if pads is not None else pad_mode
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        full_pads = ([(0, 0), (0, 0)] + pads) if pads is not None else pad_mode
+    if ceil_mode and pads is not None:
+        spatial_axes = range(1, 1 + n) if channels_last else range(2, 2 + n)
+        fp = list(full_pads)
+        for i, ax in enumerate(spatial_axes):
+            size = x.shape[ax] + 2 * (pads[i][0])
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                lo, hi = fp[ax]
+                fp[ax] = (lo, hi + stride[i] - rem)
+        full_pads = fp
+    out = lax.reduce_window(x, init, reducer, window, strides, full_pads)
+    if is_avg:
+        if count_include_pad and not isinstance(full_pads, str):
+            denom = float(np.prod(kernel))
+            out = out / denom
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, full_pads)
+            out = out / counts
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    del name
+    return _pool(x, kernel_size, stride, padding, 1, data_format, lax.add, 0.0,
+                 ceil_mode, count_include_pad=not exclusive, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    del name
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, lax.add, 0.0,
+                ceil_mode, count_include_pad=not exclusive, is_avg=divisor_override is None)
+    if divisor_override is not None:
+        out = out / divisor_override
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    del name
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, lax.add, 0.0,
+                ceil_mode, count_include_pad=not exclusive, is_avg=divisor_override is None)
+    if divisor_override is not None:
+        out = out / divisor_override
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    del name
+    out = _pool(x, kernel_size, stride, padding, 1, data_format, lax.max,
+                -jnp.inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    del name
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, lax.max,
+                -jnp.inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    del name
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, lax.max,
+                -jnp.inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def _adaptive(x, output_size, n, data_format, is_max):
+    output_size = _ntuple(output_size, n)
+    channels_last = data_format.endswith("C")
+    spatial_axes = list(range(1, 1 + n)) if channels_last else list(range(2, 2 + n))
+    out = x
+    for ax, os in zip(spatial_axes, output_size):
+        if os is None:
+            continue
+        s_in = out.shape[ax]
+        # split into os windows with boundaries floor(i*s/os) .. ceil((i+1)*s/os)
+        starts = [int(np.floor(i * s_in / os)) for i in range(os)]
+        ends = [int(np.ceil((i + 1) * s_in / os)) for i in range(os)]
+        slices = []
+        for s, e in zip(starts, ends):
+            seg = lax.slice_in_dim(out, s, e, axis=ax)
+            red = jnp.max(seg, axis=ax, keepdims=True) if is_max else jnp.mean(seg, axis=ax, keepdims=True)
+            slices.append(red)
+        out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    del name
+    return _adaptive(x, output_size, 1, "NCL", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    del name
+    return _adaptive(x, output_size, 2, data_format, False)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    del name
+    return _adaptive(x, output_size, 3, data_format, False)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    del name
+    out = _adaptive(x, output_size, 1, "NCL", True)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    del name
+    out = _adaptive(x, output_size, 2, "NCHW", True)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    del name
+    out = _adaptive(x, output_size, 3, "NCDHW", True)
+    return (out, None) if return_mask else out

@@ -1,0 +1,1 @@
+from .layers import Layer, Parameter, functional_call, functional_train_graph  # noqa: F401

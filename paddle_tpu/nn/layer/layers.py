@@ -1,0 +1,502 @@
+"""Layer base class + Parameter.
+
+TPU-native redesign of the reference's module system (reference:
+python/paddle/nn/layer/layers.py — Layer with _parameters/_buffers/_sub_layers,
+hooks, state_dict; parameters are mutable device tensors updated in place).
+
+Design: a Layer is an eager, mutable object tree for ergonomics (attribute
+access, state_dict, hooks — same surface as the reference), but the compute
+path is purely functional: ``functional_call(layer, params, buffers, *args)``
+temporarily swaps traced values into the Parameter slots, runs ``forward``,
+captures buffer mutations as explicit outputs, and restores. jax.grad /
+jax.jit / shard_map therefore see a pure function over pytrees, which is what
+XLA needs to fuse, shard and schedule for the MXU. There is no hand-built
+autograd tape (reference: paddle/fluid/eager/backward.cc) — jax.grad replaces
+the eager GradNode graph wholesale.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import dtypes as _dtypes
+
+__all__ = ["Parameter", "Layer", "functional_call", "functional_train_graph"]
+
+
+def _asarray(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+class Parameter:
+    """Trainable (or frozen) tensor slot owned by a Layer.
+
+    Wraps a jax.Array so the framework can identify trainables, attach
+    metadata (name, stop_gradient, sharding placement hints) and swap values
+    functionally during tracing. Interops with jnp via ``__jax_array__``.
+    """
+
+    __array_priority__ = 100  # beat numpy in mixed ops
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+        self.name = name
+        self.stop_gradient = not trainable
+        # Optional distributed placement hint (set by shard_tensor / TP layers).
+        self.placements = None
+        self.process_mesh = None
+        # Grad slot for eager-style APIs that expose .grad after a step.
+        self.grad = None
+
+    # -- array protocol ----------------------------------------------------
+    def __jax_array__(self):
+        return self.value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.value)
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return self.value.size
+
+    @property
+    def T(self):
+        return self.value.T
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def astype(self, dt):
+        return self.value.astype(_dtypes.convert_np_dtype_to_dtype_(dt))
+
+    def reshape(self, *s):
+        return self.value.reshape(*s)
+
+    def set_value(self, v):
+        self.value = jnp.asarray(v, dtype=self.value.dtype)
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name}, shape={tuple(self.shape)}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")
+
+    # -- operators ---------------------------------------------------------
+    def __add__(self, o):
+        return self.value + _asarray(o)
+
+    def __radd__(self, o):
+        return _asarray(o) + self.value
+
+    def __sub__(self, o):
+        return self.value - _asarray(o)
+
+    def __rsub__(self, o):
+        return _asarray(o) - self.value
+
+    def __mul__(self, o):
+        return self.value * _asarray(o)
+
+    def __rmul__(self, o):
+        return _asarray(o) * self.value
+
+    def __truediv__(self, o):
+        return self.value / _asarray(o)
+
+    def __rtruediv__(self, o):
+        return _asarray(o) / self.value
+
+    def __matmul__(self, o):
+        return self.value @ _asarray(o)
+
+    def __rmatmul__(self, o):
+        return _asarray(o) @ self.value
+
+    def __pow__(self, o):
+        return self.value ** _asarray(o)
+
+    def __neg__(self):
+        return -self.value
+
+    def __getitem__(self, idx):
+        return self.value[idx]
+
+    def __len__(self):
+        return len(self.value)
+
+
+class HookRemoveHelper:
+    next_id = 0
+
+    def __init__(self, hooks: Dict[int, Callable]):
+        self._hooks = hooks
+        self._id = HookRemoveHelper.next_id
+        HookRemoveHelper.next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    """Base class for all network layers (reference surface:
+    python/paddle/nn/layer/layers.py Layer)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        self.training = True
+        self._dtype = _dtypes.convert_np_dtype_to_dtype_(dtype)
+        self._forward_pre_hooks: Dict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name: str, value: Any):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            if value.name is None:
+                value.name = f"{self._name_scope}.{name}"
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)  # don't let a plain attr shadow
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+            layers[name] = value
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                else:
+                    params[name].set_value(value)
+                    return
+            if buffers is not None and name in buffers:
+                buffers[name] = None if value is None else jnp.asarray(value)
+                return
+            if layers is not None and name in layers and not isinstance(value, Layer):
+                del layers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias: bool = False, attr=None) -> Parameter:
+        from ..initializer import Constant, XavierNormal
+        dtype = _dtypes.convert_np_dtype_to_dtype_(dtype or self._dtype)
+        init = default_initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        value = init(tuple(shape), dtype)
+        trainable = True
+        if attr is not None and getattr(attr, "trainable", None) is not None:
+            trainable = attr.trainable
+        return Parameter(value, trainable=trainable)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        self._buffers[name] = None if tensor is None else jnp.asarray(tensor)
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+
+    # -- traversal ---------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        return iter(self._sub_layers.values())
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        return iter(self._sub_layers.items())
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = []
+        for _, l in self.named_sublayers(include_self=include_self):
+            out.append(l)
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        layers = (self.named_sublayers(prefix=prefix, include_self=True)
+                  if include_sublayers else [(prefix, self)])
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, jax.Array]]:
+        layers = (self.named_sublayers(prefix=prefix, include_self=True)
+                  if include_sublayers else [(prefix, self)])
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield (f"{lp}.{name}" if lp else name), b
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- mode / dtype ------------------------------------------------------
+    def train(self) -> "Layer":
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        del blocking
+        if dtype is not None:
+            dt = _dtypes.convert_np_dtype_to_dtype_(dtype)
+            for _, p in self.named_parameters():
+                if _dtypes.is_floating_point(p.value.dtype):
+                    p.value = p.value.astype(dt)
+            for _, layer in self.named_sublayers(include_self=True):
+                for bname, b in layer._buffers.items():
+                    if b is not None and _dtypes.is_floating_point(b.dtype):
+                        layer._buffers[bname] = b.astype(dt)
+                layer._dtype = dt
+        if device is not None:
+            from ...device import jax_device
+            dev = jax_device(device)
+            for _, p in self.named_parameters():
+                p.value = jax.device_put(p.value, dev)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, args)
+            if out is not None:
+                args = out if isinstance(out, tuple) else (out,)
+        result = self.forward(*args, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            out = hook(self, args, result)
+            if out is not None:
+                result = out
+        return result
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True, keep_vars: bool = False,
+                   structured_name_prefix: str = "") -> "OrderedDict[str, Any]":
+        out = OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            out[name] = p if keep_vars else p.value
+        layers = (self.named_sublayers(prefix=structured_name_prefix, include_self=True)
+                  if include_sublayers else [(structured_name_prefix, self)])
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or name in layer._non_persistable_buffer_names:
+                    continue
+                out[f"{lp}.{name}" if lp else name] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        del use_structured_name
+        missing, unexpected = [], []
+        own_params = dict(self.named_parameters())
+        own_buffers = {}
+        for lp, layer in self.named_sublayers(include_self=True):
+            for name in layer._buffers:
+                own_buffers[f"{lp}.{name}" if lp else name] = (layer, name)
+        for k, v in state_dict.items():
+            if k in own_params:
+                own_params[k].set_value(v)
+            elif k in own_buffers:
+                layer, name = own_buffers[k]
+                layer._buffers[name] = jnp.asarray(v)
+            else:
+                unexpected.append(k)
+        for k in own_params:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- misc --------------------------------------------------------------
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.grad = None
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, l in self._sub_layers.items():
+            sub = repr(l).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else self.__class__.__name__ + "()"
+
+
+# ---------------------------------------------------------------------------
+# Functional bridge: mutable Layer tree <-> pure function over pytrees.
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def _swapped_state(layer: Layer, params: Optional[Dict[str, Any]],
+                   buffers: Optional[Dict[str, Any]]):
+    named_params = dict(layer.named_parameters())
+    buffer_slots = {}
+    for lp, sub in layer.named_sublayers(include_self=True):
+        for name in sub._buffers:
+            buffer_slots[f"{lp}.{name}" if lp else name] = (sub, name)
+
+    saved_p = {k: p.value for k, p in named_params.items()}
+    saved_b = {k: slot[0]._buffers[slot[1]] for k, slot in buffer_slots.items()}
+    try:
+        if params is not None:
+            for k, v in params.items():
+                if k in named_params:
+                    named_params[k].value = v
+        if buffers is not None:
+            for k, v in buffers.items():
+                if k in buffer_slots:
+                    sub, name = buffer_slots[k]
+                    sub._buffers[name] = v
+        yield named_params, buffer_slots
+    finally:
+        for k, p in named_params.items():
+            p.value = saved_p[k]
+        for k, (sub, name) in buffer_slots.items():
+            sub._buffers[name] = saved_b[k]
+
+
+def functional_call(layer: Layer, params: Dict[str, Any], buffers: Dict[str, Any],
+                    *args, **kwargs):
+    """Run ``layer(*args)`` as a pure function of (params, buffers).
+
+    Returns ``(output, new_buffers)`` where new_buffers captures any buffer
+    mutation the forward performed (e.g. BatchNorm running stats), so the
+    caller can thread state through jit/grad explicitly.
+    """
+    with _swapped_state(layer, params, buffers) as (_, buffer_slots):
+        out = layer(*args, **kwargs)
+        new_buffers = {k: sub._buffers[name] for k, (sub, name) in buffer_slots.items()
+                       if sub._buffers[name] is not None}
+    return out, new_buffers
+
+
+def functional_train_graph(layer: Layer):
+    """Split a layer's state into (trainable_params, frozen_params, buffers)
+    pytrees for use with jax.grad/jit."""
+    trainable, frozen = {}, {}
+    for k, p in layer.named_parameters():
+        (trainable if p.trainable else frozen)[k] = p.value
+    buffers = {}
+    for lp, sub in layer.named_sublayers(include_self=True):
+        for name, b in sub._buffers.items():
+            if b is not None:
+                buffers[f"{lp}.{name}" if lp else name] = b
+    return trainable, frozen, buffers

@@ -1,0 +1,263 @@
+"""Norm layers (reference: python/paddle/nn/layer/norm.py).
+
+BatchNorm note: running stats are Layer *buffers*; in training mode the
+functional batch_norm returns updated stats and the layer writes them back to
+its buffer slots. Under `functional_call` tracing those writes are captured
+as explicit state outputs (see layers.py), keeping the jitted step pure.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "RMSNorm", "GroupNorm",
+           "InstanceNorm1D", "InstanceNorm2D", "InstanceNorm3D",
+           "LocalResponseNorm", "SpectralNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_features,), attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        training = self.training and not (self.use_global_stats is True)
+        out = F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                           training=training, momentum=self.momentum,
+                           epsilon=self.epsilon, data_format=self.data_format,
+                           use_global_stats=self.use_global_stats)
+        if isinstance(out, tuple):
+            out, new_mean, new_var = out
+            self._buffers["_mean"] = new_mean
+            self._buffers["_variance"] = new_var
+        return out
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm (reference: nn/layer/norm.py SyncBatchNorm →
+    sync_batch_norm CUDA kernel w/ NCCL allreduce of stats).
+
+    TPU design: when called inside shard_map/pjit over a mesh with a data
+    axis, stats are all-reduced over that axis with lax.pmean; outside a mesh
+    it degrades to plain BatchNorm.
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format)
+        self._axis_name = None
+
+    def set_mesh_axis(self, axis_name):
+        self._axis_name = axis_name
+
+    def forward(self, x):
+        from jax import lax
+        if not self.training or self._axis_name is None:
+            return super().forward(x)
+        channels_last = self.data_format.endswith("C") and self.data_format != "NC"
+        c_axis = x.ndim - 1 if channels_last else 1
+        red = tuple(i for i in range(x.ndim) if i != c_axis)
+        xf = x.astype(jnp.float32)
+        mean = lax.pmean(jnp.mean(xf, axis=red), self._axis_name)
+        mean2 = lax.pmean(jnp.mean(jnp.square(xf), axis=red), self._axis_name)
+        var = mean2 - jnp.square(mean)
+        self._buffers["_mean"] = self.momentum * self._buffers["_mean"] + (1 - self.momentum) * mean
+        self._buffers["_variance"] = self.momentum * self._buffers["_variance"] + (1 - self.momentum) * var
+        shape = [1] * x.ndim
+        shape[c_axis] = x.shape[c_axis]
+        out = (xf - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + self.epsilon)
+        out = out.astype(x.dtype)
+        if self.weight is not None:
+            out = out * self.weight.value.reshape(shape)
+        if self.bias is not None:
+            out = out + self.bias.value.reshape(shape)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            new = cls(layer.num_features, layer.momentum, layer.epsilon,
+                      data_format=layer.data_format)
+            if layer.weight is not None:
+                new.weight.set_value(layer.weight.value)
+            if layer.bias is not None:
+                new.bias.set_value(layer.bias.value)
+            new._buffers["_mean"] = layer._buffers["_mean"]
+            new._buffers["_variance"] = layer._buffers["_variance"]
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr, default_initializer=Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                self.normalized_shape, attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    """RMSNorm layer (reference fused op:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr, default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, epsilon=self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = (self.create_parameter((num_channels,), attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter((num_channels,), attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight,
+                            self.bias, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = (self.create_parameter((num_features,), attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon, data_format=self.data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (power iteration)."""
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12, name=None):
+        super().__init__()
+        self.axis, self.power_iters, self.epsilon = axis, power_iters, epsilon
+        h = weight_shape[axis]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != axis:
+                w *= s
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter((h,), default_initializer=Normal(0, 1))
+        self.weight_v = self.create_parameter((w,), default_initializer=Normal(0, 1))
+        self.weight_u.trainable = False
+        self.weight_v.trainable = False
+
+    def forward(self, weight):
+        w = jnp.moveaxis(jnp.asarray(weight), self.axis, 0)
+        mat = w.reshape(w.shape[0], -1)
+        u, v = self.weight_u.value, self.weight_v.value
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.epsilon)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.epsilon)
+        sigma = u @ mat @ v
+        return jnp.asarray(weight) / sigma

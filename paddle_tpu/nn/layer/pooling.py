@@ -1,0 +1,93 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D"]
+
+
+class _Pool(Layer):
+    _fn = None
+    _default_fmt = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format=None, name=None, **kw):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format or self._default_fmt
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride, self.padding,
+                              ceil_mode=self.ceil_mode, data_format=self.data_format)
+
+
+class AvgPool1D(_Pool):
+    _fn = staticmethod(F.avg_pool1d)
+    _default_fmt = "NCL"
+
+
+class AvgPool2D(_Pool):
+    _fn = staticmethod(F.avg_pool2d)
+
+
+class AvgPool3D(_Pool):
+    _fn = staticmethod(F.avg_pool3d)
+    _default_fmt = "NCDHW"
+
+
+class MaxPool1D(_Pool):
+    _fn = staticmethod(F.max_pool1d)
+    _default_fmt = "NCL"
+
+
+class MaxPool2D(_Pool):
+    _fn = staticmethod(F.max_pool2d)
+
+
+class MaxPool3D(_Pool):
+    _fn = staticmethod(F.max_pool3d)
+    _default_fmt = "NCDHW"
+
+
+class _AdaptivePool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, data_format=None, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return type(self)._fn(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool1d)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool2d)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool3d)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool1d)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool2d)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool3d)

@@ -1,0 +1,12 @@
+from .registry import (
+    OpSchema,
+    get_op,
+    infer_meta,
+    list_ops,
+    register_op,
+    register_pallas_impl,
+)
+
+__all__ = [
+    "OpSchema", "get_op", "infer_meta", "list_ops", "register_op", "register_pallas_impl",
+]

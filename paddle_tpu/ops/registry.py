@@ -1,0 +1,122 @@
+"""Op schema registry.
+
+TPU-native equivalent of the reference's declarative op layer
+(reference: paddle/phi/ops/yaml/ops.yaml — 466 op schemas feeding codegen;
+paddle/phi/core/kernel_factory.h:316 KernelFactory;
+paddle/phi/core/kernel_registry.h registration macros).
+
+On TPU there is exactly one device backend (XLA) plus an optional Pallas
+fast path per op, so the (backend, layout, dtype) dispatch key collapses to
+``(op, impl_tier)``. The registry keeps:
+  * the op schema (name, signature, inferred from the Python definition),
+  * the reference implementation (jax.numpy / lax composition — always valid),
+  * optional Pallas kernel overrides, gated by flags and platform.
+
+This replaces yaml + four code generators with runtime introspection: the
+schema *is* the Python signature, shape/dtype inference *is* jax tracing
+(jax.eval_shape gives InferMeta for free).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..flags import flag
+
+__all__ = ["OpSchema", "register_op", "register_pallas_impl", "get_op", "list_ops", "infer_meta"]
+
+
+@dataclass
+class OpSchema:
+    name: str
+    fn: Callable  # reference (XLA-composed) implementation
+    signature: str
+    doc: str = ""
+    pallas_impl: Optional[Callable] = None
+    pallas_supported: Optional[Callable[..., bool]] = None
+    tags: List[str] = field(default_factory=list)
+
+    def dispatch(self, *args, **kwargs):
+        if (
+            self.pallas_impl is not None
+            and flag("enable_pallas_kernels")
+            and _on_tpu()
+            and (self.pallas_supported is None or self.pallas_supported(*args, **kwargs))
+        ):
+            return self.pallas_impl(*args, **kwargs)
+        return self.fn(*args, **kwargs)
+
+
+_OPS: Dict[str, OpSchema] = {}
+
+
+@functools.lru_cache(maxsize=None)
+def _on_tpu() -> bool:
+    plat = jax.default_backend().lower()
+    return plat in ("tpu", "axon")
+
+
+def register_op(name: str, tags: Optional[List[str]] = None, dispatch: bool = False):
+    """Register `fn` as the reference implementation of op `name`.
+
+    With ``dispatch=True`` the returned callable routes through the registry
+    (so a later-registered Pallas impl takes over on TPU); otherwise the
+    original function is returned and the registry is metadata-only.
+    """
+
+    def deco(fn: Callable):
+        try:
+            sig = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        schema = OpSchema(
+            name=name, fn=fn, signature=sig, doc=(fn.__doc__ or "").strip(),
+            tags=list(tags or []),
+        )
+        _OPS[name] = schema
+        if not dispatch:
+            return fn
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return schema.dispatch(*args, **kwargs)
+
+        wrapper.__op_schema__ = schema
+        return wrapper
+
+    return deco
+
+
+def register_pallas_impl(name: str, supported: Optional[Callable[..., bool]] = None):
+    """Attach a Pallas fast-path implementation to a registered op."""
+
+    def deco(fn: Callable):
+        schema = _OPS.get(name)
+        if schema is None:
+            raise KeyError(f"op '{name}' not registered; register the reference impl first")
+        schema.pallas_impl = fn
+        schema.pallas_supported = supported
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpSchema:
+    return _OPS[name]
+
+
+def list_ops(tag: Optional[str] = None) -> List[str]:
+    if tag is None:
+        return sorted(_OPS)
+    return sorted(n for n, s in _OPS.items() if tag in s.tags)
+
+
+def infer_meta(name: str, *args, **kwargs):
+    """Shape/dtype inference without running the op (InferMeta equivalent,
+    reference: paddle/phi/infermeta/). Implemented via abstract evaluation."""
+    return jax.eval_shape(_OPS[name].fn, *args, **kwargs)

@@ -1,0 +1,6 @@
+from . import lr  # noqa: F401
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+                        NAdam, Optimizer, RAdam, RMSProp, SGD)
+
+__all__ = ["lr", "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta",
+           "RMSProp", "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam"]

@@ -1,0 +1,438 @@
+"""Optimizers (reference: python/paddle/optimizer/ — SGD/Momentum/Adam/AdamW/
+Lamb/... backed by per-op CUDA kernels e.g. paddle/phi/kernels/gpu/adam_kernel.cu).
+
+TPU design: each optimizer defines a pure functional core
+  init_state(params) -> state pytree
+  apply(params, grads, state, lr) -> (new_params, new_state)
+usable directly under jit/pjit — XLA fuses the whole update into a few
+elementwise kernels, and sharded params get sharded updates for free (this is
+how ZeRO sharding composes: shard the state pytree, not the optimizer code).
+The eager surface (`opt.step()` reading `param.grad`) matches the reference
+for porting convenience.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer, Parameter
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam"]
+
+
+def _tree_map(f, *trees):
+    return jax.tree.map(f, *trees)
+
+
+class Optimizer:
+    """Base optimizer. Subclasses implement `_init_slot` and `_update`."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        del name
+        self._lr = learning_rate
+        self._parameter_list: Optional[List[Parameter]] = None
+        if parameters is not None:
+            self._parameter_list = [p for p in parameters
+                                    if isinstance(p, Parameter)]
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._step_count = 0
+        self._eager_state = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, lr: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = lr
+
+    def _lr_step(self):
+        if isinstance(self._lr, LRScheduler):
+            self._lr.step()
+
+    # -- functional core -----------------------------------------------------
+    def _init_slot(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update(self, p, g, slot, lr, step):
+        raise NotImplementedError
+
+    def init_state(self, params) -> Dict[str, Any]:
+        slots = _tree_map(lambda p: self._init_slot(p), params)
+        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def apply(self, params, grads, state, lr=None):
+        """Pure update: returns (new_params, new_state). jit/pjit-safe."""
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            np_, ns_ = self._update(p, g, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree.unflatten(treedef, new_p),
+                {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
+
+    # -- weight decay helpers ------------------------------------------------
+    def _decay_coeff(self) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "__float__"):
+            return float(wd)
+        return float(wd)
+
+    def _apply_l2(self, g, p):
+        """L2 regularization folded into the gradient (reference semantics for
+        `weight_decay` on non-AdamW optimizers)."""
+        wd = self._decay_coeff()
+        if wd:
+            return g + wd * p
+        return g
+
+    # -- eager surface -------------------------------------------------------
+    def _ensure_params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without `parameters`")
+
+    def _param_key(self, idx: int, p: Parameter) -> str:
+        return p.name if p.name else f"param_{idx}"
+
+    def step(self):
+        """Eager step using param.grad slots (numpy/jax arrays)."""
+        self._ensure_params()
+        items = [(self._param_key(i, p), p)
+                 for i, p in enumerate(self._parameter_list)
+                 if p.trainable and p.grad is not None]
+        if not items:
+            self._step_count += 1
+            return
+        params = {k: p.value for k, p in items}
+        grads = {k: jnp.asarray(p.grad) for k, p in items}
+        if self._eager_state is None:
+            self._eager_state = self.init_state(params)
+        else:
+            # slots follow parameter names; init only newly-seen params so a
+            # frozen/unfrozen subset never resets or mis-assigns moments
+            slots = self._eager_state["slots"]
+            for k, p in items:
+                if k not in slots:
+                    slots[k] = self._init_slot(p.value)
+            state = {"step": self._eager_state["step"],
+                     "slots": {k: slots[k] for k, _ in items}}
+            new_params, new_state = self.apply(params, grads, state)
+            slots.update(new_state["slots"])
+            self._eager_state = {"step": new_state["step"], "slots": slots}
+            for k, p in items:
+                p.value = new_params[k]
+            self._step_count += 1
+            return
+        new_params, self._eager_state = self.apply(params, grads, self._eager_state)
+        for k, p in items:
+            p.value = new_params[k]
+        self._step_count += 1
+
+    def clear_grad(self):
+        self._ensure_params()
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {"step_count": self._step_count}
+        if self._eager_state is not None:
+            out["state"] = self._eager_state
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step_count", 0)
+        if "state" in state:
+            self._eager_state = state["state"]
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    # minimize-style API (reference: Optimizer.minimize)
+    def minimize(self, loss_fn: Callable, *args, **kwargs):
+        raise NotImplementedError(
+            "minimize over a traced loss is not supported; use a jitted "
+            "train step with jax.value_and_grad + opt.apply")
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        return (p - lr * g).astype(p.dtype), slot
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        v = self._momentum * slot["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return (p - lr * upd).astype(p.dtype), {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slot(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc, dtype=jnp.float32)}
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        m = slot["moment"] + jnp.square(g)
+        return (p - lr * g / (jnp.sqrt(m) + self._epsilon)).astype(p.dtype), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"avg_sq_grad": z, "avg_sq_update": z}
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        asg = self._rho * slot["avg_sq_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slot["avg_sq_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slot["avg_sq_update"] + (1 - self._rho) * jnp.square(upd)
+        return (p - lr * upd).astype(p.dtype), {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        s = {"mean_square": z, "momentum": z}
+        if self._centered:
+            s["mean_grad"] = z
+        return s
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        ms = self._rho * slot["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slot["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slot["momentum"] + lr * g / denom
+        out["momentum"] = mom
+        return (p - mom).astype(p.dtype), out
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        slot = {"moment1": z, "moment2": z}
+        if self._multi_precision and p.dtype != jnp.float32:
+            slot["master"] = p.astype(jnp.float32)
+        return slot
+
+    def _decoupled_decay(self, p, lr):
+        return 0.0
+
+    def _update(self, p, g, slot, lr, step):
+        gf = g.astype(jnp.float32)
+        master = slot.get("master", None)
+        pf = master if master is not None else p.astype(jnp.float32)
+        gf = self._apply_l2(gf, pf) if type(self) is Adam else gf
+        m1 = self._beta1 * slot["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slot["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - self._beta1 ** stepf
+        bc2 = 1 - self._beta2 ** stepf
+        m1_hat = m1 / bc1
+        m2_hat = m2 / bc2
+        upd = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        wd = self._decoupled_decay(pf, lr)
+        new_pf = pf - lr * upd - wd
+        out = {"moment1": m1, "moment2": m2}
+        if master is not None:
+            out["master"] = new_pf
+        return new_pf.astype(p.dtype), out
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (reference:
+    python/paddle/optimizer/adamw.py; kernel adamw_kernel.cu)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param_name = None
+
+    def _decoupled_decay(self, p, lr):
+        if (self._apply_decay_param_fun is not None
+                and self._current_param_name is not None
+                and not self._apply_decay_param_fun(self._current_param_name)):
+            return 0.0
+        return lr * self._decay_coeff() * p
+
+    def apply(self, params, grads, state, lr=None):
+        # Track param names (dict pytrees) so apply_decay_param_fun works.
+        if isinstance(params, dict) and self._apply_decay_param_fun is not None:
+            lr = self.get_lr() if lr is None else lr
+            step = state["step"] + 1
+            grads2 = self._grad_clip(grads) if self._grad_clip is not None else grads
+            new_p, new_s = {}, {}
+            for k in params:
+                self._current_param_name = k
+                np_, ns_ = self._update(params[k], grads2[k], state["slots"][k], lr, step)
+                new_p[k] = np_
+                new_s[k] = ns_
+            self._current_param_name = None
+            return new_p, {"step": step, "slots": new_s}
+        return super().apply(params, grads, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment": z, "inf_norm": z}
+
+    def _update(self, p, g, slot, lr, step):
+        g = self._apply_l2(g.astype(jnp.float32), p)
+        m = self._beta1 * slot["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slot["inf_norm"], jnp.abs(g))
+        stepf = step.astype(jnp.float32)
+        lr_t = lr / (1 - self._beta1 ** stepf)
+        return (p - lr_t * m / (u + self._epsilon)).astype(p.dtype), \
+               {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": z, "moment2": z}
+
+    def _update(self, p, g, slot, lr, step):
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m1 = self._beta1 * slot["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slot["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        m1_hat = m1 / (1 - self._beta1 ** stepf)
+        m2_hat = m2 / (1 - self._beta2 ** stepf)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        wd = self._decay_coeff()
+        if self._exclude_fn is None or not self._exclude_fn(p):
+            r = r + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), {"moment1": m1, "moment2": m2}
+
+
+class NAdam(Adam):
+    def _update(self, p, g, slot, lr, step):
+        gf = self._apply_l2(g.astype(jnp.float32), p)
+        m1 = self._beta1 * slot["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slot["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - self._beta1 ** stepf
+        bc2 = 1 - self._beta2 ** stepf
+        m1_bar = (self._beta1 * m1 + (1 - self._beta1) * gf) / bc1
+        upd = m1_bar / (jnp.sqrt(m2 / bc2) + self._epsilon)
+        return (p - lr * upd).astype(p.dtype), {"moment1": m1, "moment2": m2}
+
+
+class RAdam(Adam):
+    def _update(self, p, g, slot, lr, step):
+        gf = self._apply_l2(g.astype(jnp.float32), p)
+        m1 = self._beta1 * slot["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slot["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - self._beta1 ** stepf
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * stepf * self._beta2 ** stepf / (1 - self._beta2 ** stepf)
+        m1_hat = m1 / bc1
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+        v_hat = jnp.sqrt(m2 / (1 - self._beta2 ** stepf)) + self._epsilon
+        upd = jnp.where(rho_t > 5.0, r * m1_hat / v_hat, m1_hat)
+        return (p - lr * upd).astype(p.dtype), {"moment1": m1, "moment2": m2}

@@ -1,0 +1,1130 @@
+"""Tensor op namespace.
+
+TPU-native equivalent of the reference's tensor API
+(reference: python/paddle/tensor/ — ~400 ops over generated _C_ops bindings,
+which dispatch through paddle/phi/api + KernelFactory to per-backend kernels,
+see SURVEY §3.1).
+
+Design: the tensor type IS ``jax.Array`` — no wrapper class. Every function
+here is a pure, jit-traceable composition over jax.numpy/lax, so XLA fuses and
+tiles for the MXU/VPU; there is no per-op dispatch cost and no Python-side
+kernel registry in the hot path. Paddle call signatures (``axis=``, paddle
+``split``/``gather`` semantics) are preserved so reference user code ports
+directly.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import dtypes as _dtypes
+from ..random import next_key
+
+Tensor = jax.Array
+
+__all__ = ["Tensor"]  # extended at bottom
+
+
+def _dt(dtype):
+    if dtype is None:
+        return None
+    return _dtypes.convert_np_dtype_to_dtype_(dtype)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    del place, stop_gradient
+    if isinstance(data, jax.Array) and dtype is None:
+        return data
+    return jnp.asarray(data, dtype=_dt(dtype))
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return jnp.full(shape, fill_value, dtype=_dt(dtype))
+
+
+def empty(shape, dtype="float32"):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dt(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    return jnp.meshgrid(*args, indexing=kwargs.get("indexing", "ij"))
+
+
+def clone(x):
+    return jnp.asarray(x).copy()
+
+
+def numel(x):
+    return x.size
+
+
+# random creation (stateful-looking: keys pulled from the rng context)
+def rand(shape, dtype="float32"):
+    return jax.random.uniform(next_key(), shape, dtype=_dt(dtype))
+
+
+def randn(shape, dtype="float32"):
+    return jax.random.normal(next_key(), shape, dtype=_dt(dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(next_key(), shape, low, high, dtype=_dt(dtype))
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(next_key(), n).astype(_dt(dtype))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(next_key(), shape, dtype=_dt(dtype), minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return mean + std * jax.random.normal(next_key(), shape)
+
+
+def bernoulli(x):
+    return (jax.random.uniform(next_key(), x.shape) < x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples, *x.shape[:-1]))
+        return jnp.moveaxis(out, 0, -1)
+    k = next_key()
+    z = jax.random.gumbel(k, x.shape) + logits
+    return jnp.argsort(-z, axis=-1)[..., :num_samples]
+
+
+# ---------------------------------------------------------------------------
+# casting / shape
+# ---------------------------------------------------------------------------
+def cast(x, dtype):
+    return jnp.asarray(x).astype(_dt(dtype))
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape_(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    if stop_axis < 0:
+        stop_axis += ndim
+    if start_axis < 0:
+        start_axis += ndim
+    new_shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, a, b):
+    return jnp.swapaxes(x, a, b)
+
+
+def t(x):
+    return x.T
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape):
+    # -1 keeps the corresponding (trailing-aligned) dim of x
+    offset = len(shape) - x.ndim
+    resolved = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            src = i - offset
+            if src < 0:
+                raise ValueError(f"expand shape {shape}: -1 in a new leading dim")
+            resolved.append(x.shape[src])
+        else:
+            resolved.append(s)
+    return jnp.broadcast_to(x, tuple(resolved))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_shape(s1, s2):
+    return list(np.broadcast_shapes(tuple(s1), tuple(s2)))
+
+
+def concat(x: Sequence[Tensor], axis=0):
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x: Sequence[Tensor], axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    """Paddle semantics: sections are SIZES (may contain one -1), not indices."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sizes = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = builtins.sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = total - known
+    if builtins.sum(sizes) != total or builtins.any(s < 0 for s in sizes):
+        raise ValueError(
+            f"split sections {num_or_sections} do not sum to dim size {total} "
+            f"on axis {axis}")
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def slice(x, axes, starts, ends):
+    out = x
+    for ax, s, e in zip(axes, starts, ends):
+        out = lax.slice_in_dim(out, s, builtins.min(e, out.shape[ax]), axis=ax)
+    return out
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def pad(x, pad_, mode="constant", value=0.0, data_format=None):
+    """nd pad; `pad_` is a flat [before0, after0, before1, after1, ...] list
+    for the LAST len(pad_)//2 axes (paddle.nn.functional.pad flat form applies
+    to last dims first in torch-style ordering; paddle applies in order)."""
+    if len(pad_) == 2 * x.ndim:
+        pairs = [(pad_[2 * i], pad_[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        n = len(pad_) // 2
+        pairs = [(0, 0)] * (x.ndim - n) + [(pad_[2 * i], pad_[2 * i + 1]) for i in range(n)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+# ---------------------------------------------------------------------------
+# elementwise math
+# ---------------------------------------------------------------------------
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def scale(x, scale_=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale_ + bias if bias_after_scale else (x + bias) * scale_
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return lax.lgamma(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x / (1 - x))
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(idx.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def dist(x, y, p=2):
+    return norm(x - y, p=p)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        min, max = float(jnp.min(x)), float(jnp.max(x))
+    h, _ = jnp.histogram(x, bins=bins, range=(min, max))
+    return h
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(x)
+    return jnp.stack([s, l])
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eig(x):
+    # jnp.linalg.eig is CPU-only in XLA; run on host.
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def lstsq(a, b, rcond=None):
+    return jnp.linalg.lstsq(a, b, rcond=rcond)
+
+
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=_dt(dtype), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=_dt(dtype))
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, dtype=_dt(dtype), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=_dt(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=_dt(dtype))
+
+
+def _cum_select(x, axis, prefer_b):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    idx = jnp.broadcast_to(jnp.arange(x.shape[axis]).reshape(shape), x.shape)
+
+    def comb(a, b):
+        va, ia = a
+        vb, ib = b  # b is the later element in scan order
+        take_b = prefer_b(vb, va)
+        return jnp.where(take_b, vb, va), jnp.where(take_b, ib, ia)
+
+    return lax.associative_scan(comb, (x, idx), axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64"):
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    vals, inds = _cum_select(x, axis, lambda vb, va: vb > va)
+    return vals, inds.astype(_dt(dtype))
+
+
+def cummin(x, axis=None, dtype="int64"):
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    vals, inds = _cum_select(x, axis, lambda vb, va: vb < va)
+    return vals, inds.astype(_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# logic / comparison
+# ---------------------------------------------------------------------------
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def is_empty(x):
+    return x.size == 0
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+# ---------------------------------------------------------------------------
+# search / indexing
+# ---------------------------------------------------------------------------
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(_dt(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(_dt(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        x_m = jnp.moveaxis(x, axis, -1)
+        v, i = topk(x_m, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        v, i = lax.top_k(x, k)
+    else:
+        v, i = lax.top_k(-x, k)
+        v = -v
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vk = jnp.take(v, k - 1, axis=axis)
+    ik = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vk, ik = jnp.expand_dims(vk, axis), jnp.expand_dims(ik, axis)
+    return vk, ik
+
+
+def mode(x, axis=-1, keepdim=False):
+    v = jax.scipy.stats.mode(x, axis=axis, keepdims=keepdim)
+    return v.mode, None
+
+
+def nonzero(x, as_tuple=False):
+    # NOTE: data-dependent shape — host-side only (not jit-traceable).
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in idx)
+    return jnp.stack([jnp.asarray(i) for i in idx], axis=1)
+
+
+def masked_select(x, mask):
+    # NOTE: data-dependent shape — host-side only.
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle accumulate mode: rows at `index` are zeroed first, then updates
+    # are summed into them (reference: python/paddle/tensor/manipulation.py
+    # scatter, overwrite=False branch)
+    return x.at[index].multiply(0).at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    return jnp.zeros(shape, updates.dtype).at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce == "add":
+        vals = jnp.broadcast_to(values, indices.shape)
+        dim_idx = [jnp.broadcast_to(
+            jnp.arange(indices.shape[d]).reshape([-1 if i == d else 1 for i in range(indices.ndim)]),
+            indices.shape) for d in range(indices.ndim)]
+        dim_idx[axis] = indices
+        return x.at[tuple(dim_idx)].add(vals)
+    raise ValueError(f"unsupported reduce: {reduce}")
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # NOTE: data-dependent shape — host-side only.
+    res = np.unique(
+        np.asarray(x), return_index=return_index,
+        return_inverse=return_inverse, return_counts=return_counts, axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = jnp.asarray(arr[keep])
+        rets = [out]
+        if return_inverse:
+            rets.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            rets.append(jnp.asarray(np.diff(np.append(idx, arr.size))))
+        return rets[0] if len(rets) == 1 else tuple(rets)
+    raise NotImplementedError("axis != None")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + builtins.abs(offset)
+    out = jnp.zeros((*x.shape[:-1], n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., i, i + offset].set(x)
+    else:
+        out = out.at[..., i - offset, i].set(x)
+    if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        out = jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+    return out
+
+
+def numpy(x):
+    return np.asarray(x)
+
+
+def item(x):
+    return np.asarray(x).item()
+
+
+def tolist(x):
+    return np.asarray(x).tolist()
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = index_num // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+__all__ += [n for n in dir() if not n.startswith("_") and n not in ("jax", "jnp", "np", "lax", "builtins")]

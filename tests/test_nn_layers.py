@@ -1,0 +1,229 @@
+"""Layer tests incl. parity vs torch CPU golden values where convenient
+(reference pattern: OpTest golden-value framework, SURVEY §4.1)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_linear_matches_torch():
+    import torch
+    x = np.random.randn(4, 8).astype(np.float32)
+    lin = nn.Linear(8, 3)
+    out = np.asarray(lin(paddle.to_tensor(x)))
+    tw = torch.tensor(np.asarray(lin.weight.value))
+    tb = torch.tensor(np.asarray(lin.bias.value))
+    ref = (torch.tensor(x) @ tw + tb).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    import torch
+    x = np.random.randn(2, 3, 10, 10).astype(np.float32)
+    conv = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+    out = np.asarray(conv(paddle.to_tensor(x)))
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(np.asarray(conv.weight.value)),
+        torch.tensor(np.asarray(conv.bias.value)), stride=2, padding=1).numpy()
+    assert out.shape == ref.shape
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    import torch
+    x = np.random.randn(2, 4, 7, 7).astype(np.float32)
+    conv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    out = np.asarray(conv(paddle.to_tensor(x)))
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(np.asarray(conv.weight.value)),
+        torch.tensor(np.asarray(conv.bias.value)), stride=2, padding=1,
+        output_padding=1).numpy()
+    assert out.shape == ref.shape
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_grouped_and_depthwise_conv():
+    import torch
+    x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+    conv = nn.Conv2D(4, 8, 3, groups=4, padding=1)
+    out = np.asarray(conv(paddle.to_tensor(x)))
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(np.asarray(conv.weight.value)),
+        torch.tensor(np.asarray(conv.bias.value)), padding=1, groups=4).numpy()
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(5)
+    x = paddle.randn((4, 5, 6, 6))
+    bn.train()
+    y = bn(x)
+    m = np.asarray(y).mean(axis=(0, 2, 3))
+    assert np.allclose(m, 0.0, atol=1e-5)
+    assert np.abs(np.asarray(bn._mean)).sum() > 0  # running stats updated
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layer_norm_matches_torch():
+    import torch
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    ln = nn.LayerNorm(8)
+    out = np.asarray(ln(paddle.to_tensor(x)))
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(x), (8,), torch.tensor(np.asarray(ln.weight.value)),
+        torch.tensor(np.asarray(ln.bias.value))).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_rms_norm():
+    x = np.random.randn(2, 4, 8).astype(np.float32)
+    rn = nn.RMSNorm(8)
+    out = np.asarray(rn(paddle.to_tensor(x)))
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1], [2, 0]]))
+    out = np.asarray(emb(ids))
+    assert np.allclose(out[0, 0], 0.0)
+    assert np.allclose(out[1, 1], 0.0)
+    assert not np.allclose(out[0, 1], 0.0)
+
+
+def test_pools_match_torch():
+    import torch
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(F.max_pool2d(paddle.to_tensor(x), 2, 2))
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out, ref, atol=1e-6)
+    # paddle exclusive=False == torch count_include_pad=True (both defaults
+    # differ; pin them explicitly)
+    out = np.asarray(F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1, exclusive=False))
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                                         count_include_pad=True).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+    out = np.asarray(F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1, exclusive=True))
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                                         count_include_pad=False).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+    out = np.asarray(F.adaptive_avg_pool2d(paddle.to_tensor(x), 1))
+    ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 1).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (6,))
+    labels[0] = -100
+    out = float(F.cross_entropy(paddle.to_tensor(logits),
+                                paddle.to_tensor(labels), ignore_index=-100))
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels), ignore_index=-100).item()
+    assert abs(out - ref) < 1e-5
+
+
+def test_cross_entropy_soft_label_and_smoothing():
+    import torch
+    logits = np.random.randn(4, 7).astype(np.float32)
+    labels = np.random.randint(0, 7, (4,))
+    out = float(F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                                label_smoothing=0.1))
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels), label_smoothing=0.1).item()
+    assert abs(out - ref) < 1e-5
+
+
+def test_bce_with_logits_matches_torch():
+    import torch
+    z = np.random.randn(5, 3).astype(np.float32)
+    t = (np.random.rand(5, 3) > 0.5).astype(np.float32)
+    out = float(F.binary_cross_entropy_with_logits(paddle.to_tensor(z), paddle.to_tensor(t)))
+    ref = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(z), torch.tensor(t)).item()
+    assert abs(out - ref) < 1e-5
+
+
+def test_sdpa_matches_torch():
+    import torch
+    q = np.random.randn(2, 5, 4, 8).astype(np.float32)  # B S H D
+    k = np.random.randn(2, 5, 4, 8).astype(np.float32)
+    v = np.random.randn(2, 5, 4, 8).astype(np.float32)
+    out = np.asarray(F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=True))
+    tq = torch.tensor(q).permute(0, 2, 1, 3)
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        tq, torch.tensor(k).permute(0, 2, 1, 3),
+        torch.tensor(v).permute(0, 2, 1, 3), is_causal=True)
+    ref = ref.permute(0, 2, 1, 3).numpy()
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_dropout_train_eval():
+    x = paddle.ones((1000,))
+    y = F.dropout(x, 0.5, training=True)
+    frac = float((np.asarray(y) == 0).mean())
+    assert 0.3 < frac < 0.7
+    kept = np.asarray(y)[np.asarray(y) != 0]
+    assert np.allclose(kept, 2.0)
+    assert np.allclose(np.asarray(F.dropout(x, 0.5, training=False)), 1.0)
+
+
+def test_interpolate():
+    import torch
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    out = np.asarray(F.interpolate(paddle.to_tensor(x), scale_factor=2, mode="nearest"))
+    ref = torch.nn.functional.interpolate(torch.tensor(x), scale_factor=2).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+    out = np.asarray(F.interpolate(paddle.to_tensor(x), size=[8, 8], mode="bilinear",
+                                   align_corners=True))
+    ref = torch.nn.functional.interpolate(torch.tensor(x), size=(8, 8), mode="bilinear",
+                                          align_corners=True).numpy()
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_state_dict_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    loaded = paddle.load(path)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    missing, unexpected = net2.set_state_dict(loaded)
+    assert not missing and not unexpected
+    x = paddle.randn((3, 4))
+    assert np.allclose(np.asarray(net(x)), np.asarray(net2(x)), atol=1e-6)
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    lin(paddle.randn((1, 2)))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn((1, 2)))
+    assert calls == [1]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(layer, 2)
+    enc.eval()
+    x = paddle.randn((2, 6, 16))
+    out = enc(x)
+    assert out.shape == (2, 6, 16)
+
+
+def test_sublayer_traversal():
+    net = nn.Sequential(nn.Linear(2, 3), nn.Sequential(nn.Linear(3, 4)))
+    names = [n for n, _ in net.named_parameters()]
+    assert "0.weight" in names and "1.0.weight" in names
+    assert len(net.parameters()) == 4

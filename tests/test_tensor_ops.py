@@ -1,0 +1,104 @@
+"""Tensor-op golden tests vs numpy (reference pattern:
+test/legacy_test/test_*_op.py OpTest forward-vs-numpy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == (2, 3)
+    assert paddle.ones([2], dtype="int32").dtype == np.int32
+    assert np.allclose(np.asarray(paddle.arange(5)), np.arange(5))
+    assert paddle.full([2, 2], 7.0)[0, 0] == 7.0
+    assert paddle.eye(3).shape == (3, 3)
+
+
+def test_elementwise_math():
+    x = np.random.randn(4, 5).astype(np.float32)
+    y = np.random.randn(4, 5).astype(np.float32)
+    assert np.allclose(np.asarray(paddle.add(x, y)), x + y, atol=1e-6)
+    assert np.allclose(np.asarray(paddle.exp(x)), np.exp(x), rtol=1e-5)
+    assert np.allclose(np.asarray(paddle.clip(x, -0.5, 0.5)), np.clip(x, -0.5, 0.5))
+    assert np.allclose(np.asarray(paddle.rsqrt(np.abs(x) + 1)),
+                       1 / np.sqrt(np.abs(x) + 1), rtol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(5, 4).astype(np.float32)
+    out = paddle.matmul(a, b, transpose_y=True)
+    assert np.allclose(np.asarray(out), a @ b.T, atol=1e-5)
+
+
+def test_split_paddle_semantics():
+    x = np.arange(24).reshape(2, 12)
+    parts = paddle.split(paddle.to_tensor(x), [3, 4, -1], axis=1)
+    assert [p.shape[1] for p in parts] == [3, 4, 5]
+    parts2 = paddle.split(paddle.to_tensor(x), 3, axis=1)
+    assert all(p.shape[1] == 4 for p in parts2)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    assert np.allclose(np.asarray(paddle.sum(x, axis=1)), x.sum(1), atol=1e-5)
+    assert np.allclose(np.asarray(paddle.mean(x, axis=[0, 2])), x.mean((0, 2)), atol=1e-6)
+    assert np.allclose(np.asarray(paddle.logsumexp(x, axis=-1)),
+                       np.log(np.exp(x).sum(-1)), rtol=1e-5)
+    assert np.allclose(np.asarray(paddle.std(x)), x.std(ddof=1), rtol=1e-4)
+
+
+def test_indexing_ops():
+    x = np.random.randn(4, 6).astype(np.float32)
+    idx = np.array([2, 0, 1])
+    assert np.allclose(np.asarray(paddle.gather(x, idx, axis=0)), x[idx])
+    assert np.allclose(np.asarray(paddle.index_select(x, idx, axis=1)), x[:, idx])
+    v, i = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    assert np.allclose(np.asarray(v), ref, atol=1e-6)
+
+
+def test_scatter_put_along_axis():
+    x = np.zeros((3, 4), np.float32)
+    idx = np.array([[0], [1], [2]])
+    out = paddle.put_along_axis(paddle.to_tensor(x), idx, 1.0, axis=1, reduce="add")
+    assert np.asarray(out).sum() == 3.0
+
+
+def test_shape_manipulation():
+    x = np.arange(24).reshape(2, 3, 4)
+    assert paddle.flatten(paddle.to_tensor(x), 1, 2).shape == (2, 12)
+    assert paddle.unsqueeze(paddle.to_tensor(x), [0, 2]).shape == (1, 2, 1, 3, 4)
+    assert paddle.squeeze(paddle.unsqueeze(paddle.to_tensor(x), 0), 0).shape == (2, 3, 4)
+    assert paddle.tile(paddle.to_tensor(x), [1, 2, 1]).shape == (2, 6, 4)
+    assert paddle.roll(paddle.to_tensor(x), 1, axis=0).shape == (2, 3, 4)
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.asarray(paddle.cholesky(spd))
+    assert np.allclose(L @ L.T, spd, atol=1e-4)
+    assert np.allclose(np.asarray(paddle.inverse(spd)) @ spd, np.eye(4), atol=1e-4)
+    assert abs(float(paddle.det(spd)) - np.linalg.det(spd)) / abs(np.linalg.det(spd)) < 1e-4
+
+
+def test_logic():
+    x = np.array([1.0, np.nan, np.inf])
+    assert np.asarray(paddle.isnan(x)).tolist() == [False, True, False]
+    assert np.asarray(paddle.isinf(x)).tolist() == [False, False, True]
+    assert bool(paddle.allclose(np.ones(3), np.ones(3)))
+
+
+def test_einsum_cumsum():
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    assert np.allclose(np.asarray(paddle.einsum("ij,jk->ik", a, b)), a @ b, atol=1e-5)
+    assert np.allclose(np.asarray(paddle.cumsum(a, axis=1)), np.cumsum(a, 1), atol=1e-6)
+
+
+def test_cast_dtype():
+    x = paddle.to_tensor([1.5, 2.5])
+    assert paddle.cast(x, "int32").dtype == np.int32
+    assert paddle.cast(x, "bfloat16").dtype == paddle.bfloat16
