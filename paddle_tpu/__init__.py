@@ -29,6 +29,10 @@ from . import nn  # noqa: F401
 from .nn.layer.layers import Parameter  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import ops  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from .hapi.model import Model  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,8 @@
+"""paddle.distributed equivalent namespace (filled in by the distributed
+stack: topology/mesh, collectives, fleet, auto_parallel, checkpoint)."""
+
+from .env import (ParallelEnv, get_local_rank, get_rank, get_world_size,
+                  init_parallel_env, is_initialized)
+
+__all__ = ["get_rank", "get_world_size", "get_local_rank", "ParallelEnv",
+           "init_parallel_env", "is_initialized"]

@@ -1,0 +1,104 @@
+"""Distributed process environment (reference:
+python/paddle/distributed/parallel.py env vars PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM; launcher sets them, SURVEY §3.3).
+
+TPU design: a JAX process == one host controller of (possibly many) local
+devices. Rank/world-size come from the launcher env (paddle-compatible names
+first, then JAX/TPU coordinator names), falling back to single-process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["get_rank", "get_world_size", "get_local_rank", "ParallelEnv",
+           "init_parallel_env", "is_initialized"]
+
+_initialized = [False]
+
+
+def _env_int(names, default):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return default
+
+
+def get_rank() -> int:
+    import jax
+    if _initialized[0]:
+        return jax.process_index()
+    return _env_int(["PADDLE_TRAINER_ID", "PADDLE_RANK_IN_NODE", "RANK",
+                     "JAX_PROCESS_INDEX"], 0)
+
+
+def get_world_size() -> int:
+    import jax
+    if _initialized[0]:
+        return jax.process_count()
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    if eps:
+        return len(eps.split(","))
+    return _env_int(["PADDLE_TRAINERS_NUM", "WORLD_SIZE", "JAX_PROCESS_COUNT"], 1)
+
+
+def get_local_rank() -> int:
+    return _env_int(["PADDLE_LOCAL_RANK", "LOCAL_RANK"], 0)
+
+
+class ParallelEnv:
+    """(reference: python/paddle/distributed/parallel.py ParallelEnv)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_local_rank()
+
+    @property
+    def dev_id(self):
+        return get_local_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Multi-host initialization (reference: init_parallel_env
+    parallel.py:978 — TCPStore rendezvous + ProcessGroup setup).
+
+    TPU design: jax.distributed.initialize connects to the TPU coordination
+    service (the TCPStore equivalent); collectives need no ring bootstrap —
+    XLA programs embed them. Single-process (or already-initialized) calls
+    are no-ops so scripts run unchanged on one host.
+    """
+    import jax
+    if _initialized[0]:
+        return ParallelEnv()
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    world = num_processes if num_processes is not None else get_world_size()
+    if world > 1 or addr:
+        rank = process_id if process_id is not None else get_rank()
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=world, process_id=rank)
+        _initialized[0] = True
+    return ParallelEnv()

@@ -1,0 +1,7 @@
+from .callbacks import (Callback, EarlyStopping, History, LRSchedulerCallback,
+                        ModelCheckpoint, ProgBarLogger)
+from .model import Model
+from .summary import summary
+
+__all__ = ["Model", "summary", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "History", "LRSchedulerCallback"]

@@ -1,0 +1,24 @@
+"""Data loading (reference: python/paddle/io/ — Dataset/DataLoader with
+multiprocess workers, samplers, collate).
+
+TPU design: the loader produces numpy batches on host; device transfer is a
+single jax.device_put per batch (or is handled by jit donation). Background
+prefetch uses threads (workers read ahead while the TPU computes) — on TPU
+the bottleneck is HBM/compute, not Python, so process pools are optional
+(num_workers>0 uses a thread pool; the GIL is released in numpy/IO paths).
+"""
+
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "DataLoader", "default_collate_fn", "get_worker_info",
+    "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "WeightedRandomSampler", "SubsetRandomSampler",
+]

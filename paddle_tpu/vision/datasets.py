@@ -1,0 +1,139 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: dataset classes read local files (same on-disk
+formats as the reference: CIFAR pickle batches, MNIST idx). FakeImageDataset
+generates deterministic synthetic data for benchmarks and CI.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FakeImageDataset"]
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic synthetic image classification data (CI/bench stand-in
+    for downloads; reference tests use similar fakes, SURVEY §4.6)."""
+
+    def __init__(self, num_samples=1024, image_shape=(3, 32, 32),
+                 num_classes=10, transform: Optional[Callable] = None,
+                 dtype="float32", seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+        self._rng_seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState((self._rng_seed * 1000003 + idx) % (2**31))
+        img = rng.randn(*self.image_shape).astype(self.dtype)
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local archive (reference:
+    python/paddle/vision/datasets/cifar.py; same pickle-batch format)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        del download, backend
+        self.mode = mode
+        self.transform = transform
+        self.data = []
+        self.labels = []
+        if data_file is not None and os.path.exists(data_file):
+            self._load_archive(data_file)
+        else:
+            raise FileNotFoundError(
+                "Cifar10 requires a local data_file (no network access); "
+                "use vision.datasets.FakeImageDataset for synthetic data")
+
+    def _load_archive(self, path):
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if self.mode == "train" else ["test_batch"])
+        with tarfile.open(path, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d[b"labels"])
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype("float32") / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    def _load_archive(self, path):
+        names = ["train"] if self.mode == "train" else ["test"]
+        with tarfile.open(path, "r:*") as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d[b"fine_labels"])
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference:
+    python/paddle/vision/datasets/mnist.py)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        del download, backend, mode
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise FileNotFoundError(
+                "MNIST requires local image_path/label_path (no network)")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(p):
+        return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+
+    def _read_images(self, p):
+        with self._open(p) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, 1, rows, cols)
+
+    def _read_labels(self, p):
+        with self._open(p) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049
+            return np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32") / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])

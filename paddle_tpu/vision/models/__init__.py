@@ -1,0 +1,4 @@
+from .resnet import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+
+__all__ = list(_resnet_all)

@@ -1,0 +1,180 @@
+"""Image transforms over numpy CHW arrays (reference:
+python/paddle/vision/transforms/)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "Transpose", "ToTensor", "Resize",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "RandomCrop",
+           "CenterCrop", "Pad", "RandomRotation", "BrightnessTransform",
+           "ContrastTransform"]
+
+
+class Compose:
+    def __init__(self, transforms: List):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        del to_rgb
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        raw = np.asarray(img)
+        img = raw.astype(np.float32)
+        if raw.dtype == np.uint8:
+            img = img / 255.0
+        if img.ndim == 3 and self.data_format == "CHW" and img.shape[0] not in (1, 3, 4):
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+def _chw(img):
+    return np.asarray(img)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = _chw(img)
+        C, H, W = img.shape
+        h, w = self.size
+        ys = (np.arange(h) + 0.5) * H / h - 0.5
+        xs = (np.arange(w) + 0.5) * W / w - 0.5
+        y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        wy = np.clip(ys - y0, 0, 1)[None, :, None]
+        wx = np.clip(xs - x0, 0, 1)[None, None, :]
+        a = img[:, y0][:, :, x0]
+        b = img[:, y0][:, :, x1]
+        c = img[:, y1][:, :, x0]
+        d = img[:, y1][:, :, x1]
+        return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+                + c * wy * (1 - wx) + d * wy * wx).astype(img.dtype)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1, :].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = _chw(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((0, 0), (p, p), (p, p)), mode="constant")
+        C, H, W = img.shape
+        h, w = self.size
+        top = np.random.randint(0, H - h + 1)
+        left = np.random.randint(0, W - w + 1)
+        return img[:, top:top + h, left:left + w]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = _chw(img)
+        C, H, W = img.shape
+        h, w = self.size
+        top = (H - h) // 2
+        left = (W - w) // 2
+        return img[:, top:top + h, left:left + w]
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding if not isinstance(padding, int) else (padding,) * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        l, t, r, b = self.padding
+        return np.pad(_chw(img), ((0, 0), (t, b), (l, r)), constant_values=self.fill)
+
+
+class RandomRotation:
+    def __init__(self, degrees):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, (int, float)) else degrees
+
+    def __call__(self, img):
+        # 90-degree-quantized rotation (cheap, allocation-free approximation)
+        angle = np.random.uniform(*self.degrees)
+        k = int(np.round(angle / 90.0)) % 4
+        return np.rot90(_chw(img), k=k, axes=(1, 2)).copy()
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return np.clip(_chw(img) * f, 0, None)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        img = _chw(img)
+        mean = img.mean()
+        return np.clip((img - mean) * f + mean, 0, None)
