@@ -1,8 +1,46 @@
-"""paddle.distributed equivalent namespace (filled in by the distributed
-stack: topology/mesh, collectives, fleet, auto_parallel, checkpoint)."""
+"""paddle.distributed equivalent namespace.
 
+Layer map (SURVEY §2.4/§2.5 -> here):
+  ProcessGroup/NCCL stack   -> collective.py (lax collectives over mesh axes)
+  CommunicateTopology/HCG   -> topology.py (jax.sharding.Mesh + Group views)
+  auto_parallel DTensor     -> auto_parallel/ (NamedSharding + device_put)
+  DataParallel/reducer      -> parallel.py (dp-axis batch sharding)
+  fleet hybrid stack        -> fleet/
+"""
+
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                            ShardingStage1, ShardingStage2, ShardingStage3,
+                            dtensor_from_local, dtensor_to_local,
+                            get_placements, reshard, shard_layer,
+                            shard_optimizer, shard_tensor, unshard_dtensor)
+from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
+                         barrier, batch_isend_irecv, broadcast, get_group,
+                         new_group, ppermute, recv, reduce, reduce_scatter,
+                         scatter, send)
+from . import fleet  # noqa: F401
 from .env import (ParallelEnv, get_local_rank, get_rank, get_world_size,
                   init_parallel_env, is_initialized)
+from .parallel import DataParallel, shard_batch
+from .topology import (CommunicateTopology, Group, HybridCommunicateGroup,
+                       build_mesh, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
 
-__all__ = ["get_rank", "get_world_size", "get_local_rank", "ParallelEnv",
-           "init_parallel_env", "is_initialized"]
+__all__ = [
+    # env
+    "get_rank", "get_world_size", "get_local_rank", "ParallelEnv",
+    "init_parallel_env", "is_initialized",
+    # topology
+    "CommunicateTopology", "HybridCommunicateGroup", "Group", "build_mesh",
+    "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+    # collectives
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "reduce", "scatter", "all_to_all", "ppermute", "barrier", "P2POp",
+    "batch_isend_irecv", "new_group", "get_group", "send", "recv", "fleet",
+    # auto parallel
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+    "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    # dp
+    "DataParallel", "shard_batch",
+]

@@ -1,0 +1,13 @@
+from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
+                  dtensor_from_local, dtensor_to_local, get_placements,
+                  reshard, shard_layer, shard_optimizer, shard_tensor,
+                  unshard_dtensor)
+from .placement_type import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_local",
+    "dtensor_to_local", "unshard_dtensor", "shard_optimizer", "get_placements",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+]

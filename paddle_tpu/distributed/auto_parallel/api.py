@@ -1,0 +1,238 @@
+"""Semi-auto parallel (DTensor) API (reference:
+python/paddle/distributed/auto_parallel/api.py — shard_tensor :179,
+reshard :675, shard_layer :776, dtensor_from_local :589, shard_optimizer
+:1448; SPMD propagation in paddle/phi/infermeta/spmd_rules/ and the reshard
+engine in phi/core/distributed/auto_parallel/reshard/).
+
+TPU design: a "DistTensor" is simply a jax.Array with a NamedSharding —
+GSPMD is the SPMD-rule engine (per-op sharding propagation) and
+jax.device_put between NamedShardings is the reshard engine (XLA emits the
+collective-permute / all-gather / reduce-scatter plans the reference
+implements by hand in r_to_s/s_to_r/... reshard functions). Partial
+placements are materialized by an explicit psum over the axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...nn.layer.layers import Layer, Parameter
+from .placement_type import Partial, Placement, Replicate, Shard, placements_to_spec, to_placements
+from .process_mesh import ProcessMesh, to_jax_mesh
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "dtensor_from_local",
+           "dtensor_to_local", "unshard_dtensor", "shard_optimizer",
+           "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3"]
+
+
+def _sharding_for(x_ndim: int, mesh, placements: Sequence[Placement]) -> NamedSharding:
+    jmesh = to_jax_mesh(mesh)
+    spec = placements_to_spec(placements, x_ndim, jmesh.axis_names)
+    return NamedSharding(jmesh, spec)
+
+
+def shard_tensor(data, mesh, placements: Sequence[Placement],
+                 dtype=None, stop_gradient=None) -> jax.Array:
+    """Place `data` on the mesh with given placements (reference: api.py:179).
+    Returns a global jax.Array whose shards live on the mesh devices."""
+    if isinstance(data, Parameter):
+        sharded = shard_tensor(data.value, mesh, placements)
+        data.value = sharded
+        data.placements = list(placements)
+        data.process_mesh = mesh
+        return data
+    x = jnp.asarray(data, dtype=dtype)
+    partial_axes = [(i, p) for i, p in enumerate(placements) if isinstance(p, Partial)]
+    if partial_axes:
+        raise ValueError("shard_tensor cannot create Partial placements; "
+                         "Partial arises from computation (use reshard to "
+                         "reduce it)")
+    return jax.device_put(x, _sharding_for(x.ndim, mesh, placements))
+
+
+def get_placements(x, mesh=None) -> Optional[List[Placement]]:
+    """Recover placements from a jax.Array's sharding."""
+    sharding = getattr(x, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return None
+    return to_placements(sharding.spec, x.ndim, sharding.mesh.axis_names)
+
+
+def reshard(x, mesh, placements: Sequence[Placement]) -> jax.Array:
+    """Convert to new placements (reference: api.py:675; C++ reshard function
+    registry). jax.device_put handles all pairwise conversions (s->r, r->s,
+    s->s', cross-mesh); Partial->Replicate/Shard performs the pending
+    reduction explicitly."""
+    cur = get_placements(x)
+    jmesh = to_jax_mesh(mesh)
+    partials = [(i, p) for i, p in enumerate(placements) if isinstance(p, Partial)]
+    if partials:
+        raise ValueError("reshard target cannot be Partial")
+    if isinstance(x, Parameter):
+        x.value = reshard(x.value, mesh, placements)
+        x.placements = list(placements)
+        return x
+    return jax.device_put(jnp.asarray(x), _sharding_for(jnp.asarray(x).ndim, mesh, placements))
+
+
+def dtensor_from_local(local_tensor, mesh, placements: Sequence[Placement]) -> jax.Array:
+    """Assemble a global array from this process's local shard (reference:
+    api.py:589). Single-controller: local shards are per-device arrays; use
+    jax.make_array_from_single_device_arrays across local devices, or treat
+    `local_tensor` as the (replicated) global value when placements are all
+    Replicate."""
+    jmesh = to_jax_mesh(mesh)
+    sharding = _sharding_for(jnp.asarray(local_tensor).ndim, mesh, placements)
+    if all(isinstance(p, Replicate) for p in placements):
+        return jax.device_put(jnp.asarray(local_tensor), sharding)
+    # global shape: local shape scaled up along sharded dims
+    local = np.asarray(local_tensor)
+    gshape = list(local.shape)
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            gshape[p.dim] *= jmesh.devices.shape[axis_idx]
+    # every device contributes an identical local block at its mesh position
+    return jax.make_array_from_callback(tuple(gshape), sharding,
+                                        lambda idx: local)
+
+
+def dtensor_to_local(x, mesh=None, placements=None):
+    """Per-device local shard view (reference: api.py dtensor_to_local).
+    Single-controller: returns the addressable shard of this process."""
+    shards = [s for s in x.addressable_shards]
+    if len(shards) == 1:
+        return shards[0].data
+    return [s.data for s in shards]
+
+
+def unshard_dtensor(x) -> jax.Array:
+    """Gather to a fully-replicated array (reference: api.py unshard_dtensor)."""
+    sharding = getattr(x, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return jax.device_put(x, NamedSharding(sharding.mesh, PartitionSpec()))
+    return x
+
+
+def shard_layer(layer: Layer, process_mesh, shard_fn: Optional[Callable] = None,
+                input_fn=None, output_fn=None) -> Layer:
+    """Shard every parameter of `layer` (reference: api.py:776). Default
+    shard_fn replicates; custom fn gets (name, layer, mesh) per sublayer."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is not None and p.process_mesh is None:
+                    shard_tensor(p, mesh, [Replicate() for _ in
+                                           to_jax_mesh(mesh).axis_names])
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, args: input_fn(args, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, args, out: output_fn(out, process_mesh))
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# shard_optimizer: ZeRO via sharded optimizer states (reference: api.py:1448,
+# ShardingStage1/2/3 shard_fns at :1209,1270,1356)
+# ---------------------------------------------------------------------------
+class _ShardingStageBase:
+    def __init__(self, mesh=None, sharding_mesh_dim: Union[int, str, None] = None):
+        self._mesh = mesh
+        self._dim = sharding_mesh_dim
+
+
+class ShardingStage1(_ShardingStageBase):
+    """Shard optimizer states (moments) along the sharding axis."""
+    stage = 1
+
+
+class ShardingStage2(_ShardingStageBase):
+    """Stage-2: optimizer states + gradients sharded. Under pjit, gradient
+    sharding falls out of the optimizer-state sharding (reduce-scatter is
+    inserted by XLA when grads feed sharded states)."""
+    stage = 2
+
+
+class ShardingStage3(_ShardingStageBase):
+    """Stage-3: parameters sharded too (gather-on-use inserted by XLA)."""
+    stage = 3
+
+
+class _ShardedOptimizer:
+    """Wraps an Optimizer so init_state() produces sharded state pytrees.
+
+    The parameter->state mapping stays 1:1 (unlike the reference's
+    rank-partition bookkeeping in dygraph_sharding_optimizer.py:240 —
+    GSPMD does the partitioning from the sharding annotations alone).
+    """
+
+    def __init__(self, optimizer, shard_cfg, mesh):
+        self._inner = optimizer
+        self._cfg = shard_cfg
+        self._mesh = to_jax_mesh(mesh) if mesh is not None else None
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _shard_axis_name(self):
+        dim = self._cfg._dim
+        if isinstance(dim, str):
+            return dim
+        names = self._mesh.axis_names
+        if dim is None:
+            for cand in ("sharding", "dp"):
+                if cand in names:
+                    return cand
+            return names[0]
+        return names[dim]
+
+    def _shard_leaf(self, leaf):
+        """Shard a state leaf along its largest dim divisible by the axis."""
+        axis = self._shard_axis_name()
+        size = self._mesh.shape[axis]
+        spec_entries = [None] * leaf.ndim
+        for d in np.argsort([-s for s in leaf.shape]):
+            if leaf.shape[d] % size == 0 and leaf.shape[d] >= size:
+                spec_entries[int(d)] = axis
+                break
+        sharding = NamedSharding(self._mesh, PartitionSpec(*spec_entries))
+        return jax.device_put(leaf, sharding)
+
+    def init_state(self, params):
+        state = self._inner.init_state(params)
+        state["slots"] = jax.tree.map(self._shard_leaf, state["slots"])
+        return state
+
+    def apply(self, params, grads, state, lr=None):
+        return self._inner.apply(params, grads, state, lr)
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self):
+        return self._inner.clear_grad()
+
+
+def shard_optimizer(optimizer, shard_fn=None, mesh=None):
+    """(reference: api.py:1448). With a ShardingStage* shard_fn, optimizer
+    states are annotated sharded; stage 3 additionally shards parameters."""
+    if shard_fn is None:
+        shard_fn = ShardingStage1(mesh)
+    use_mesh = mesh if mesh is not None else getattr(shard_fn, "_mesh", None)
+    assert use_mesh is not None, "shard_optimizer needs a mesh"
+    wrapped = _ShardedOptimizer(optimizer, shard_fn, use_mesh)
+    if getattr(shard_fn, "stage", 1) >= 3 and optimizer._parameter_list:
+        axis = wrapped._shard_axis_name()
+        for p in optimizer._parameter_list:
+            if p.trainable:
+                leaf = wrapped._shard_leaf(p.value)
+                p.value = leaf
+    return wrapped

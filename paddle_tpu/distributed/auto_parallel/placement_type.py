@@ -1,0 +1,115 @@
+"""Placement types (reference:
+python/paddle/distributed/auto_parallel/placement_type.py; C++
+paddle/phi/core/distributed/auto_parallel/placement_types.h).
+
+Mapping to XLA/GSPMD:
+  Shard(d)    -> tensor dim d partitioned over a mesh axis (PartitionSpec entry)
+  Replicate() -> no annotation on that mesh axis
+  Partial(op) -> pending cross-axis reduction; GSPMD tracks this internally,
+                 here it's explicit metadata resolved by `reshard` (psum /
+                 pmax / ... over the axis).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial",
+           "placements_to_spec", "to_placements"]
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def placements_to_spec(placements: Sequence[Placement], ndim: int,
+                       axis_names: Sequence[str]) -> PartitionSpec:
+    """Convert a per-mesh-axis placement list into a per-tensor-dim
+    PartitionSpec. placements[i] describes what mesh axis i does."""
+    entries: List = [None] * ndim
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim if p.dim >= 0 else p.dim + ndim
+            axis = axis_names[axis_idx]
+            if entries[d] is None:
+                entries[d] = axis
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis,)
+            else:
+                entries[d] = (entries[d], axis)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def to_placements(spec: PartitionSpec, ndim: int,
+                  axis_names: Sequence[str]) -> List[Placement]:
+    """Inverse of placements_to_spec (lossy: Partial is not representable)."""
+    out: List[Placement] = [Replicate() for _ in axis_names]
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for a in axes:
+            out[list(axis_names).index(a)] = Shard(dim)
+    return out

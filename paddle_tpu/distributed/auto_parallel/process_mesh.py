@@ -1,0 +1,101 @@
+"""ProcessMesh (reference:
+python/paddle/distributed/auto_parallel/process_mesh.py; C++
+paddle/phi/core/distributed/auto_parallel/process_mesh.h).
+
+Wraps a jax.sharding.Mesh: `mesh` is an N-d array of global device ids (the
+reference's process ids), `dim_names` name the axes. All sharding/reshard
+APIs accept either ProcessMesh or a raw jax Mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        assert arr.ndim == len(dim_names)
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devices = np.array(jax.devices())
+        if arr.size > devices.size:
+            raise ValueError(
+                f"ProcessMesh needs {arr.size} devices, only {devices.size} "
+                f"visible")
+        self._jax_mesh = Mesh(devices[arr.reshape(-1)].reshape(arr.shape),
+                              tuple(self._dim_names))
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index: Optional[int] = None):
+        """Reorder so `dim_name` is first; with index, slice that submesh
+        (reference: process_mesh.py get_mesh_with_dim)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        ids = np.transpose(self._ids, order)
+        names = [self._dim_names[i] for i in order]
+        if index is None:
+            return ProcessMesh(ids, names)
+        return ProcessMesh(ids[index], names[1:])
+
+    def __getitem__(self, idx):
+        ids = self._ids[idx]
+        names = self._dim_names[1:] if not isinstance(idx, slice) else self._dim_names
+        if ids.ndim == 0:
+            ids = ids.reshape(1)
+            names = ["d0"]
+        return ProcessMesh(ids, names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+def to_jax_mesh(mesh) -> Mesh:
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    raise TypeError(f"expected ProcessMesh or jax Mesh, got {type(mesh)}")

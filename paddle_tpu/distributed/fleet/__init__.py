@@ -1,0 +1,11 @@
+"""Fleet distributed-training facade (reference:
+python/paddle/distributed/fleet/ — fleet.init/distributed_model/
+distributed_optimizer at fleet/fleet.py:151,218).
+
+Populated incrementally: layers/ (TP), utils/ (SP), recompute/, meta_parallel/
+(pipeline, sharding). The top-level fleet API object lives in fleet.py.
+"""
+
+from . import layers, recompute, utils  # noqa: F401
+
+__all__ = ["layers", "recompute", "utils"]

@@ -1,0 +1,8 @@
+from . import mp_ops  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+
+__all__ = ["mp_ops", "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "RNGStatesTracker", "get_rng_state_tracker"]

@@ -1,0 +1,139 @@
+"""Tensor-parallel collective primitives (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_ops.py — _c_identity,
+_c_concat, _c_split, _mp_allreduce; CUDA ops
+paddle/fluid/operators/collective/c_*).
+
+These are the explicit-mode building blocks used *inside shard_map* where
+the 'mp' mesh axis is in scope. Each op pairs a forward collective with the
+matching backward collective via jax.custom_vjp — the same fwd/bwd pairing
+the reference encodes in its c_* op grad registrations:
+
+  identity fwd / all_reduce bwd   (input to column-parallel)
+  all_reduce fwd / identity bwd   (output of row-parallel)
+  split fwd / all_gather bwd
+  all_gather fwd / split bwd
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["c_identity", "mp_allreduce", "c_split", "c_concat",
+           "explicit_mode", "in_explicit_mode", "explicit_axis"]
+
+import contextlib
+import threading
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.axis = None
+
+
+_mode = _Mode()
+
+
+@contextlib.contextmanager
+def explicit_mode(axis: str = "mp"):
+    """Inside this scope, TP layers use explicit collectives over `axis`
+    (for shard_map-traced programs) instead of GSPMD annotations."""
+    prev = _mode.axis
+    _mode.axis = axis
+    try:
+        yield
+    finally:
+        _mode.axis = prev
+
+
+def in_explicit_mode() -> bool:
+    return _mode.axis is not None
+
+
+def explicit_axis() -> Optional[str]:
+    return _mode.axis
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def c_identity(x, axis: str):
+    """Identity forward; all-reduce backward (column-parallel input)."""
+    return x
+
+
+def _c_identity_fwd(x, axis):
+    return x, None
+
+
+def _c_identity_bwd(axis, res, g):
+    return (lax.psum(g, axis),)
+
+
+c_identity.defvjp(_c_identity_fwd, _c_identity_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_allreduce(x, axis: str):
+    """All-reduce forward; identity backward (row-parallel output)."""
+    return lax.psum(x, axis)
+
+
+def _mp_allreduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _mp_allreduce_bwd(axis, res, g):
+    return (g,)
+
+
+mp_allreduce.defvjp(_mp_allreduce_fwd, _mp_allreduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def c_split(x, axis: str, dim: int = -1):
+    """Take this rank's slice along `dim`; backward all-gathers."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    d = dim if dim >= 0 else x.ndim + dim
+    size = x.shape[d] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=d)
+
+
+def _c_split_fwd(x, axis, dim):
+    return c_split(x, axis, dim), None
+
+
+def _c_split_bwd(axis, dim, res, g):
+    return (_all_gather_concat(g, axis, dim),)
+
+
+c_split.defvjp(_c_split_fwd, _c_split_bwd)
+
+
+def _all_gather_concat(x, axis: str, dim: int):
+    d = dim if dim >= 0 else x.ndim + dim
+    return lax.all_gather(x, axis, axis=d, tiled=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def c_concat(x, axis: str, dim: int = -1):
+    """All-gather-concat along `dim`; backward takes this rank's slice."""
+    return _all_gather_concat(x, axis, dim)
+
+
+def _c_concat_fwd(x, axis, dim):
+    return _all_gather_concat(x, axis, dim), None
+
+
+def _c_concat_bwd(axis, dim, res, g):
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    d = dim if dim >= 0 else g.ndim + dim
+    size = g.shape[d] // n
+    return (lax.dynamic_slice_in_dim(g, idx * size, size, axis=d),)
+
+
+c_concat.defvjp(_c_concat_fwd, _c_concat_bwd)

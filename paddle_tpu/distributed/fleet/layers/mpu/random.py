@@ -1,0 +1,8 @@
+"""TP-aware RNG (reference: fleet/layers/mpu/random.py) — re-exported from
+the core threefry-based tracker (paddle_tpu/random.py)."""
+
+from .....random import (MODEL_PARALLEL_RNG, RNGStatesTracker,  # noqa: F401
+                         get_rng_state_tracker, model_parallel_random_seed)
+
+__all__ = ["MODEL_PARALLEL_RNG", "RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]

@@ -1,0 +1,65 @@
+"""Activation recomputation (reference:
+python/paddle/distributed/fleet/recompute/recompute.py — RecomputeFunction
+PyLayer :124 with RNG-state preservation + re-forward in backward;
+recompute_sequential :602).
+
+TPU design: jax.checkpoint (remat) IS the recompute engine — it replays the
+forward under the same traced RNG keys automatically (no CUDA RNG state
+capture needed: threefry keys are values, not state), and XLA schedules the
+recomputed segment inside the backward pass. `use_reentrant`/offload knobs
+collapse into jax.checkpoint policies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, policy=None, prevent_cse: bool = True,
+              **kwargs):
+    """Run `function(*args)` with rematerialization in the backward.
+
+    Matches the reference call form recompute(fn, *args). The checkpointing
+    applies to this call's trace, so use inside a jitted/grad-traced region.
+    `policy` may be a jax.checkpoint_policies policy for selective remat
+    (e.g. dots_saveable to keep matmul outputs — the knob the reference
+    exposes as sr/offload variants).
+    """
+    del preserve_rng_state, use_reentrant
+    fn = jax.checkpoint(function, policy=policy, prevent_cse=prevent_cse)
+    return fn(*args, **kwargs)
+
+
+def recompute_sequential(ctx: Optional[dict], functions, *args, **kwargs):
+    """Recompute a Sequential in segments (reference: recompute.py:602).
+
+    ctx: {"segments": n} or None. Each segment of sublayers becomes one
+    checkpointed region.
+    """
+    segments = (ctx or {}).get("segments", 1)
+    from ....nn.layer.container import Sequential
+    if isinstance(functions, Sequential):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // segments)
+    out = args
+    for start in range(0, n, seg_size):
+        seg = layers[start:start + seg_size]
+
+        def run_segment(*inputs, _seg=seg):
+            x = inputs
+            for l in _seg:
+                x = l(*x) if isinstance(x, tuple) else l(x)
+                x = x if isinstance(x, tuple) else (x,)
+            return x[0] if len(x) == 1 else x
+
+        res = recompute(run_segment, *out, **kwargs)
+        out = res if isinstance(res, tuple) else (res,)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,3 @@
+from . import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["sequence_parallel_utils"]

@@ -1,0 +1,158 @@
+"""Megatron-style sequence parallelism (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers :85-137,
+ColumnSequenceParallelLinear/RowSequenceParallelLinear :427+).
+
+Activations between TP blocks are sharded on the SEQUENCE dim over the 'mp'
+axis; entering a TP block all-gathers the sequence, leaving it
+reduce-scatters — replacing the identity/allreduce pair of plain TP with an
+allgather/reduce-scatter pair of equal bandwidth but 1/mp activation memory.
+
+Explicit-mode (shard_map) ops, paired fwd/bwd via custom_vjp exactly as the
+reference's PyLayers; sequence dim is axis 0 ([s, b, h] layout) to match the
+reference's convention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....nn.layer.layers import Layer
+from ....nn.initializer import XavierNormal
+from ..layers.mpu import mp_ops
+
+__all__ = ["scatter", "all_gather", "reduce_scatter", "mark_as_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "GatherOp", "ScatterOp", "AllGatherOp", "ReduceScatterOp"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter(x, axis: str = "mp"):
+    """Split seq dim across mp ranks; bwd all-gathers (ScatterOp :85)."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    size = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=0)
+
+
+def _scatter_fwd(x, axis):
+    return scatter(x, axis), None
+
+
+def _scatter_bwd(axis, res, g):
+    return (lax.all_gather(g, axis, axis=0, tiled=True),)
+
+
+scatter.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def all_gather(x, axis: str = "mp"):
+    """Gather seq dim; bwd reduce-scatters (AllGatherOp :118)."""
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def _all_gather_fwd(x, axis):
+    return all_gather(x, axis), None
+
+
+def _all_gather_bwd(axis, res, g):
+    return (lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True),)
+
+
+all_gather.defvjp(_all_gather_fwd, _all_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter(x, axis: str = "mp"):
+    """Sum + split seq dim; bwd all-gathers (ReduceScatterOp :137)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+def _reduce_scatter_fwd(x, axis):
+    return reduce_scatter(x, axis), None
+
+
+def _reduce_scatter_bwd(axis, res, g):
+    return (lax.all_gather(g, axis, axis=0, tiled=True),)
+
+
+reduce_scatter.defvjp(_reduce_scatter_fwd, _reduce_scatter_bwd)
+
+# Reference PyLayer-name aliases
+ScatterOp = scatter
+GatherOp = all_gather
+AllGatherOp = all_gather
+ReduceScatterOp = reduce_scatter
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Tag a parameter (e.g. LayerNorm weight) as replicated-but-SP so its
+    grads get allreduced over mp (reference:
+    register_sequence_parallel_allreduce_hooks :192). Under GSPMD the psum
+    is automatic; the tag is kept for explicit-mode engines."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """all_gather(seq) -> local column matmul (reference :427)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        from ..layers.mpu.mp_layers import _mp_info, _annotate
+        from jax.sharding import PartitionSpec as P
+        self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
+        assert out_features % self.world_size == 0
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr,
+                                            default_initializer=XavierNormal())
+        self.weight.placements = None
+        _annotate(self.weight, self.mesh, P(None, "mp"))
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            _annotate(self.bias, self.mesh, P("mp"))
+
+    def forward(self, x):
+        axis = mp_ops.explicit_axis() or "mp"
+        if mp_ops.in_explicit_mode() and self.world_size > 1:
+            x = all_gather(x, axis)
+        y = jnp.matmul(x, jnp.asarray(self.weight))
+        if self.bias is not None:
+            y = y + jnp.asarray(self.bias)
+        return y
+
+
+class RowSequenceParallelLinear(Layer):
+    """local row matmul -> reduce_scatter(seq) (reference :427+)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None, name=None):
+        super().__init__()
+        from ..layers.mpu.mp_layers import _mp_info, _annotate
+        from jax.sharding import PartitionSpec as P
+        self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
+        assert in_features % self.world_size == 0
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr,
+                                            default_initializer=XavierNormal())
+        _annotate(self.weight, self.mesh, P("mp", None))
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            _annotate(self.bias, self.mesh, P())
+
+    def forward(self, x):
+        axis = mp_ops.explicit_axis() or "mp"
+        y = jnp.matmul(x, jnp.asarray(self.weight))
+        if mp_ops.in_explicit_mode() and self.world_size > 1:
+            y = reduce_scatter(y, axis)
+        if self.bias is not None:
+            y = y + jnp.asarray(self.bias)
+        return y

@@ -1,0 +1,90 @@
+"""Data parallelism (reference: python/paddle/distributed/parallel.py —
+DataParallel :219 with EagerReducer fused-bucket allreduce on backward hooks,
+reducer.cc).
+
+TPU design: DP is a *sharding*, not a wrapper protocol. Batch dim sharded
+over the 'dp' mesh axis + replicated params means XLA emits exactly one
+fused gradient all-reduce per step — the compiler does the bucketing,
+ordering and comm/compute overlap that EagerReducer (reducer.cc concat/split
+fusing) does by hand. DataParallel therefore only:
+  * records the mesh/axis,
+  * shards input batches (`shard_batch`),
+  * keeps the reference API (no_sync, state_dict passthrough) alive.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer.layers import Layer
+from .topology import get_hybrid_communicate_group
+
+__all__ = ["DataParallel", "shard_batch"]
+
+
+def shard_batch(batch, mesh: Optional[Mesh] = None, axis: str = "dp"):
+    """Place a host batch so dim 0 is sharded over the dp axis."""
+    if mesh is None:
+        hcg = get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg is not None else None
+    if mesh is None or axis not in mesh.axis_names:
+        return jnp.asarray(batch)
+    return jax.device_put(jnp.asarray(batch), NamedSharding(mesh, P(axis)))
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        # comm_buffer_size etc. are bucketing knobs for the hand-rolled
+        # reducer; XLA's gradient all-reduce fusion makes them no-ops here.
+        del strategy, comm_buffer_size, last_comm_buffer_size
+        del find_unused_parameters
+        self._layers = layers
+        self.group = group
+        hcg = get_hybrid_communicate_group()
+        self.mesh = (group.mesh if group is not None and group.mesh is not None
+                     else (hcg.mesh if hcg is not None else None))
+        self.axis = (group.axis_name if group is not None and group.axis_name
+                     else "dp")
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            shard_batch(x, self.mesh, self.axis)
+            if isinstance(x, (jnp.ndarray, np.ndarray, jax.Array)) and getattr(x, "ndim", 0) > 0
+            else x
+            for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Gradient-sync-free scope (reference :219 no_sync). With sharded-
+        batch DP the sync happens inside the jitted step, so accumulation
+        without sync is expressed by accumulating grads across microbatches
+        in the step function; this context is a compat no-op."""
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return Layer.__getattr__(self, name)
+        except AttributeError:
+            return getattr(Layer.__getattr__(self, "_layers"), name)

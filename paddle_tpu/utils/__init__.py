@@ -1,0 +1,3 @@
+from .compat import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

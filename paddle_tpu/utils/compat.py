@@ -1,0 +1,30 @@
+"""Version-compat shims."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check=False, **kwargs):
+    """jax.shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma. We default it OFF because explicit-mode
+    collectives legitimately mix replicated and varying values."""
+    if f is None:
+        return functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check=check, **kwargs)
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    for kw in ("check_vma", "check_rep"):
+        try:
+            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       **{kw: check}, **kwargs)
+        except TypeError as e:
+            if kw not in str(e):
+                raise
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -1,0 +1,190 @@
+"""TP layer parity tests on the 8-device CPU mesh (reference pattern:
+test/collective/fleet/hybrid_parallel_mp_layers.py — compare parallel layers
+against dense single-device equivalents with identical weights)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from paddle_tpu.utils import shard_map
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.layers.mpu import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, mp_ops)
+from paddle_tpu.distributed.topology import (CommunicateTopology,
+                                             HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+
+
+@pytest.fixture
+def hcg4():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [2, 1, 1, 1, 4])
+    hcg = HybridCommunicateGroup(topo, global_rank=0)
+    set_hybrid_communicate_group(hcg)
+    yield hcg
+    set_hybrid_communicate_group(None)
+
+
+def test_topology_rank_mapping():
+    topo = CommunicateTopology(["data", "pipe", "model"], [2, 2, 2])
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=0, pipe=0, model=1) == 1
+    assert topo.get_rank(data=1, pipe=0, model=0) == 4
+    assert topo.get_coord(5) == (1, 0, 1)
+    mp_groups = topo.get_comm_list("model")
+    assert [0, 1] in mp_groups and [6, 7] in mp_groups
+    assert topo.get_axis_list("model", 0) == [0, 2, 4, 6]
+
+
+def test_hcg_mesh_shape(hcg4):
+    assert dict(zip(hcg4.mesh.axis_names, hcg4.mesh.devices.shape)) == {
+        "dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 4}
+    assert hcg4.get_model_parallel_world_size() == 4
+    assert hcg4.get_data_parallel_world_size() == 2
+
+
+def test_column_row_parallel_auto_mode(hcg4):
+    """GSPMD path: layers under jit with sharded weights match dense math."""
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    row = RowParallelLinear(32, 16, input_is_parallel=True)
+    x = np.random.randn(4, 16).astype(np.float32)
+
+    @jax.jit
+    def fwd(x):
+        return row(col(x))
+
+    out = np.asarray(fwd(jnp.asarray(x)))
+    ref = (x @ np.asarray(col.weight.value) + np.asarray(col.bias.value)) \
+        @ np.asarray(row.weight.value) + np.asarray(row.bias.value)
+    assert np.allclose(out, ref, atol=1e-4)
+    # weight shards actually live distributed over mp
+    assert col.weight.value.sharding.spec == P(None, "mp")
+
+
+def test_column_row_parallel_explicit_mode(hcg4):
+    """shard_map path with explicit collectives matches dense math."""
+    mesh = hcg4.mesh
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    row = RowParallelLinear(32, 16, input_is_parallel=True)
+    x = np.random.randn(4, 16).astype(np.float32)
+
+    wc, bc = col.weight.value, col.bias.value
+    wr, br = row.weight.value, row.bias.value
+
+    def local_fwd(x, wc, bc, wr, br):
+        with mp_ops.explicit_mode("mp"):
+            col.weight.value, col.bias.value = wc, bc
+            row.weight.value, row.bias.value = wr, br
+            return row(col(x))
+
+    fwd = shard_map(
+        local_fwd, mesh=mesh,
+        in_specs=(P(), P(None, "mp"), P("mp"), P("mp", None), P()),
+        out_specs=P())
+    out = np.asarray(jax.jit(fwd)(jnp.asarray(x), wc, bc, wr, br))
+    ref = (x @ np.asarray(wc) + np.asarray(bc)) @ np.asarray(wr) + np.asarray(br)
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_explicit_mode_gradients(hcg4):
+    """Backward collectives (c_identity/mp_allreduce custom vjp) give the
+    same grads as the dense reference."""
+    mesh = hcg4.mesh
+    x = np.random.randn(4, 8).astype(np.float32)
+    w = np.random.randn(8, 16).astype(np.float32)
+
+    def local_grads(x, w):
+        # grads taken INSIDE the SPMD program (the train-step pattern):
+        # collectives in the custom vjps produce already-correct local grads
+        def loss(x, w):
+            with mp_ops.explicit_mode("mp"):
+                xi = mp_ops.c_identity(x, "mp")
+                y = xi @ w  # w local shard [8, 4]
+                y = mp_ops.c_concat(y, "mp", dim=-1)
+                return jnp.sum(y ** 2)
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    grads_fn = shard_map(local_grads, mesh=mesh,
+                         in_specs=(P(), P(None, "mp")),
+                         out_specs=(P(), P(None, "mp")))
+    gx, gw = jax.jit(grads_fn)(jnp.asarray(x), jnp.asarray(w))
+
+    def dense_loss(x, w):
+        return jnp.sum((x @ w) ** 2)
+
+    rx, rw = jax.grad(dense_loss, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+    assert np.allclose(np.asarray(gx), np.asarray(rx), atol=1e-4)
+    assert np.allclose(np.asarray(gw), np.asarray(rw), atol=1e-4)
+
+
+def test_vocab_parallel_embedding(hcg4):
+    emb = VocabParallelEmbedding(32, 8)
+    ids = np.array([[0, 5, 31], [7, 15, 16]])
+    out = np.asarray(jax.jit(lambda i: emb(i))(jnp.asarray(ids)))
+    ref = np.asarray(emb.weight.value)[ids]
+    assert np.allclose(out, ref, atol=1e-5)
+
+    # explicit mode inside shard_map
+    mesh = hcg4.mesh
+    w = emb.weight.value
+
+    def local(ids, w):
+        with mp_ops.explicit_mode("mp"):
+            emb.weight.value = w
+            return emb(ids)
+
+    out2 = np.asarray(jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(), P("mp")), out_specs=P(),
+        ))(jnp.asarray(ids), w))
+    assert np.allclose(out2, ref, atol=1e-5)
+
+
+def test_parallel_cross_entropy(hcg4):
+    mesh = hcg4.mesh
+    logits = np.random.randn(6, 32).astype(np.float32)
+    labels = np.random.randint(0, 32, (6,))
+    pce = ParallelCrossEntropy()
+
+    def local(logits, labels):
+        with mp_ops.explicit_mode("mp"):
+            return pce(logits, labels)
+
+    out = np.asarray(jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(None, "mp"), P()), out_specs=P(),
+        ))(jnp.asarray(logits), jnp.asarray(labels)))
+    ref = np.asarray(nn.functional.cross_entropy(
+        jnp.asarray(logits), jnp.asarray(labels), reduction="none"))
+    assert np.allclose(out.squeeze(-1), ref, atol=1e-4)
+
+
+def test_collective_eager_wrappers():
+    from paddle_tpu.distributed import collective as C
+    # rank-major eager semantics over the default world mesh (8 devs)
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    out = np.asarray(C.all_reduce(x))
+    assert np.allclose(out, np.tile(x.sum(0), (8, 1)))
+    out = np.asarray(C.all_reduce(x, op=C.ReduceOp.MAX))
+    assert np.allclose(out, np.tile(x.max(0), (8, 1)))
+    g = np.asarray(C.all_gather(x))
+    assert g.shape == (8, 8, 3) and np.allclose(g[0], x)
+    b = np.asarray(C.broadcast(x, src=3))
+    assert np.allclose(b, np.tile(x[3], (8, 1)))
+    # reduce_scatter: each rank holds a length-8 vector; rank i gets the sum
+    # of element i across ranks
+    v = np.random.randn(8, 8).astype(np.float32)
+    rs = np.asarray(C.reduce_scatter(v))
+    assert rs.shape == (8, 1)
+    assert np.allclose(rs[:, 0], v.sum(0), atol=1e-5)
+
+
+def test_all_to_all_eager():
+    from paddle_tpu.distributed import collective as C
+    x = np.arange(8 * 8, dtype=np.float32).reshape(8, 8)  # [rank, 8]
+    out = np.asarray(C.all_to_all(x[:, :, None]))
+    assert out.shape == (8, 8, 1)
+    # all_to_all transposes the rank/chunk grid
+    assert np.allclose(out[:, :, 0], x.T)
