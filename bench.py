@@ -1,0 +1,78 @@
+"""Benchmark: GPT pretraining throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec/chip for a GPT-small-class model (bf16, full train step:
+fwd + bwd + AdamW). vs_baseline = achieved_MFU / 0.45 (the north-star MFU
+target from BASELINE.json; the reference publishes no absolute numbers).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as G
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    if on_tpu:
+        cfg = G.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=16,
+                          num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16)
+        batch, seq, iters = 8, 1024, 20
+    else:  # CPU smoke fallback
+        cfg = G.GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=4, max_seq_len=128, dtype=jnp.float32)
+        batch, seq, iters = 2, 128, 3
+
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4)
+    state = jax.jit(opt.init_state)(params)
+
+    @jax.jit
+    def step(params, state, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: G.dense_loss(p, tokens, labels, cfg))(params)
+        params, state = opt.apply(params, grads, state, 1e-4)
+        return params, state, loss
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup/compile (fetch a concrete value — block_until_ready alone can
+    # return early through remote-execution tunnels)
+    params, state, loss = step(params, state, tokens, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, loss = step(params, state, tokens, labels)
+    jax.block_until_ready(params)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+
+    # params count (excluding embeddings for flops-per-token ~ 6N rule)
+    n_params = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(params))
+    n_emb = int(np.prod(params["wte"].shape)) + int(np.prod(params["wpe"].shape))
+    flops_per_token = 6 * (n_params - n_emb) + 12 * cfg.num_layers * cfg.hidden_size * seq
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = achieved_flops / peak
+
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -221,11 +221,14 @@ def scatter(tensor, tensor_list=None, src: int = 0,
         src_val = broadcast(tensor, src=src, group=group, axis=ax)
         return lax.dynamic_index_in_dim(src_val, idx, axis=0, keepdims=False)
 
+    src_in_group = (group.get_group_rank(src)
+                    if group is not None and src in group.ranks else src)
+
     def f(local):
         local = local.reshape(local.shape[1:])  # [n, *S] view on each rank
         ax2 = default_axis(group)
         idx = lax.axis_index(ax2)
-        sv = jnp.where(idx == src, local, jnp.zeros_like(local))
+        sv = jnp.where(idx == src_in_group, local, jnp.zeros_like(local))
         sv = lax.psum(sv, ax2)  # broadcast src's [n, *S]
         return lax.dynamic_index_in_dim(sv, idx, axis=0, keepdims=False)[None]
 

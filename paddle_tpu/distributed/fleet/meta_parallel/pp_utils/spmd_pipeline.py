@@ -1,0 +1,105 @@
+"""SPMD pipeline parallelism (reference:
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
+forward_backward_pipeline :547 1F1B schedule; p2p layer
+pp_utils/p2p_communication.py :570 _p2p_helper).
+
+TPU redesign: the reference runs a host-driven 1F1B loop with explicit NCCL
+send/recv per microbatch. On TPU the whole pipeline is ONE compiled program:
+a lax.scan over time steps where every pp rank computes its stage and
+activations rotate with lax.ppermute over the ICI ring. Differentiating the
+scanned forward yields the reverse pipeline automatically — the backward
+ppermutes are the transposes of the forward ones, so the compiler sees the
+complete 1F1B dataflow and overlaps compute with neighbor transfers.
+
+Layout: every pp rank holds L/P consecutive blocks, parameters stacked on a
+leading layer axis sharded over 'pp'. Microbatch m enters stage 0 at t=m,
+reaches stage d at t=m+d; total T = M + P - 1 steps (the pipeline bubble is
+the same (P-1)/(M+P-1) fraction as the reference's 1F1B fill/drain).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["spmd_pipeline", "pipeline_last_stage_value"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _replicate_from_last(x, axis: str):
+    """Broadcast the last pp stage's value to all stages.
+
+    Needs a custom vjp: a plain masked psum would deliver the SUM of the
+    (identical, replicated) downstream cotangents to the last stage —
+    scaling gradients by pp_degree. The correct transpose consumes the
+    cotangent on the last stage only."""
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == P - 1, x, jnp.zeros_like(x)), axis)
+
+
+def _replicate_from_last_fwd(x, axis):
+    return _replicate_from_last(x, axis), None
+
+
+def _replicate_from_last_bwd(axis, res, g):
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    return (jnp.where(idx == P - 1, g, jnp.zeros_like(g)),)
+
+
+_replicate_from_last.defvjp(_replicate_from_last_fwd, _replicate_from_last_bwd)
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
+                  axis: str = "pp", checkpoint_stages: bool = True):
+    """Run a homogeneous-stage pipeline inside shard_map.
+
+    stage_fn(stage_params_local, x) -> y with y.shape == x.shape
+        (the per-rank segment: typically a lax.scan over L/P stacked blocks).
+    stage_params: this rank's local (already sharded-in) parameter pytree.
+    x_microbatches: [M, mb, ...] — microbatch inputs, replicated over `axis`
+        (only stage 0 consumes them).
+
+    Returns [M, mb, ...] — outputs of the LAST stage, valid on every rank
+    (zeros elsewhere are summed into place with one psum at the end).
+    """
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    M = x_microbatches.shape[0]
+    T = M + P - 1
+
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    def step(carry, t):
+        state, outputs = carry
+        # rotate activations one stage down the ring (stage d-1 -> d)
+        prev = lax.ppermute(state, axis, [(i, i + 1) for i in range(P - 1)])
+        inj = jnp.take(x_microbatches, jnp.clip(t, 0, M - 1), axis=0)
+        inj = jnp.where(t < M, inj, jnp.zeros_like(inj))
+        inp = jnp.where(idx == 0, inj, prev)
+        out = fn(stage_params, inp)
+        # last stage emits microbatch m = t - (P-1)
+        m = t - (P - 1)
+        mc = jnp.clip(m, 0, M - 1)
+        write = (m >= 0) & (idx == P - 1)
+        cur = lax.dynamic_index_in_dim(outputs, mc, axis=0, keepdims=False)
+        val = jnp.where(write, out, cur)
+        outputs = lax.dynamic_update_index_in_dim(outputs, val, mc, axis=0)
+        return (out, outputs), None
+
+    out0 = jnp.zeros_like(x_microbatches)
+    state0 = jnp.zeros_like(x_microbatches[0])
+    (_, outputs), _ = lax.scan(step, (state0, out0), jnp.arange(T))
+    # replicate last-stage outputs to every rank (loss is computed SPMD)
+    return _replicate_from_last(outputs, axis)
+
+
+def pipeline_last_stage_value(value, axis: str = "pp"):
+    """Broadcast a value computed on the last pp stage to all stages
+    (reference: pipeline_parallel.py:1024 _broadcast_final_loss)."""
+    return _replicate_from_last(value, axis)

@@ -204,6 +204,7 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
         loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        was_training = self.network.training
         self.network.eval()
         if self._params is None:
             self._sync_from_network()
@@ -228,12 +229,14 @@ class Model:
         for m in self._metrics:
             nm = m.name() if isinstance(m.name(), str) else m.name()[0]
             logs[nm] = m.accumulate()
-        self.network.train()
+        if was_training:
+            self.network.train()
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 verbose=1, callbacks=None):
         loader = self._to_loader(test_data, batch_size, False, num_workers)
+        was_training = self.network.training
         self.network.eval()
         if self._params is None:
             self._sync_from_network()
@@ -246,7 +249,8 @@ class Model:
                 self._params, self._frozen, self._buffers,
                 tuple(jnp.asarray(x) for x in inputs), ())
             outs.append(tuple(np.asarray(o) for o in outputs))
-        self.network.train()
+        if was_training:
+            self.network.train()
         if stack_outputs:
             n_out = len(outs[0])
             return [np.concatenate([o[i] for o in outs]) for i in range(n_out)]
@@ -274,12 +278,14 @@ class Model:
             self._eval_step_fn = self._build_eval_step()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        was_training = self.network.training
         self.network.eval()
         outputs, loss = self._eval_step_fn(
             self._params, self._frozen, self._buffers,
             tuple(jnp.asarray(x) for x in inputs),
             tuple(jnp.asarray(y) for y in labels))
-        self.network.train()
+        if was_training:
+            self.network.train()
         return float(loss) if loss is not None else [np.asarray(o) for o in outputs]
 
     def predict_batch(self, inputs):

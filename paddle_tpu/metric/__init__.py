@@ -132,14 +132,12 @@ class Auc(Metric):
         preds = np.asarray(preds)
         if preds.ndim == 2:
             preds = preds[:, -1]
-        labels = np.asarray(labels).reshape(-1)
+        labels = np.asarray(labels).reshape(-1).astype(bool)
         idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
                       self.num_thresholds)
-        for i, l in zip(idx, labels):
-            if l:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        nbins = self.num_thresholds + 1
+        self._stat_pos += np.bincount(idx[labels], minlength=nbins)
+        self._stat_neg += np.bincount(idx[~labels], minlength=nbins)
 
     def accumulate(self):
         tot_pos = tot_neg = auc = 0.0

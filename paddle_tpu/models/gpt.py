@@ -1,0 +1,414 @@
+"""GPT model family (reference: the GPT/GPT-3 configs exercised by Fleet
+hybrid-parallel — model definition test/legacy_test/auto_parallel_gpt_model.py,
+used via test/auto_parallel/get_gpt_model.py:18; BASELINE configs 2/4).
+
+Two executions of the same architecture:
+
+* ``GPT`` — eager nn.Layer for single-device / GSPMD-auto use (Model.fit,
+  generation). Attention rides the op-registry scaled_dot_product_attention
+  (Pallas flash kernel on TPU).
+
+* ``hybrid`` engine — functional stacked-parameter form for the explicit
+  SPMD path: vocab-parallel embedding + Megatron TP inside each block (over
+  'mp'), scan+ppermute pipeline over 'pp' (spmd_pipeline), dp gradient
+  pmean, all inside ONE shard_map/jit program. This is the TPU-native
+  equivalent of the reference's PipelineParallel+TensorParallel meta_parallel
+  stack (fleet/meta_parallel/pipeline_parallel.py:547,
+  fleet/layers/mpu/mp_layers.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import spmd_pipeline
+
+__all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
+           "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
+           "build_hybrid_train_step"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: Optional[int] = None
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16  # MXU-native compute dtype
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                     num_heads=4, max_seq_len=256, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+def gpt_6p7b(**kw):
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_seq_len=2048, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Eager nn.Layer form
+# ---------------------------------------------------------------------------
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        H = cfg.hidden_size
+        self.cfg = cfg
+        self.ln1 = nn.LayerNorm(H)
+        self.qkv = nn.Linear(H, 3 * H, bias_attr=cfg.use_bias)
+        self.proj = nn.Linear(H, H, bias_attr=cfg.use_bias)
+        self.ln2 = nn.LayerNorm(H)
+        self.fc1 = nn.Linear(H, cfg.ffn_hidden, bias_attr=cfg.use_bias)
+        self.fc2 = nn.Linear(cfg.ffn_hidden, H, bias_attr=cfg.use_bias)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, S, H = x.shape
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              dropout_p=cfg.dropout,
+                                              training=self.training)
+        attn = self.proj(attn.reshape(B, S, H))
+        x = x + self.drop(attn)
+        h = self.ln2(x)
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(h), approximate=True)))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..nn.initializer import Normal
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, tokens):
+        B, S = tokens.shape
+        pos = jnp.arange(S)[None, :]
+        x = self.wte(tokens) + self.wpe(pos)
+        x = self.drop(x).astype(self.cfg.dtype)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        return self.lm_head(x.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Hybrid (explicit SPMD) form: stacked params + shard_map engine
+# ---------------------------------------------------------------------------
+def init_hybrid_params(cfg: GPTConfig, key) -> Dict[str, Any]:
+    """Stacked-parameter pytree. Blocks are stacked on a leading [L] axis so
+    the pipeline can shard them over 'pp' and scan within a stage."""
+    H, L, FF, V = cfg.hidden_size, cfg.num_layers, cfg.ffn_hidden, cfg.vocab_size
+    k = jax.random.split(key, 12)
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def nrm(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(pd)
+
+    params = {
+        "wte": nrm(k[0], (V, H)),
+        "wpe": nrm(k[1], (cfg.max_seq_len, H)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, H), pd),
+            "ln1_b": jnp.zeros((L, H), pd),
+            "qkv_w": nrm(k[2], (L, H, 3 * H)),
+            "qkv_b": jnp.zeros((L, 3 * H), pd),
+            "proj_w": nrm(k[3], (L, H, H), std / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((L, H), pd),
+            "ln2_g": jnp.ones((L, H), pd),
+            "ln2_b": jnp.zeros((L, H), pd),
+            "fc1_w": nrm(k[4], (L, H, FF)),
+            "fc1_b": jnp.zeros((L, FF), pd),
+            "fc2_w": nrm(k[5], (L, FF, H), std / math.sqrt(2 * L)),
+            "fc2_b": jnp.zeros((L, H), pd),
+        },
+        "lnf_g": jnp.ones((H,), pd),
+        "lnf_b": jnp.zeros((H,), pd),
+        "head_w": nrm(k[6], (H, V)),
+    }
+    return params
+
+
+def hybrid_param_specs(cfg: GPTConfig) -> Dict[str, Any]:
+    """PartitionSpecs: blocks stacked-L over 'pp'; Megatron shardings over
+    'mp'; vocab-parallel embedding + head over 'mp'."""
+    return {
+        "wte": P("mp", None),
+        "wpe": P(),
+        "blocks": {
+            "ln1_g": P("pp"), "ln1_b": P("pp"),
+            "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
+            "proj_w": P("pp", "mp", None), "proj_b": P("pp"),
+            "ln2_g": P("pp"), "ln2_b": P("pp"),
+            "fc1_w": P("pp", None, "mp"), "fc1_b": P("pp", "mp"),
+            "fc2_w": P("pp", "mp", None), "fc2_b": P("pp"),
+        },
+        "lnf_g": P(), "lnf_b": P(),
+        "head_w": P(None, "mp"),
+    }
+
+
+def _ln(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def _attention(q, k, v):
+    """Causal attention on local heads. [B, S, h_local, D]."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    S = logits.shape[-1]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp"):
+    """One transformer block, explicit Megatron TP (runs inside shard_map;
+    degenerates correctly at mp degree 1).
+
+    QKV channel layout is HEAD-MAJOR: [H, heads * 3 * head_dim], so a
+    contiguous column shard over 'mp' holds COMPLETE heads (each with its
+    q, k and v) — a [H, 3H] q|k|v-major packing would split heads across
+    ranks and silently corrupt attention under TP."""
+    mp = lax.axis_size(mp_axis)
+    heads_local = cfg.num_heads // mp
+    B, S, H = x.shape
+    from ..distributed.fleet.layers.mpu import mp_ops
+
+    h = _ln(x, p["ln1_g"], p["ln1_b"])
+    hi = mp_ops.c_identity(h, mp_axis)
+    qkv = (hi.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+           + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
+    qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
+    attn = _attention(qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+    attn = attn.reshape(B, S, H // mp)
+    out = attn @ p["proj_w"].astype(cfg.dtype)  # row-parallel: [B, S, H]
+    out = mp_ops.mp_allreduce(out, mp_axis) + p["proj_b"].astype(cfg.dtype)
+    x = x + out
+
+    h = _ln(x, p["ln2_g"], p["ln2_b"])
+    hi = mp_ops.c_identity(h, mp_axis)
+    m = (hi.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+         + p["fc1_b"].astype(cfg.dtype))
+    m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
+    m = m @ p["fc2_w"].astype(cfg.dtype)
+    m = mp_ops.mp_allreduce(m, mp_axis) + p["fc2_b"].astype(cfg.dtype)
+    return x + m
+
+
+def _vocab_parallel_embed(wte_local, tokens, mp_axis: str = "mp"):
+    from ..distributed.fleet.layers.mpu import mp_ops
+    idx = lax.axis_index(mp_axis)
+    per = wte_local.shape[0]
+    local = tokens - idx * per
+    ok = (local >= 0) & (local < per)
+    safe = jnp.where(ok, local, 0)
+    out = jnp.take(wte_local, safe, axis=0)
+    out = jnp.where(ok[..., None], out, 0.0)
+    # psum fwd / identity bwd: downstream is replicated across mp, so a raw
+    # psum would deliver mp-times the cotangent to the local shard
+    return mp_ops.mp_allreduce(out, mp_axis)
+
+
+def _vocab_parallel_ce(logits_local, labels, mp_axis: str = "mp",
+                       ignore_index: int = -100):
+    """Stable vocab-sharded softmax CE; returns per-token loss."""
+    mp_idx = lax.axis_index(mp_axis)
+    per = logits_local.shape[-1]
+    lf = logits_local.astype(jnp.float32)
+    # max-shift is for stability only; its gradient cancels, and pmax has no
+    # differentiation rule — stop_gradient is exact here
+    from ..distributed.fleet.layers.mpu import mp_ops
+    lmax = lax.pmax(lax.stop_gradient(jnp.max(lf, -1, keepdims=True)),
+                    mp_axis)
+    shifted = lf - lmax
+    # mp_allreduce (identity bwd) — see _vocab_parallel_embed
+    lse = jnp.log(mp_ops.mp_allreduce(
+        jnp.sum(jnp.exp(shifted), -1, keepdims=True), mp_axis)) + lmax
+    local_label = labels - mp_idx * per
+    ok = (local_label >= 0) & (local_label < per)
+    safe = jnp.where(ok, local_label, 0)
+    picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)
+    picked = mp_ops.mp_allreduce(jnp.where(ok[..., None], picked, 0.0), mp_axis)
+    loss = (lse - picked)[..., 0]
+    valid = labels != ignore_index
+    return jnp.where(valid, loss, 0.0), valid
+
+
+def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
+    """Single-device forward over the stacked-parameter pytree (no
+    collectives). Same math/layout as the hybrid engine — head-major QKV.
+    remat=True checkpoints each block (recompute in backward) — the memory/
+    FLOPs trade that keeps long-sequence training inside HBM."""
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][None, :tokens.shape[1]]
+    x = x.astype(cfg.dtype)
+
+    def block(p, x):
+        B, S, H = x.shape
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+               + p["qkv_b"].astype(cfg.dtype))
+        qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
+        attn = _attention(qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+        out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
+        x = x + out + p["proj_b"].astype(cfg.dtype)
+        h = _ln(x, p["ln2_g"], p["ln2_b"])
+        m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+             + p["fc1_b"].astype(cfg.dtype))
+        m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
+        return x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+
+    blk = jax.checkpoint(block) if remat else block
+
+    def body(carry, p):
+        return blk(p, carry), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+
+
+def dense_loss(params, tokens, labels, cfg: GPTConfig):
+    logits = dense_forward(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
+                   num_microbatches: int, dp_axis="dp", pp_axis="pp",
+                   mp_axis="mp"):
+    """Per-device loss of the full hybrid GPT (runs inside shard_map).
+
+    tokens/labels: this dp shard's batch [b_local, S].
+    """
+    b_local, S = tokens.shape
+    M = num_microbatches
+    assert b_local % M == 0, (b_local, M)
+    x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
+    x = x + params["wpe"][None, :S]
+    x = x.astype(cfg.dtype)
+    x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
+
+    def stage_fn(block_params, h):
+        def body(carry, p):
+            return _block_fn(p, carry, cfg, mp_axis), None
+        out, _ = lax.scan(body, h, block_params)
+        return out
+
+    out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+    out = out.reshape(b_local, S, cfg.hidden_size)
+    out = _ln(out, params["lnf_g"], params["lnf_b"])
+    from ..distributed.fleet.layers.mpu import mp_ops
+    # column-parallel head: identity fwd / allreduce bwd on its input
+    out = mp_ops.c_identity(out, mp_axis)
+    logits_local = out.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+    loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
+    total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    return lax.pmean(total, dp_axis)
+
+
+def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
+                            num_microbatches: int = 1, dp_axis="dp",
+                            pp_axis="pp", mp_axis="mp", extra_grad_axes=()):
+    """Compile the full hybrid train step: one program containing embedding,
+    pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
+    optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
+    """
+    from ..utils import shard_map as _shard_map
+
+    specs = hybrid_param_specs(cfg)
+    state_slot_specs = jax.tree.map(lambda s: s, specs)  # same layout per slot
+
+    def shard_params(params):
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            params, specs)
+
+    def init_state(params):
+        # zeros_like under jit preserves input shardings
+        return jax.jit(optimizer.init_state)(params)
+
+    data_spec = P(dp_axis)
+
+    def local_step(params, opt_state, tokens, labels, lr):
+        def loss_fn(p):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # dp gradient reduction (the EagerReducer equivalent — one pmean,
+        # fused and overlapped by XLA)
+        reduce_axes = (dp_axis,) + tuple(extra_grad_axes)
+        grads = jax.tree.map(
+            lambda g: lax.pmean(g, reduce_axes), grads)
+        new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
+        return new_params, new_state, loss
+
+    def spec_tree_like(tree, leaf_spec_tree):
+        return jax.tree.map(lambda _: leaf_spec_tree, tree)
+
+    # optimizer state: {"step": P(), "slots": {param-path: {slot: spec}}}
+    def state_specs(params):
+        slots = jax.tree.map(
+            lambda s: s, specs)
+        return {"step": P(),
+                "slots": jax.tree.map(lambda s: {"moment1": s, "moment2": s},
+                                      specs, is_leaf=lambda x: isinstance(x, P))}
+
+    sspec = state_specs(None)
+
+    step = _shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, sspec, data_spec, data_spec, P()),
+        out_specs=(specs, sspec, P()))
+    return jax.jit(step), shard_params, init_state

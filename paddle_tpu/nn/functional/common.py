@@ -95,14 +95,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     pad = list(pad)
     if len(pad) == 2 * x.ndim:
         return T.pad(x, pad, mode=mode, value=value)
-    # paddle semantics: pad applies to spatial dims per data_format
+    # paddle semantics: partial pad list applies LAST-SPATIAL-DIM FIRST
+    # ((pad_left, pad_right) pad W, then (pad_top, pad_bottom) pad H, ...)
     n = len(pad) // 2
     pairs = [(0, 0)] * x.ndim
     if data_format.startswith("NC"):  # NCL/NCHW/NCDHW: spatial dims are 2..
-        spatial = list(range(2, 2 + n))
+        spatial = list(range(2, x.ndim))
     else:  # NLC/NHWC/NDHWC: spatial dims are 1..ndim-1
-        spatial = list(range(1, 1 + n))
-    for i, ax in enumerate(spatial):
+        spatial = list(range(1, x.ndim - 1))
+    for i in range(n):
+        ax = spatial[len(spatial) - 1 - i]
         pairs[ax] = (pad[2 * i], pad[2 * i + 1])
     flat = [v for p in pairs for v in p]
     return T.pad(x, flat, mode=mode, value=value)

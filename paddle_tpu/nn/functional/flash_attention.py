@@ -72,9 +72,14 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     """paddle.nn.functional.flash_attention.flash_attention parity:
     returns (out, softmax) tuple."""
     del fixed_seed_offset, rng_name, name
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax is not supported by the fused attention path "
+            "(the tiled kernel never materializes the softmax matrix); "
+            "compose it manually with softmax(q @ k^T) if needed")
     out = get_op("scaled_dot_product_attention").dispatch(
         query, key, value, None, dropout, causal, training)
-    return out, None if not return_softmax else None
+    return out, None
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
@@ -94,10 +99,13 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         pos_q = jnp.arange(tq) - jnp.take(cu_seqlens_q, seg_q - 1)
         pos_k = jnp.arange(tk) - jnp.take(cu_seqlens_k, seg_k - 1)
         mask = mask & (pos_k[None, :] <= pos_q[:, None])
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax is not supported by flash_attn_unpadded")
     out = _sdpa_reference(query[None], key[None], value[None],
                           attn_mask=mask[None, None], dropout_p=dropout,
                           is_causal=False, scale=scale, training=training)[0]
-    return (out, None) if return_softmax else (out, None)
+    return out, None
 
 
 def flashmask_attention(query, key, value, startend_row_indices=None,

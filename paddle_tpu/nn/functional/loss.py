@@ -43,8 +43,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
 
     n_classes = input.shape[axis]
-    if soft_label or (not jnp.issubdtype(jnp.asarray(label).dtype, jnp.integer)
-                      and jnp.asarray(label).ndim == input.ndim):
+    label_arr = jnp.asarray(label)
+    # hard float labels of shape [..., 1] (paddle's standard label shape)
+    # must NOT be mistaken for soft distributions — require a full class dim
+    looks_soft = (not jnp.issubdtype(label_arr.dtype, jnp.integer)
+                  and label_arr.ndim == input.ndim
+                  and label_arr.shape[axis] == n_classes)
+    if soft_label or looks_soft:
         soft = jnp.asarray(label, dtype=jnp.float32)
         if label_smoothing > 0.0:
             soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
@@ -56,9 +61,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
         return _reduce(loss, reduction)
 
-    label = jnp.asarray(label)
+    label = label_arr
     if label.ndim == input.ndim and label.shape[axis] == 1:
         label = jnp.squeeze(label, axis=axis)
+    if not jnp.issubdtype(label.dtype, jnp.integer):
+        label = label.astype(jnp.int32)
     valid = label != ignore_index
     safe_label = jnp.where(valid, label, 0)
     picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_label, axis), axis=axis)

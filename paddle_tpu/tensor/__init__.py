@@ -964,8 +964,24 @@ def kthvalue(x, k, axis=-1, keepdim=False):
 
 
 def mode(x, axis=-1, keepdim=False):
-    v = jax.scipy.stats.mode(x, axis=axis, keepdims=keepdim)
-    return v.mode, None
+    ax0 = axis if axis >= 0 else x.ndim + axis
+    s = jnp.sort(x, axis=ax0)
+    # count of each sorted element within its row; argmax picks the most
+    # frequent (ties resolve to the smallest value, first in sort order)
+    counts = jnp.sum(jnp.expand_dims(s, ax0) == jnp.expand_dims(s, ax0 + 1),
+                     axis=ax0 + 1)
+    best = jnp.argmax(counts, axis=ax0, keepdims=True)
+    vals = jnp.take_along_axis(s, best, axis=ax0)
+    # index of the last occurrence of the modal value (paddle contract)
+    ax = axis if axis >= 0 else x.ndim + axis
+    matches = x == vals
+    n = x.shape[ax]
+    pos = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(x.ndim)])
+    idx = jnp.max(jnp.where(matches, pos, -1), axis=ax, keepdims=True)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis=ax)
+        idx = jnp.squeeze(idx, axis=ax)
+    return vals, idx.astype(jnp.int64)
 
 
 def nonzero(x, as_tuple=False):
@@ -1127,4 +1143,8 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     return jnp.where(in_shard, x - lo, ignore_value)
 
 
-__all__ += [n for n in dir() if not n.startswith("_") and n not in ("jax", "jnp", "np", "lax", "builtins")]
+_NON_API = {"jax", "jnp", "np", "lax", "builtins", "next_key",
+            "List", "Optional", "Sequence", "Union", "annotations"}
+__all__ += [n for n in dir()
+            if not n.startswith("_") and n not in _NON_API
+            and callable(globals().get(n))]

@@ -1,0 +1,132 @@
+"""SPMD pipeline tests (reference pattern:
+test/collective/fleet/hybrid_parallel_pp_*.py — pipeline output/grad parity
+vs the unpartitioned model)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.utils import shard_map
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
+    pipeline_last_stage_value, spmd_pipeline)
+
+PP = 4          # pipeline stages
+L_PER = 2       # blocks per stage
+M = 8           # microbatches
+MB, H = 2, 16   # microbatch size, hidden
+
+
+def _block(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stage_fn(stage_params, x):
+    # scan over this rank's stacked blocks
+    def body(h, p):
+        return _block(p, h), None
+    h, _ = jax.lax.scan(body, x, stage_params)
+    return h
+
+
+def _dense_forward(params, x):
+    # params stacked [L, ...] — run all blocks sequentially
+    def body(h, p):
+        return _block(p, h), None
+    h, _ = jax.lax.scan(body, x, params)
+    return h
+
+
+@pytest.fixture
+def pipeline_setup():
+    mesh = dist.build_mesh({"pp": PP, "rest": 8 // PP})
+    rng = np.random.RandomState(0)
+    L = PP * L_PER
+    params = {
+        "w": jnp.asarray(rng.randn(L, H, H).astype(np.float32) * 0.3),
+        "b": jnp.asarray(rng.randn(L, H).astype(np.float32) * 0.1),
+    }
+    x = jnp.asarray(rng.randn(M, MB, H).astype(np.float32))
+    return mesh, params, x
+
+
+def test_pipeline_forward_matches_dense(pipeline_setup):
+    mesh, params, x = pipeline_setup
+
+    def run(params, x):
+        # reshape local [L/P, ...] params
+        local = jax.tree.map(lambda a: a, params)
+        return spmd_pipeline(_stage_fn, local, x, axis="pp")
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+                   out_specs=P())
+    out = jax.jit(fn)(params, x)
+    ref = jax.vmap(lambda xi: _dense_forward(params, xi))(x)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+
+def test_pipeline_grads_match_dense(pipeline_setup):
+    mesh, params, x = pipeline_setup
+    y = jnp.asarray(np.random.RandomState(1).randn(M, MB, H).astype(np.float32))
+
+    def pp_loss_grads(params, x, y):
+        def loss(params):
+            out = spmd_pipeline(_stage_fn, params, x, axis="pp")
+            return jnp.mean((out - y) ** 2)
+        l, g = jax.value_and_grad(loss)(params)
+        return l, g
+
+    fn = shard_map(pp_loss_grads, mesh=mesh,
+                   in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
+                   out_specs=(P(), {"w": P("pp"), "b": P("pp")}))
+    l_pp, g_pp = jax.jit(fn)(params, x, y)
+
+    def dense_loss(params):
+        out = jax.vmap(lambda xi: _dense_forward(params, xi))(x)
+        return jnp.mean((out - y) ** 2)
+
+    l_ref, g_ref = jax.value_and_grad(dense_loss)(params)
+    assert abs(float(l_pp) - float(l_ref)) < 1e-6
+    for k in g_ref:
+        assert np.allclose(np.asarray(g_pp[k]), np.asarray(g_ref[k]),
+                           atol=1e-5), k
+
+
+def test_pipeline_with_dp_axis(pipeline_setup):
+    """pp x dp hybrid: batch sharded over dp, blocks over pp."""
+    mesh, params, x = pipeline_setup  # axes pp=4, rest=2 (use as dp)
+
+    def run(params, x):
+        out = spmd_pipeline(_stage_fn, params, x, axis="pp")
+        return jnp.mean(out ** 2)
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=({"w": P("pp"), "b": P("pp")}, P(None, "rest")),
+                   out_specs=P())
+    # mean over dp shards needs a psum — wrap:
+    def run2(params, x):
+        out = spmd_pipeline(_stage_fn, params, x, axis="pp")
+        return jax.lax.pmean(jnp.mean(out ** 2), "rest")
+
+    fn2 = shard_map(run2, mesh=mesh,
+                    in_specs=({"w": P("pp"), "b": P("pp")}, P(None, "rest")),
+                    out_specs=P())
+    out = float(jax.jit(fn2)(params, x))
+    ref = jax.vmap(lambda xi: _dense_forward(params, xi))(x)
+    assert abs(out - float(jnp.mean(ref ** 2))) < 1e-5
+
+
+def test_last_stage_broadcast():
+    mesh = dist.build_mesh({"pp": 8})
+
+    def run():
+        idx = jax.lax.axis_index("pp")
+        val = jnp.where(idx == 7, 42.0, 0.0)
+        return pipeline_last_stage_value(val, "pp")
+
+    out = jax.jit(shard_map(run, mesh=mesh, in_specs=(), out_specs=P()))()
+    assert float(out) == 42.0
