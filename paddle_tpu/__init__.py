@@ -29,6 +29,8 @@ from . import nn  # noqa: F401
 from .nn.layer.layers import Parameter  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import ops  # noqa: F401
+from . import kernels  # noqa: F401  (registers Pallas fast paths)
+from . import incubate  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401

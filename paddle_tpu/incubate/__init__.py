@@ -1,0 +1,3 @@
+"""paddle.incubate equivalent namespace (fused-op API surface)."""
+
+from . import nn  # noqa: F401

@@ -1,0 +1,177 @@
+"""Fused functional ops (paddle.incubate.nn.functional parity).
+
+Reference surface: python/paddle/incubate/nn/functional/ — fused_rms_norm,
+fused_rotary_position_embedding (fused_rope), swiglu, fused_linear,
+fused_bias_act. Each is an op-registry entry whose reference implementation
+is an XLA composition (already fused by the compiler) and whose TPU fast
+path, where it pays off, is a Pallas kernel from paddle_tpu.kernels.pallas.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops import get_op, register_op, register_pallas_impl
+from ....nn.functional.norm import rms_norm as _rms_norm_op
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "swiglu", "fused_linear", "fused_bias_act",
+]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    """Reference: python/paddle/incubate/nn/functional/fused_rms_norm.py.
+    Dispatches to the Pallas rms_norm kernel on TPU."""
+    return _rms_norm_op(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1):
+    from ....nn import functional as F
+    axis = begin_norm_axis % x.ndim
+    shape = x.shape[axis:]
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def _normalize_cos_sin(cos, sin, seq_len, head_dim):
+    """Accept [S, D/2], [S, D] (neox-duplicated halves) or [1, S, 1, D]."""
+    def norm(t):
+        t = jnp.asarray(t)
+        t = t.reshape(t.shape[-2] if t.ndim > 2 else t.shape[0], t.shape[-1])
+        if t.shape[-1] == head_dim:
+            t = t[:, : head_dim // 2]
+        return t[:seq_len]
+    return norm(cos), norm(sin)
+
+
+def _rope_one_ref(x, cos, sin):
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+@register_op("fused_rope", tags=["fusion", "attention"], dispatch=True)
+def _fused_rope(q, k, v, cos, sin):
+    """Rotate q/k (and optionally v) by position embeddings. Shapes
+    [B, S, H, D]; cos/sin [S, D/2]. Reference:
+    paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu."""
+    out = tuple(None if t is None else _rope_one_ref(t, cos, sin)
+                for t in (q, k, v))
+    return out
+
+
+def _rope_supported(q, k, v, cos, sin):
+    from ....kernels.pallas import rope as rope_mod
+    return all(t is None or rope_mod.supported(t, cos, sin)
+               for t in (q, k, v))
+
+
+@register_pallas_impl("fused_rope", supported=_rope_supported)
+def _fused_rope_pallas(q, k, v, cos, sin):
+    from ....kernels.pallas.rope import apply_rope
+    return tuple(None if t is None else apply_rope(t, cos, sin)
+                 for t in (q, k, v))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotate_half=False):
+    """Reference: python/paddle/incubate/nn/functional/
+    fused_rotary_position_embedding.py. Returns (q, k, v) rotated.
+
+    Only the NeoX half-split convention has a fused path; interleaved
+    (use_neox_rotary_style=False) and gathered position_ids fall back to the
+    XLA composition.
+    """
+    if time_major:
+        raise NotImplementedError("time_major=False only (S-major layout)")
+    seq_len, head_dim = q.shape[1], q.shape[-1]
+    if cos is None or sin is None:
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, head_dim, 2,
+                                            dtype=jnp.float32) / head_dim))
+        ang = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * inv[None, :]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+    else:
+        cos, sin = _normalize_cos_sin(cos, sin, seq_len, head_dim)
+    if position_ids is not None:
+        cosb = jnp.take(cos, position_ids, axis=0)  # [B, S, D/2]
+        sinb = jnp.take(sin, position_ids, axis=0)
+
+        def rot(x):
+            if x is None:
+                return None
+            half = x.shape[-1] // 2
+            x1 = x[..., :half].astype(jnp.float32)
+            x2 = x[..., half:].astype(jnp.float32)
+            c = cosb[:, :, None, :]
+            s = sinb[:, :, None, :]
+            return jnp.concatenate(
+                [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+        return rot(q), rot(k), rot(v)
+    if not use_neox_rotary_style or rotate_half:
+        # interleaved (GPT-J) convention: de-interleave, rotate, re-interleave
+        def rot(x):
+            if x is None:
+                return None
+            d = x.shape[-1]
+            xe = x[..., 0::2].astype(jnp.float32)
+            xo = x[..., 1::2].astype(jnp.float32)
+            c = cos[None, :, None, :]
+            s = sin[None, :, None, :]
+            ye = xe * c - xo * s
+            yo = xo * c + xe * s
+            return jnp.stack([ye, yo], axis=-1).reshape(x.shape).astype(x.dtype)
+        return rot(q), rot(k), rot(v)
+    return get_op("fused_rope").dispatch(q, k, v, cos, sin)
+
+
+@register_op("swiglu", tags=["fusion", "activation"])
+def swiglu(x, y=None):
+    """silu(x) * y; with y=None, x is split in half on the last axis.
+    Reference: python/paddle/incubate/nn/functional/swiglu.py
+    (paddle/phi/kernels/fusion/gpu/fused_swiglu_kernel.cu)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    """Reference: python/paddle/incubate/nn/functional/fused_matmul_bias.py.
+    One XLA dot with fused bias epilogue."""
+    w = weight.T if transpose_weight else weight
+    out = jnp.matmul(x, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+_ACTS = {
+    "gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+    "swiglu": lambda x: swiglu(x), "geglu": None, "identity": lambda x: x,
+}
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", dequant_scales=None,
+                   shift=None, smooth=None, quant_scale=-1, **kwargs):
+    """Reference: python/paddle/incubate/nn/functional/fused_bias_act.py.
+    Quant paths are out of TPU scope (bf16-first design)."""
+    if dequant_scales is not None or quant_scale != -1:
+        raise NotImplementedError("int8 quant paths are not supported")
+    if bias is not None:
+        x = x + bias
+    if shift is not None:
+        x = x + shift
+    if smooth is not None:
+        x = x * smooth
+    if act_method == "geglu":
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.gelu(a) * b
+    return _ACTS[act_method](x)

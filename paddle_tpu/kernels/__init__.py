@@ -1,0 +1,11 @@
+"""Fused-kernel tier (TPU-native equivalent of paddle/phi/kernels/fusion/).
+
+The reference ships 92.8k LoC of hand-written CUDA fusion kernels
+(fused_attention, fused_rope, rms_norm, fused_multi_transformer, ...).
+On TPU the same tier is a small set of Pallas kernels that XLA cannot fuse
+on its own — attention (O(S) VMEM tiling), normalization (single-pass
+row reductions), rotary embedding — registered as fast-path overrides of
+the XLA-composed reference ops via ``paddle_tpu.ops.register_pallas_impl``.
+"""
+
+from . import pallas  # noqa: F401

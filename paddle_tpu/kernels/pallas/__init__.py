@@ -1,0 +1,16 @@
+"""Pallas TPU kernels.
+
+Each submodule provides a ``jax.custom_vjp``-wrapped fused op plus a
+``supported(...)`` predicate used by the op registry to decide when the
+Pallas fast path may replace the XLA-composed reference implementation.
+
+Access kernels via their modules (``pallas.flash_attention.flash_attention``)
+— submodule names are not shadowed by function re-exports so that
+``import paddle_tpu.kernels.pallas.flash_attention`` always yields the
+module.
+"""
+
+from . import flash_attention  # noqa: F401
+from . import rms_norm  # noqa: F401
+from . import rope  # noqa: F401
+from . import register  # noqa: F401

@@ -1,0 +1,11 @@
+"""Shared constants/helpers for the Pallas kernel tier."""
+
+import jax
+
+LANES = 128  # TPU lane width; row-scalar scratch is lane-replicated
+
+
+def interpret() -> bool:
+    """Run kernels in interpret mode off-TPU (CPU CI); compiled otherwise
+    (real 'tpu' backend or the tunneled 'axon' platform)."""
+    return jax.default_backend() == "cpu"

@@ -1,0 +1,33 @@
+"""Wire Pallas kernels into the op registry as TPU fast paths.
+
+The reference selects fused CUDA kernels through KernelFactory dispatch
+(paddle/phi/core/kernel_factory.h:316); here the same decision is the
+``register_pallas_impl`` override, gated by the ``enable_pallas_kernels``
+flag and the per-kernel ``supported`` predicate.
+"""
+
+from __future__ import annotations
+
+from ...ops import register_pallas_impl
+import paddle_tpu.kernels.pallas.flash_attention as fa
+import paddle_tpu.kernels.pallas.rms_norm as rn
+
+
+@register_pallas_impl("scaled_dot_product_attention", supported=fa.supported)
+def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
+                 is_causal=False, training=True, name=None):
+    del attn_mask, dropout_p, training, name
+    return fa.flash_attention(query, key, value, is_causal)
+
+
+def _rms_supported(x, weight=None, bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    return (weight is not None and bias is None
+            and begin_norm_axis in (-1, x.ndim - 1)
+            and rn.supported(x, weight, epsilon))
+
+
+@register_pallas_impl("rms_norm", supported=_rms_supported)
+def _rms_norm_pallas(x, weight=None, bias=None, epsilon=1e-6,
+                     begin_norm_axis=-1):
+    return rn.rms_norm(x, weight, epsilon)

@@ -45,11 +45,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     return out
 
 
-@register_op("rms_norm", tags=["norm", "fusion"])
+@register_op("rms_norm", tags=["norm", "fusion"], dispatch=True)
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     """RMSNorm (reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu;
     python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
-    axes = begin_norm_axis if begin_norm_axis != -1 else x.ndim - 1
+    axes = begin_norm_axis % x.ndim
     red = tuple(range(axes, x.ndim))
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=red, keepdims=True)
