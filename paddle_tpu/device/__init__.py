@@ -156,3 +156,25 @@ def is_compiled_with_tpu() -> bool:
 def synchronize(place=None):
     """Block until all dispatched work on the device is complete."""
     (jax.device_put(0.0, jax_device(place)) + 0).block_until_ready()
+
+
+def force_virtual_cpu_devices(n: int = 8) -> None:
+    """Force the host CPU platform with `n` virtual devices (the reference's
+    subprocess-spawn distributed-test pattern, SURVEY §4, mapped to
+    ``--xla_force_host_platform_device_count``). Must run before any jax
+    computation initializes the backend. Used by tests/conftest.py and the
+    driver's ``dryrun_multichip`` so multi-chip shardings validate without
+    real chips. Does not permanently alter JAX_PLATFORMS for child processes
+    beyond what the CPU run needs."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:  # no-op if the backend is already initialized
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        pass

@@ -2,19 +2,11 @@
 subprocess-spawn distributed test pattern, SURVEY §4, maps to
 xla_force_host_platform_device_count on TPU-less CI)."""
 
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the virtual CPU mesh
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-
-import jax
+from paddle_tpu.device import force_virtual_cpu_devices
 
 # jax may already be imported (pytest plugins) with JAX_PLATFORMS=axon baked
 # in; force the CPU backend before any computation initializes it.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+force_virtual_cpu_devices(8)
 
 import numpy as np
 import pytest
