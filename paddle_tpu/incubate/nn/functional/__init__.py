@@ -37,13 +37,15 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
 
 
 def _normalize_cos_sin(cos, sin, seq_len, head_dim):
-    """Accept [S, D/2], [S, D] (neox-duplicated halves) or [1, S, 1, D]."""
+    """Accept [S, D/2], [S, D] (neox-duplicated halves) or [1, S, 1, D].
+    seq_len=None keeps the full table (needed when a position_ids gather
+    selects rows beyond the query length, e.g. KV-cache decode)."""
     def norm(t):
         t = jnp.asarray(t)
-        t = t.reshape(t.shape[-2] if t.ndim > 2 else t.shape[0], t.shape[-1])
+        t = t.reshape(-1, t.shape[-1])
         if t.shape[-1] == head_dim:
             t = t[:, : head_dim // 2]
-        return t[:seq_len]
+        return t if seq_len is None else t[:seq_len]
     return norm(cos), norm(sin)
 
 
@@ -100,7 +102,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         ang = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * inv[None, :]
         cos, sin = jnp.cos(ang), jnp.sin(ang)
     else:
-        cos, sin = _normalize_cos_sin(cos, sin, seq_len, head_dim)
+        cos, sin = _normalize_cos_sin(
+            cos, sin, None if position_ids is not None else seq_len, head_dim)
     if position_ids is not None:
         cosb = jnp.take(cos, position_ids, axis=0)  # [B, S, D/2]
         sinb = jnp.take(sin, position_ids, axis=0)

@@ -340,10 +340,6 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k):
 # public API ([B, S, H, D] layout, custom_vjp)
 # ---------------------------------------------------------------------------
 
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(query, key, value, causal=False, sm_scale=None,
                     block_q=None, block_k=None):
