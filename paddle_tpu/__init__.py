@@ -31,6 +31,7 @@ from . import optimizer  # noqa: F401
 from . import ops  # noqa: F401
 from . import kernels  # noqa: F401  (registers Pallas fast paths)
 from . import incubate  # noqa: F401
+from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.amp parity surface (reference: python/paddle/amp/)."""
+
+from .auto_cast import (
+    auto_cast, amp_guard, decorate, amp_decorate, amp_state,
+    is_auto_cast_enabled, get_amp_dtype, white_cast, black_cast, promote_cast,
+    WHITE_LIST, BLACK_LIST,
+)
+from .grad_scaler import GradScaler
+from . import debugging
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+    "is_auto_cast_enabled", "get_amp_dtype", "debugging",
+    "white_cast", "black_cast", "promote_cast",
+]

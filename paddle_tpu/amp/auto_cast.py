@@ -1,0 +1,223 @@
+"""Automatic mixed precision — auto_cast / decorate.
+
+Reference surface: python/paddle/amp/auto_cast.py:102 (AMPGlobalState,
+amp_guard O1/O2 semantics, per-op white/black lists); the reference injects
+casts into every generated ad_func ("AMP Logic" slot,
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:322).
+
+TPU design: bf16 is the native MXU dtype, so AMP here is a *dtype policy
+applied at trace time*. auto_cast pushes an AMP state consulted by the hot
+functional ops (linear / matmul / conv — the MXU ops cast inputs to the amp
+dtype; numerically sensitive ops — softmax, norms, cross-entropy — keep or
+promote fp32). Because jax traces the Python, the context governs everything
+compiled inside it; no per-op code generation is needed. O2 additionally
+casts parameters themselves (see `decorate`), keeping fp32 master weights in
+the optimizer (`multi_precision`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterable, Optional, Set
+
+import jax.numpy as jnp
+
+from .. import dtypes as _dtypes
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "amp_decorate", "amp_state",
+    "is_auto_cast_enabled", "get_amp_dtype", "white_cast", "black_cast",
+    "promote_cast", "WHITE_LIST", "BLACK_LIST",
+]
+
+# Default O1 lists (reference: python/paddle/amp/auto_cast.py WHITE_LIST /
+# BLACK_LIST). White = MXU-bound ops that are safe and fast in low precision;
+# black = numerically sensitive reductions.
+WHITE_LIST: Set[str] = {
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "matmul", "matmul_v2", "mul",
+    "einsum", "linear", "bmm", "flash_attention",
+    "fused_multi_transformer", "fused_rope",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "square", "log", "mean", "sum", "cos_sim",
+    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "c_softmax_with_cross_entropy",
+    "layer_norm", "batch_norm", "rms_norm", "group_norm", "instance_norm",
+    "reduce_sum", "cumsum", "logsumexp",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white: Set[str] = set(WHITE_LIST)
+        self.black: Set[str] = set(BLACK_LIST)
+
+
+_STATE = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _STATE
+
+
+def is_auto_cast_enabled() -> bool:
+    return _STATE.enabled
+
+
+def get_amp_dtype():
+    return _STATE.dtype if _STATE.enabled else None
+
+
+def _resolve_dtype(dtype):
+    if dtype is None:
+        return jnp.bfloat16
+    if isinstance(dtype, str):
+        return {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+                "bf16": jnp.bfloat16, "fp16": jnp.float16}[dtype]
+    return _dtypes.convert_np_dtype_to_dtype_(dtype)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = None,
+              custom_black_list: Optional[Iterable[str]] = None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """Context under which traced ops follow the AMP dtype policy.
+
+    Reference: python/paddle/amp/auto_cast.py (amp_guard). level O1 casts
+    white-listed ops to `dtype`; O2 casts everything except the black list.
+    On TPU `dtype` defaults to bfloat16 (no GradScaler needed); float16 is
+    supported for parity testing.
+    """
+    del use_promote  # promote is the only inter-op behavior we implement
+    assert level in ("O0", "O1", "O2"), level
+    prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
+            set(_STATE.white), set(_STATE.black))
+    _STATE.enabled = bool(enable) and level != "O0"
+    _STATE.dtype = _resolve_dtype(dtype)
+    _STATE.level = level
+    if custom_white_list:
+        _STATE.white |= set(custom_white_list)
+        _STATE.black -= set(custom_white_list)
+    if custom_black_list:
+        _STATE.black |= set(custom_black_list)
+        _STATE.white -= set(custom_black_list)
+    try:
+        yield
+    finally:
+        (_STATE.enabled, _STATE.dtype, _STATE.level,
+         _STATE.white, _STATE.black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (reference keeps both names)
+
+
+def _float_dtype(x):
+    """dtype of x if it is (or wraps) a float array/scalar, else None."""
+    if x is None:
+        return None
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        if isinstance(x, float):
+            return jnp.dtype(jnp.float32)
+        return None
+    try:
+        return dt if jnp.issubdtype(dt, jnp.floating) else None
+    except TypeError:
+        return None
+
+
+def _cast_all(xs, target):
+    out = tuple(
+        jnp.asarray(x).astype(target) if _float_dtype(x) is not None else x
+        for x in xs)
+    return out if len(out) != 1 else out[0]
+
+
+def white_cast(op_name: str, *xs):
+    """Cast float inputs of a white-listed (MXU) op to the amp dtype.
+    No-op when AMP is off or the op has been black-listed.
+
+    NOTE (sharp edge, by design): the AMP state is *trace-time* Python
+    state. A function jitted and first called outside ``auto_cast`` caches
+    an fp32 program that later calls under the context will reuse (jit does
+    not key on AMP state). Open the context inside the jitted function, or
+    jit inside the context, as all framework train loops here do."""
+    if not _STATE.enabled:
+        return xs if len(xs) != 1 else xs[0]
+    if op_name in _STATE.black:
+        return _cast_all(xs, jnp.float32)
+    if _STATE.level == "O1" and op_name not in _STATE.white:
+        return xs if len(xs) != 1 else xs[0]
+    return _cast_all(xs, _STATE.dtype)
+
+
+def black_cast(op_name: str, *xs):
+    """Promote low-precision float inputs of a black-listed op to float32
+    (or to the amp dtype if the user white-listed the op explicitly)."""
+    if not _STATE.enabled:
+        return xs if len(xs) != 1 else xs[0]
+    if op_name in _STATE.white:  # user moved it to the white list
+        return _cast_all(xs, _STATE.dtype)
+    out = tuple(
+        jnp.asarray(x).astype(jnp.float32)
+        if _float_dtype(x) in (jnp.float16, jnp.bfloat16) else x
+        for x in xs)
+    return out if len(out) != 1 else out[0]
+
+
+def promote_cast(*xs):
+    """Promote mixed float inputs to the widest present dtype (the
+    'promote to widest' rule for gray-list ops)."""
+    floats = [dt for dt in (_float_dtype(x) for x in xs) if dt is not None]
+    if not floats:
+        return xs if len(xs) != 1 else xs[0]
+    return _cast_all(xs, jnp.result_type(*floats))
+
+
+_KEEP_FP32_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "SyncBatchNorm", "RMSNorm")
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype: Optional[str] = None):
+    """O2 decoration: cast model parameters to the amp dtype in place,
+    keeping normalization layers fp32 (reference:
+    python/paddle/amp/auto_cast.py amp_decorate; O2 'pure fp16/bf16' mode).
+    Optimizers get `multi_precision` master weights when `master_weight`
+    is not False.
+
+    Returns (models, optimizers) like the reference.
+    """
+    del save_dtype
+    assert level in ("O1", "O2"), level
+    target = _resolve_dtype(dtype)
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if type(layer).__name__.startswith(_KEEP_FP32_LAYERS):
+                    continue
+                for _, p in layer.named_parameters(include_sublayers=False):
+                    if jnp.issubdtype(p.value.dtype, jnp.floating):
+                        p.value = p.value.astype(target)
+
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if master_weight is not False:
+        for o in opt_list:
+            o._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)
+
+
+amp_decorate = decorate

@@ -1,0 +1,109 @@
+"""AMP / numerics debugging utilities.
+
+Reference: python/paddle/amp/debugging.py (check_numerics, operator stats
+collection, skip-check contexts) and the eager nan/inf checks
+(paddle/fluid/eager/nan_inf_utils.cc, flag FLAGS_check_nan_inf).
+
+TPU design: jax.debug_nans is the compiler-level equivalent of
+FLAGS_check_nan_inf; `check_numerics` adds an explicit in-graph assert via
+jax checkify-free debug callback (error at the op that produced the NaN,
+even under jit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..flags import flag, set_flags
+
+__all__ = [
+    "enable_tensor_checker", "disable_tensor_checker", "check_numerics",
+    "collect_operator_stats", "DebugMode",
+]
+
+
+class DebugMode:
+    """Reference: python/paddle/amp/debugging.py DebugMode enum."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def enable_tensor_checker(checker_config=None):
+    """Turn on global NaN/Inf detection (reference: FLAGS_check_nan_inf).
+    Maps to jax_debug_nans: any op producing NaN under jit re-runs
+    un-jitted and raises at the culprit."""
+    del checker_config
+    set_flags({"check_nan_inf": True})
+    jax.config.update("jax_debug_nans", True)
+
+
+def disable_tensor_checker():
+    set_flags({"check_nan_inf": False})
+    jax.config.update("jax_debug_nans", False)
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: int = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """In-graph NaN/Inf check on one tensor. Works under jit via
+    jax.debug.callback; aborts (raises in the callback) or prints stats
+    depending on debug_mode. Returns the tensor unchanged so it can be
+    inserted inline: ``x = check_numerics(x, "attn", "scores")``."""
+    x = jnp.asarray(tensor)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return tensor
+    num_nan = jnp.sum(jnp.isnan(x))
+    num_inf = jnp.sum(jnp.isinf(x))
+
+    def _report(nn, ni):
+        if int(nn) or int(ni):
+            msg = (f"[check_numerics] op={op_type} var={var_name}: "
+                   f"{int(nn)} NaN, {int(ni)} Inf")
+            if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            print(msg)
+
+    jax.debug.callback(_report, num_nan, num_inf)
+    return tensor
+
+
+class _OpStats:
+    def __init__(self):
+        self.stats: Dict[str, Dict[str, int]] = {}
+
+    def add(self, op: str, dtype):
+        d = self.stats.setdefault(op, {})
+        key = str(jnp.dtype(dtype))
+        d[key] = d.get(key, 0) + 1
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Count per-op dtype occurrences while tracing under AMP (reference:
+    debugging.collect_operator_stats low/high-precision op-list report).
+    Hooks the op registry dispatch; prints a summary on exit."""
+    from ..ops import registry as _reg
+
+    stats = _OpStats()
+    orig = _reg.OpSchema.dispatch
+
+    def traced(self, *args, **kwargs):
+        for a in args:
+            if hasattr(a, "dtype"):
+                stats.add(self.name, a.dtype)
+                break
+        return orig(self, *args, **kwargs)
+
+    _reg.OpSchema.dispatch = traced
+    try:
+        yield stats
+    finally:
+        _reg.OpSchema.dispatch = orig
+        if stats.stats:
+            print("<-------------- op list: (op, dtype counts) -------------->")
+            for op, counts in sorted(stats.stats.items()):
+                print(f"  {op}: {counts}")

@@ -1,0 +1,194 @@
+"""Dynamic loss scaling for float16 AMP.
+
+Reference: python/paddle/amp/grad_scaler.py (GradScaler: scale/unscale_/
+step/update/minimize with dynamic loss scaling and found_inf skip).
+
+TPU design: bf16 — the native policy — needs no scaler; this exists for
+fp16 parity. Two surfaces:
+
+* functional (jit/pjit-safe): explicit scaler state pytree threaded through
+  the train step. `found_inf` is a traced scalar; the skip is a jnp.where
+  select so the whole step stays one compiled program (no host sync, which
+  would stall the TPU pipeline the way the reference's GPU found_inf D2H
+  copy does).
+* eager: paddle-style scale(loss)/step(optimizer)/update() over Parameter
+  .grad slots for hapi/dygraph-style loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradScaler", "OptimizerState"]
+
+OptimizerState = Dict[str, Any]
+
+
+def _tree_finite(tree) -> jax.Array:
+    leaves = [x for x in jax.tree.leaves(tree) if x is not None]
+    if not leaves:
+        return jnp.bool_(True)
+    oks = [jnp.all(jnp.isfinite(x)) for x in leaves
+           if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not oks:
+        return jnp.bool_(True)
+    return jnp.stack(oks).all()
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 16,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = bool(enable)
+        self._init_scale = float(init_loss_scaling)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every = int(incr_every_n_steps)
+        self._decr_every = int(decr_every_n_nan_or_inf)
+        self._dynamic = bool(use_dynamic_loss_scaling)
+        # eager-mode state
+        self._eager = self.init_state()
+        self._eager_found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    # ---------------- functional surface ----------------
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {
+            "scale": jnp.float32(self._init_scale if self._enable else 1.0),
+            "good_steps": jnp.int32(0),
+            "bad_steps": jnp.int32(0),
+        }
+
+    def scale(self, loss, state: Optional[Dict] = None):
+        """loss * loss_scaling. Without `state`, uses the eager state."""
+        if not self._enable:
+            return loss
+        s = (state or self._eager)["scale"]
+        # promote to fp32: demoting the scale to fp16 overflows at the
+        # default 2**16 (> fp16 max) and would flag every step as inf
+        return jnp.asarray(loss).astype(jnp.float32) * s
+
+    def unscale(self, grads, state: Dict):
+        """Returns (unscaled_grads, found_inf). Pure; jit-safe."""
+        if not self._enable:
+            return grads, jnp.bool_(False)
+        inv = (1.0 / state["scale"]).astype(jnp.float32)
+        un = jax.tree.map(
+            lambda g: None if g is None
+            else (g.astype(jnp.float32) * inv).astype(g.dtype), grads,
+            is_leaf=lambda x: x is None)
+        found_inf = ~_tree_finite(un)
+        return un, found_inf
+
+    def update_state(self, state: Dict, found_inf) -> Dict:
+        """Dynamic loss-scaling bookkeeping (reference semantics: grow scale
+        by incr_ratio after incr_every_n_steps clean steps; shrink by
+        decr_ratio after decr_every_n_nan_or_inf bad steps)."""
+        if not (self._enable and self._dynamic):
+            return state
+        found_inf = jnp.asarray(found_inf)
+        good = jnp.where(found_inf, 0, state["good_steps"] + 1)
+        bad = jnp.where(found_inf, state["bad_steps"] + 1, 0)
+        grow = good >= self._incr_every
+        shrink = bad >= self._decr_every
+        scale = state["scale"]
+        scale = jnp.where(grow, scale * self._incr_ratio, scale)
+        scale = jnp.where(shrink, jnp.maximum(scale * self._decr_ratio, 1.0),
+                          scale)
+        good = jnp.where(grow, 0, good)
+        bad = jnp.where(shrink, 0, bad)
+        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+
+    def step(self, optimizer, params, grads, opt_state: OptimizerState,
+             scaler_state: Dict, lr=None):
+        """Unscale, conditionally apply the optimizer (skip on inf), update
+        scaling. Returns (params, opt_state, scaler_state, found_inf).
+        One compiled program — the inf-skip is a select, not a host branch."""
+        grads, found_inf = self.unscale(grads, scaler_state)
+        new_p, new_s = optimizer.apply(params, grads, opt_state, lr)
+        keep = lambda old, new: old if new is None else (
+            new if old is None else jnp.where(found_inf, old, new))
+        params = jax.tree.map(keep, params, new_p,
+                              is_leaf=lambda x: x is None)
+        opt_state = jax.tree.map(keep, opt_state, new_s,
+                                 is_leaf=lambda x: x is None)
+        scaler_state = self.update_state(scaler_state, found_inf)
+        return params, opt_state, scaler_state, found_inf
+
+    # ---------------- eager surface (paddle parity) ----------------
+    def unscale_(self, optimizer):
+        """Divide Parameter.grad slots by the scale; record found_inf."""
+        if not self._enable or self._unscaled:
+            return
+        import numpy as np
+        inv = 1.0 / float(self._eager["scale"])
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = np.asarray(p.grad, dtype=np.float32) * inv
+            if not np.isfinite(g).all():
+                found = True
+            p.grad = g.astype(np.asarray(p.grad).dtype)
+        self._eager_found_inf = found
+        self._unscaled = True
+
+    def eager_step(self, optimizer):
+        self.unscale_(optimizer)
+        if not self._eager_found_inf:
+            optimizer.step()
+
+    def update(self):
+        self._eager = jax.tree.map(
+            jnp.asarray,
+            self.update_state(self._eager, jnp.bool_(self._eager_found_inf)))
+        self._eager_found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        del scaled_loss  # backward already ran in the eager flow
+        self.eager_step(optimizer)
+        self.update()
+
+    # ---------------- scale accessors / checkpoint ----------------
+    def get_loss_scaling(self):
+        return float(self._eager["scale"])
+
+    def set_init_loss_scaling(self, v: float):
+        self._init_scale = float(v)
+        self._eager["scale"] = jnp.float32(v)
+
+    def state_dict(self) -> Dict[str, Any]:
+        import numpy as np
+        return {
+            "scale": np.asarray(self._eager["scale"]),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": int(self._eager["good_steps"]),
+            "bad_steps": int(self._eager["bad_steps"]),
+            "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self._eager = {
+            "scale": jnp.float32(sd["scale"]),
+            "good_steps": jnp.int32(sd.get("good_steps", 0)),
+            "bad_steps": jnp.int32(sd.get("bad_steps", 0)),
+        }
+        self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every = int(sd.get("incr_every_n_steps", self._incr_every))
+        self._decr_every = int(sd.get("decr_every_n_nan_or_inf",
+                                      self._decr_every))

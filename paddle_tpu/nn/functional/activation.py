@@ -134,6 +134,9 @@ def swiglu(x, y=None):
 def softmax(x, axis=-1, dtype=None):
     if dtype is not None:
         x = x.astype(dtype)
+    else:
+        from ...amp.auto_cast import black_cast
+        x = black_cast("softmax", x)
     return jax.nn.softmax(x, axis=axis)
 
 

@@ -23,6 +23,8 @@ def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. W is [in, out] (paddle layout). Rides the MXU; keep the
     contraction dims multiples of 128 for best tiling."""
     del name
+    from ...amp.auto_cast import white_cast
+    x, weight, bias = white_cast("linear", x, weight, bias)
     w = jnp.asarray(weight)
     out = jnp.matmul(x, w)
     if bias is not None:

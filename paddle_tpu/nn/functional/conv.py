@@ -62,6 +62,8 @@ def _dim_numbers(ndim_spatial, data_format):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    from ...amp.auto_cast import white_cast
+    x, weight, bias = white_cast(f"conv{n}d", x, weight, bias)
     w = jnp.asarray(weight)
     stride = _ntuple(stride, n)
     dilation = _ntuple(dilation, n)
@@ -100,6 +102,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                     groups, data_format, n, output_size=None):
+    from ...amp.auto_cast import white_cast
+    x, weight, bias = white_cast(f"conv{n}d_transpose", x, weight, bias)
     w = jnp.asarray(weight)  # paddle layout: [in_c, out_c/groups, *spatial]
     stride = _ntuple(stride, n)
     dilation = _ntuple(dilation, n)

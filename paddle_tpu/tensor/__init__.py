@@ -584,6 +584,8 @@ def multiplex(inputs, index):
 # linalg
 # ---------------------------------------------------------------------------
 def matmul(x, y, transpose_x=False, transpose_y=False):
+    from ..amp.auto_cast import white_cast
+    x, y = white_cast("matmul", x, y)
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if transpose_y:
@@ -596,6 +598,8 @@ def mm(x, y):
 
 
 def bmm(x, y):
+    from ..amp.auto_cast import white_cast
+    x, y = white_cast("bmm", x, y)
     return jnp.matmul(x, y)
 
 
@@ -616,6 +620,10 @@ def cross(x, y, axis=-1):
 
 
 def einsum(equation, *operands):
+    from ..amp.auto_cast import white_cast
+    operands = white_cast("einsum", *operands)
+    if not isinstance(operands, tuple):
+        operands = (operands,)
     return jnp.einsum(equation, *operands)
 
 

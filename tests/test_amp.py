@@ -1,0 +1,162 @@
+"""AMP tests (reference strategy: test/amp/ — O1/O2 cast behavior,
+GradScaler dynamic scaling and inf-skip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn
+from paddle_tpu.nn import functional as F
+
+
+class TestAutoCast:
+    def test_o1_white_op_casts(self):
+        x = jnp.ones((8, 16), jnp.float32)
+        w = jnp.ones((16, 4), jnp.float32)
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = F.linear(x, w)
+        assert out.dtype == jnp.bfloat16
+        # outside the context: fp32 again
+        assert F.linear(x, w).dtype == jnp.float32
+
+    def test_o1_black_op_promotes(self):
+        x = jnp.ones((4, 8), jnp.bfloat16)
+        with amp.auto_cast(level="O1"):
+            out = F.softmax(x)
+        assert out.dtype == jnp.float32
+
+    def test_custom_lists(self):
+        x = jnp.ones((8, 16), jnp.float32)
+        w = jnp.ones((16, 4), jnp.float32)
+        with amp.auto_cast(custom_black_list={"linear"}):
+            out = F.linear(x, w)
+        assert out.dtype == jnp.float32
+
+    def test_matmul_casts_under_amp(self):
+        x = jnp.ones((4, 8), jnp.float32)
+        with amp.auto_cast():
+            out = paddle.matmul(x, x.T)
+        assert out.dtype == jnp.bfloat16
+
+    def test_disabled(self):
+        x = jnp.ones((8, 16), jnp.float32)
+        w = jnp.ones((16, 4), jnp.float32)
+        with amp.auto_cast(enable=False):
+            assert F.linear(x, w).dtype == jnp.float32
+
+    def test_under_jit_trace(self):
+        x = jnp.ones((8, 16), jnp.float32)
+        w = jnp.ones((16, 4), jnp.float32)
+
+        @jax.jit
+        def f(x, w):
+            with amp.auto_cast():
+                return F.linear(x, w)
+
+        assert f(x, w).dtype == jnp.bfloat16
+
+
+class TestDecorate:
+    def test_o2_casts_params_keeps_norms_fp32(self):
+        model = nn.Sequential(
+            nn.Linear(8, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+        model = amp.decorate(model, level="O2", dtype="bfloat16")
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert model[1].weight.dtype == jnp.float32
+        assert model[2].weight.dtype == jnp.bfloat16
+
+    def test_decorate_sets_master_weights(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(0.001, parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2")
+        assert opt._multi_precision
+
+
+class TestGradScaler:
+    def test_scale_unscale_roundtrip(self):
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        st = scaler.init_state()
+        loss = jnp.float32(2.0)
+        scaled = scaler.scale(loss, st)
+        assert float(scaled) == 2048.0
+        grads = {"w": jnp.full((3,), 1024.0)}
+        un, found = scaler.unscale(grads, st)
+        np.testing.assert_allclose(np.asarray(un["w"]), 1.0)
+        assert not bool(found)
+
+    def test_found_inf_skips_step_and_halves_scale(self):
+        scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                                decr_every_n_nan_or_inf=1)
+        st = scaler.init_state()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        params = {"w": jnp.ones((2,))}
+        ostate = opt.init_state(params)
+        grads = {"w": jnp.array([jnp.inf, 1.0])}
+        params2, ostate2, st2, found = scaler.step(
+            opt, params, grads, ostate, st, 0.1)
+        assert bool(found)
+        np.testing.assert_allclose(np.asarray(params2["w"]), 1.0)  # skipped
+        assert float(st2["scale"]) == 512.0
+        assert int(ostate2["step"]) == 0  # step count rolled back
+
+    def test_good_steps_grow_scale(self):
+        scaler = amp.GradScaler(init_loss_scaling=8.0, incr_every_n_steps=2,
+                                incr_ratio=2.0)
+        st = scaler.init_state()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        params = {"w": jnp.ones((2,))}
+        ostate = opt.init_state(params)
+        grads = {"w": jnp.ones((2,))}
+        for _ in range(2):
+            params, ostate, st, _ = scaler.step(
+                opt, params, grads, ostate, st, 0.1)
+        assert float(st["scale"]) == 16.0
+
+    def test_step_is_jittable(self):
+        scaler = amp.GradScaler(init_loss_scaling=256.0)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01)
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        ostate = opt.init_state(params)
+        st = scaler.init_state()
+
+        @jax.jit
+        def step(params, ostate, st, x):
+            loss, grads = jax.value_and_grad(
+                lambda p: jnp.sum((p["w"] * x) ** 2))(params)
+            sloss = scaler.scale(loss, st)
+            del sloss  # jax.grad path scales grads implicitly in real use
+            return scaler.step(opt, params, grads, ostate, st, 0.01)
+
+        params, ostate, st, found = step(params, ostate, st,
+                                         jnp.ones((4,)))
+        assert not bool(found)
+        assert float(params["w"][0]) < 1.0
+
+    def test_state_dict_roundtrip(self):
+        s1 = amp.GradScaler(init_loss_scaling=123.0)
+        sd = s1.state_dict()
+        s2 = amp.GradScaler()
+        s2.load_state_dict(sd)
+        assert s2.get_loss_scaling() == 123.0
+
+
+class TestDebugging:
+    def test_check_numerics_pass(self):
+        x = jnp.ones((4,))
+        out = amp.debugging.check_numerics(x, "op", "x")
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_check_numerics_raises(self):
+        x = jnp.array([1.0, jnp.nan])
+        with pytest.raises(Exception):
+            jax.block_until_ready(
+                amp.debugging.check_numerics(x, "op", "x"))
+            jax.effects_barrier()
+
+    def test_collect_operator_stats(self):
+        x = jnp.ones((4, 8), jnp.float32)
+        with amp.debugging.collect_operator_stats() as stats:
+            F.rms_norm(x)
+        assert "rms_norm" in stats.stats
