@@ -18,6 +18,8 @@ from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                          new_group, ppermute, recv, reduce, reduce_scatter,
                          scatter, send)
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model
 from .env import (ParallelEnv, get_local_rank, get_rank, get_world_size,
                   init_parallel_env, is_initialized)
 from .parallel import DataParallel, shard_batch
@@ -43,4 +45,6 @@ __all__ = [
     "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3",
     # dp
     "DataParallel", "shard_batch",
+    # zero / group sharded
+    "sharding", "group_sharded_parallel", "save_group_sharded_model",
 ]

@@ -6,6 +6,6 @@ Populated incrementally: layers/ (TP), utils/ (SP), recompute/, meta_parallel/
 (pipeline, sharding). The top-level fleet API object lives in fleet.py.
 """
 
-from . import layers, recompute, utils  # noqa: F401
+from . import layers, meta_parallel, recompute, utils  # noqa: F401
 
-__all__ = ["layers", "recompute", "utils"]
+__all__ = ["layers", "meta_parallel", "recompute", "utils"]

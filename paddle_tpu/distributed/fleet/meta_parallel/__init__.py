@@ -1,0 +1,8 @@
+from . import pp_utils  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
+                       GroupShardedStage2, GroupShardedStage3)
+
+__all__ = ["pp_utils", "sharding", "DygraphShardingOptimizer",
+           "GroupShardedOptimizerStage2", "GroupShardedStage2",
+           "GroupShardedStage3"]

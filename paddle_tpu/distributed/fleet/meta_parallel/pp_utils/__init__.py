@@ -1,0 +1,3 @@
+from .spmd_pipeline import spmd_pipeline
+
+__all__ = ["spmd_pipeline"]

@@ -1,0 +1,8 @@
+"""paddle.distributed.sharding parity surface."""
+
+from .group_sharded import (LEVELS, build_sharded_train_step,
+                            group_sharded_parallel, param_specs,
+                            save_group_sharded_model, shard_spec_for)
+
+__all__ = ["LEVELS", "build_sharded_train_step", "group_sharded_parallel",
+           "param_specs", "save_group_sharded_model", "shard_spec_for"]

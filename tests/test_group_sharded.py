@@ -1,0 +1,169 @@
+"""GroupSharded / ZeRO tests.
+
+Reference strategy: test/collective/fleet/dygraph_group_sharded_*.py —
+stage 1/2/3 runs must match the plain-DP run numerically; here the golden
+is the single-program dense run on the same virtual 8-device mesh
+(SURVEY §4: multi-rank vs single-card parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.sharding import (build_sharded_train_step,
+                                             group_sharded_parallel,
+                                             param_specs, shard_spec_for)
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    DygraphShardingOptimizer, GroupShardedStage3)
+
+
+def make_mesh():
+    return dist.build_mesh({"dp": 2, "sharding": 4}, devices=jax.devices()[:8])
+
+
+def init_params(key, din=16, dh=32, dout=4):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+        "b1": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def loss_fn(params, x, y):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def dense_run(params, batches, opt, lr=0.1, steps=4):
+    state = opt.init_state(params)
+
+    @jax.jit
+    def step(p, s, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p, s = opt.apply(p, g, s, lr)
+        return p, s, l
+
+    losses = []
+    for x, y in batches:
+        params, state, l = step(params, state, x, y)
+        losses.append(float(l))
+    return params, losses
+
+
+def batches_for(steps=4, n=64, din=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = jnp.asarray(rng.randn(n, din).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 4, (n,)))
+        out.append((x, y))
+    return out
+
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_zero_levels_match_dense(level):
+    mesh = make_mesh()
+    params = init_params(jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1)
+    batches = batches_for()
+    dense_p, dense_losses = dense_run(dict(params), batches, opt)
+
+    _, place, compile_for = build_sharded_train_step(
+        loss_fn, opt, mesh, level=level)
+    sp, sstate = place(dict(params))
+    step, batch_spec = compile_for(sp)
+    losses = []
+    for x, y in batches:
+        x = jax.device_put(x, batch_spec)
+        y = jax.device_put(y, batch_spec)
+        sp, sstate, l = step(sp, sstate, x, y, 0.1)
+        losses.append(float(l))
+    # reduction-order noise across layouts: loose-ish but tight enough to
+    # catch a wrong collective (those diverge at the 1e-1 level)
+    np.testing.assert_allclose(losses, dense_losses, rtol=1e-4, atol=1e-5)
+    for k in dense_p:
+        np.testing.assert_allclose(np.asarray(sp[k]), np.asarray(dense_p[k]),
+                                   rtol=1e-3, atol=5e-5)
+
+
+def test_state_is_sharded_and_params_layout_per_level():
+    mesh = make_mesh()
+    params = init_params(jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1)
+
+    for level, stage in [("os", 1), ("p_g_os", 3)]:
+        _, place, _ = build_sharded_train_step(loss_fn, opt, mesh, level=level)
+        sp, sstate = place(dict(params))
+        # moment slots sharded over the 4-way sharding axis
+        m1 = sstate["slots"]["w1"]["moment1"]
+        shard_shape = m1.sharding.shard_shape(m1.shape)
+        assert shard_shape != m1.shape, "state not sharded"
+        # params sharded only at stage 3
+        w1 = sp["w1"]
+        if stage >= 3:
+            assert w1.sharding.shard_shape(w1.shape) != w1.shape
+        else:
+            assert w1.sharding.shard_shape(w1.shape) == w1.shape
+
+
+def test_shard_spec_for_indivisible_is_replicated():
+    mesh = make_mesh()
+    leaf = jnp.zeros((3, 5))
+    assert shard_spec_for(leaf, mesh, "sharding") == P(None, None)
+    leaf2 = jnp.zeros((8, 5))
+    assert shard_spec_for(leaf2, mesh, "sharding") == P("sharding", None)
+
+
+def test_param_specs_stages():
+    mesh = make_mesh()
+    params = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((3,))}
+    s1 = param_specs(params, mesh, "sharding", 1)
+    assert s1["w"] == P() and s1["b"] == P()
+    s3 = param_specs(params, mesh, "sharding", 3)
+    assert s3["w"] == P("sharding", None) and s3["b"] == P(None)
+
+
+def test_group_sharded_parallel_eager_surface():
+    mesh = make_mesh()
+    from paddle_tpu import nn
+    model = nn.Linear(16, 8)
+    opt = paddle.optimizer.AdamW(0.01, parameters=model.parameters())
+    m, o, s = group_sharded_parallel(model, opt, "os", mesh=mesh,
+                                     shard_axis="sharding")
+    st = o.init_state({"w": jnp.zeros((16, 8))})
+    m1 = st["slots"]["w"]["moment1"]
+    assert m1.sharding.shard_shape(m1.shape) != m1.shape
+
+
+def test_dygraph_sharding_optimizer_partition_and_state():
+    mesh = make_mesh()
+    from paddle_tpu import nn
+    model = nn.Sequential(nn.Linear(16, 32), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(0.01, parameters=model.parameters())
+    sopt = DygraphShardingOptimizer(opt, mesh=mesh, axis="sharding")
+    ranks = set(sopt.param_to_rank.values())
+    assert ranks <= set(range(4)) and len(ranks) > 1  # spread across ranks
+    params = {"w": jnp.zeros((32, 8))}
+    st = sopt.init_state(params)
+    m1 = st["slots"]["w"]["moment1"]
+    assert m1.sharding.shard_shape(m1.shape) != m1.shape
+
+
+def test_stage3_wrapper_shards_layer_params():
+    mesh = make_mesh()
+    from paddle_tpu import nn
+    model = nn.Linear(16, 8)
+    opt = paddle.optimizer.AdamW(0.01, parameters=model.parameters())
+    wrapped = GroupShardedStage3(model, opt, mesh=mesh, axis="sharding")
+    w = model.weight.value
+    assert w.sharding.shard_shape(w.shape) != w.shape
+    # still usable forward
+    out = wrapped(jnp.ones((2, 16)))
+    assert out.shape == (2, 8)
