@@ -196,15 +196,9 @@ class _ShardedOptimizer:
 
     def _shard_leaf(self, leaf):
         """Shard a state leaf along its largest dim divisible by the axis."""
-        axis = self._shard_axis_name()
-        size = self._mesh.shape[axis]
-        spec_entries = [None] * leaf.ndim
-        for d in np.argsort([-s for s in leaf.shape]):
-            if leaf.shape[d] % size == 0 and leaf.shape[d] >= size:
-                spec_entries[int(d)] = axis
-                break
-        sharding = NamedSharding(self._mesh, PartitionSpec(*spec_entries))
-        return jax.device_put(leaf, sharding)
+        from ..sharding.group_sharded import shard_spec_for
+        spec = shard_spec_for(leaf, self._mesh, self._shard_axis_name())
+        return jax.device_put(leaf, NamedSharding(self._mesh, spec))
 
     def init_state(self, params):
         state = self._inner.init_state(params)

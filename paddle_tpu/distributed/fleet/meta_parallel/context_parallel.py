@@ -1,0 +1,144 @@
+"""Context (sequence) parallelism: ring attention and Ulysses all-to-all.
+
+The reference snapshot has no ring attention — its long-context answers are
+Megatron-SP (sequence_parallel_utils.py), the SEP axis (segment_parallel.py:26,
+sequence split for the non-attention parts) and long-seq CUDA kernels
+(flash_attn varlen / flashmask, SURVEY §5 "Long-context"). On TPU, true
+context parallelism over the ICI ring is the idiomatic design (SURVEY §5:
+"ring attention over ICI ... or Ulysses all-to-all"), so this module is the
+SEP axis done TPU-first:
+
+* ``ring_attention`` — q stays local, k/v blocks rotate around the mesh axis
+  with lax.ppermute; an online-softmax state (m, l, acc) merges each block's
+  contribution, so no device ever materializes full-sequence K/V or scores.
+  The rotation is a lax.scan: XLA overlaps each step's ppermute (ICI) with
+  the block matmuls (MXU), and autodiff through scan+ppermute yields the
+  reverse ring for the backward pass. Per-step jax.checkpoint keeps
+  residuals O(S_local).
+
+* ``ulysses_attention`` — all-to-all swaps the sequence shard for a head
+  shard ([B, S/n, H, D] -> [B, S, H/n, D]), runs ordinary full attention on
+  the local heads (Pallas flash kernel on TPU), and swaps back. Cheaper than
+  the ring when heads divide the axis (two all-to-alls vs n ppermutes) but
+  caps the parallel degree at num_heads.
+
+Both are per-shard functions: call them inside shard_map with the sequence
+dim sharded over `axis`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
+                   sm_scale: Optional[float] = None, remat: bool = True):
+    """Blockwise ring attention over mesh axis `axis`.
+
+    q/k/v: this rank's sequence shard, [B, S_local, H, D] (paddle layout).
+    Returns [B, S_local, H, D]. Global sequence order is the concatenation
+    of shards by rank; causal masking uses global positions.
+    """
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    q32 = (q * scale).astype(q.dtype)
+    q_pos = rank * S + jnp.arange(S)  # [S] global positions of local queries
+
+    # kv blocks rotate "backward" (rank r sends to r+1), so after t steps
+    # this rank holds the block originating at rank - t (mod n): every rank
+    # sees every block after n steps.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (rank - t) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk,
+                       preferred_element_type=jnp.float32)  # [B,H,Sq,Sk]
+        if causal:
+            k_pos = src * S + jnp.arange(S)
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)                     # [B,H,Sq]
+        m_new = jnp.maximum(m, m_cur)
+        # fully-masked rows keep m = -inf; guard the shift to avoid inf-inf
+        shift = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - shift[..., None])               # [B,H,Sq,Sk]
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.exp(jnp.where(m <= _NEG_INF, _NEG_INF, m) - shift)
+        alpha = jnp.where(m <= _NEG_INF, 0.0, alpha)    # first contribution
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    def _vary(x):
+        # the scan carry must be device-varying like the rotating k/v blocks
+        # (shard_map's varying-axis type system)
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis,), to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis,))
+        return x  # older jax: types are untracked
+
+    m0 = _vary(jnp.full((B, H, S), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, S), jnp.float32))
+    acc0 = _vary(jnp.zeros((B, S, H, D), jnp.float32))
+    (k_blk, v_blk, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n))
+    inv = jnp.where(l == 0.0, 0.0, 1.0 / jnp.maximum(l, 1e-37))
+    out = acc * inv.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """DeepSpeed-Ulysses style sequence parallelism: trade the sequence
+    shard for a head shard with one all-to-all each way.
+
+    q/k/v: [B, S_local, H, D] with H divisible by the axis size.
+    A custom `attn_fn` is called as attn_fn(q, k, v, causal) on the
+    head-sharded full-sequence arrays (sm_scale is pre-folded into q).
+    """
+    n = lax.axis_size(axis)
+    B, S, H, D = q.shape
+    assert H % n == 0, f"heads {H} not divisible by axis size {n}"
+
+    def to_heads(x):
+        # split heads across ranks, gather the full sequence
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)  # [B, S*n, H/n, D]
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)  # [B, S_local, H, D]
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if sm_scale is not None:
+        # fold a custom scale into q (inner attention uses 1/sqrt(D))
+        qh = qh * (sm_scale * math.sqrt(D))
+    if attn_fn is None:
+        from ....nn import functional as F
+        out = F.scaled_dot_product_attention(qh, kh, vh, is_causal=causal)
+    else:
+        out = attn_fn(qh, kh, vh, causal)
+    return to_seq(out)

@@ -1,0 +1,98 @@
+"""SEP (segment parallel) axis: sequence split across ranks.
+
+Reference: fleet/meta_parallel/segment_parallel.py:26 (SegmentParallel:
+params broadcast over the sep group at init, grads allreduced over the
+sep/dp fused group — hybrid_parallel_util.py:254-267) and the `sep` axis in
+topology.py:73-80.
+
+TPU design: under SPMD the broadcast/allreduce choreography is the
+replicated-parameter layout plus one pmean in the train step; activations
+carry the sequence shard. The attention itself crosses shards via
+ring_attention / ulysses_attention (context_parallel.py) — the upgrade the
+reference lacks. This class keeps the reference wrapper surface and adds
+the helpers a sep-parallel train step needs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .context_parallel import ring_attention, ulysses_attention
+
+__all__ = ["SegmentParallel", "split_sequence", "sep_reduce_gradients"]
+
+
+def split_sequence(x, mesh: Mesh, axis: str = "sep", seq_dim: int = 1):
+    """Place a global [B, S, ...] batch with the sequence dim sharded over
+    the sep axis (each rank computes on its segment)."""
+    spec = [None] * jnp.ndim(x)
+    spec[seq_dim] = axis
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(*spec)))
+
+
+def sep_reduce_gradients(grads, axes=("sep", "dp")):
+    """Grad reduction over sep (+dp) for shard_map-style steps (reference:
+    hybrid_parallel_util.py fused sep-dp allreduce group). Parameters are
+    replicated over sep, so each segment contributes a partial grad.
+    Axis names not bound in the enclosing shard_map are skipped."""
+    use = []
+    for a in axes:
+        try:
+            lax.axis_size(a)  # raises NameError when unbound
+            use.append(a)
+        except NameError:
+            pass
+    if not use:
+        return grads
+    use = tuple(use)
+    return jax.tree.map(lambda g: lax.pmean(g, use), grads)
+
+
+class SegmentParallel:
+    """Model wrapper for sep-parallel training (reference surface).
+
+    Parameters stay replicated over 'sep' (the sharded train step's
+    in_shardings do the 'broadcast'); `attention` routes to ring or ulysses
+    so the model's attention works on sequence shards.
+    """
+
+    def __init__(self, layers, hcg=None, mesh: Optional[Mesh] = None,
+                 axis: str = "sep", strategy=None, mode: str = "ring"):
+        del strategy
+        assert mode in ("ring", "ulysses")
+        self._layers = layers
+        self._hcg = hcg
+        self._mesh = mesh if mesh is not None else (
+            hcg.mesh if hcg is not None else None)
+        self._axis = axis
+        self._mode = mode
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def attention(self, q, k, v, causal: bool = False, **kw):
+        """Sequence-sharded attention on [B, S_local, H, D] shards (call
+        inside shard_map over the sep axis)."""
+        if self._mode == "ulysses":
+            return ulysses_attention(q, k, v, axis=self._axis, causal=causal,
+                                     **kw)
+        return ring_attention(q, k, v, axis=self._axis, causal=causal, **kw)
+
+    def split_inputs(self, x, seq_dim: int = 1):
+        assert self._mesh is not None, "SegmentParallel needs a mesh"
+        return split_sequence(x, self._mesh, self._axis, seq_dim)
+
+    def reduce_gradients(self, grads, include_dp: bool = True):
+        axes = (self._axis, "dp") if include_dp else (self._axis,)
+        return sep_reduce_gradients(grads, axes)

@@ -18,7 +18,6 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["DygraphShardingOptimizer"]
@@ -70,10 +69,8 @@ class DygraphShardingOptimizer:
     def shard_state_specs(self, params):
         """Sharded optimizer-state specs (the GSPMD form of the rank
         partition)."""
-        from ....sharding.group_sharded import shard_spec_for
-        shape = jax.eval_shape(self._inner_opt.init_state, params)
-        return jax.tree.map(
-            lambda leaf: shard_spec_for(leaf, self._mesh, self._axis), shape)
+        from ....sharding.group_sharded import _state_specs
+        return _state_specs(self._inner_opt, params, self._mesh, self._axis)
 
     def init_state(self, params):
         state = self._inner_opt.init_state(params)

@@ -26,8 +26,7 @@ replicated (tiny tensors — biases, norms — where sharding buys nothing).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Sequence, Union
+from typing import Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -81,9 +80,13 @@ def build_sharded_train_step(
     written for GLOBAL arrays (GSPMD style — no collectives by hand; XLA
     derives them from the in/out shardings).
 
-    Returns (step_fn, place_fn) where
-      step_fn(params, opt_state, *batch, lr) -> (params, opt_state, loss)
-      place_fn(params) -> (params, opt_state) placed per the level.
+    Returns (step, place, compile_for):
+      step(params, opt_state, *batch, lr) — the raw (uncompiled) update,
+        usable for composition/testing;
+      place(params) -> (params, opt_state) placed per the level;
+      compile_for(placed_params) -> (jitted_step, batch_sharding) — the
+        jitted step with pinned param/state shardings; shard each batch
+        array with the returned batch_sharding before calling.
 
     The data batch is sharded over `data_axes` (the reference's
     sharding-as-extra-dp semantics: sharding ranks consume distinct data,
@@ -180,10 +183,14 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
     return model, opt, scaler
 
 
-def save_group_sharded_model(model, output, optimizer=None):
+def save_group_sharded_model(model, output, optimizer=None, opt_state=None):
     """Reference: group_sharded.py save_group_sharded_model — gather the
-    sharded model/optimizer to full arrays and save via paddle.save."""
+    sharded model/optimizer to full arrays and save via paddle.save.
+
+    Functional training threads opt_state explicitly — pass it here;
+    eager training stores it on the optimizer (`_eager_state`)."""
     import os
+    import warnings
     from ...framework.io import save
 
     def _full(x):
@@ -196,6 +203,12 @@ def save_group_sharded_model(model, output, optimizer=None):
     os.makedirs(output, exist_ok=True)
     sd = {k: _full(v) for k, v in model.state_dict().items()}
     save(sd, os.path.join(output, "model.pdparams"))
-    if optimizer is not None and getattr(optimizer, "_eager_state", None):
-        save(jax.tree.map(_full, optimizer._eager_state),
+    if opt_state is None and optimizer is not None:
+        opt_state = getattr(optimizer, "_eager_state", None)
+        if opt_state is None:
+            warnings.warn(
+                "save_group_sharded_model: optimizer given but no state — "
+                "pass opt_state= when training with the functional step")
+    if opt_state is not None:
+        save(jax.tree.map(_full, opt_state),
              os.path.join(output, "model.pdopt"))
