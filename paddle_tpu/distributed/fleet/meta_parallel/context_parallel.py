@@ -77,8 +77,7 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
         p = jnp.exp(s - shift[..., None])               # [B,H,Sq,Sk]
         if causal:
             p = jnp.where(mask[None, None], p, 0.0)
-        alpha = jnp.exp(jnp.where(m <= _NEG_INF, _NEG_INF, m) - shift)
-        alpha = jnp.where(m <= _NEG_INF, 0.0, alpha)    # first contribution
+        alpha = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - shift))
         l = l * alpha + jnp.sum(p, axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
                         preferred_element_type=jnp.float32)

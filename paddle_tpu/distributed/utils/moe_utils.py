@@ -1,0 +1,57 @@
+"""Expert-parallel token exchange (reference:
+python/paddle/distributed/utils/moe_utils.py — global_scatter/global_gather;
+CUDA ops paddle/fluid/operators/collective/global_{scatter,gather}_op.cu.cc).
+
+TPU design: the reference exchanges variable-length token lists with NCCL
+alltoall on computed send/recv counts. XLA needs static shapes, so the
+TPU-native layout is capacity-based: tokens are packed per (expert, slot)
+into a dense [num_experts, capacity, d] buffer and exchanged with ONE
+`lax.all_to_all` over the expert-parallel mesh axis — the collective rides
+ICI and its layout is known to the compiler, so it overlaps with the expert
+GEMMs. Overflowing tokens are dropped by the gate (same semantics as the
+reference's capacity-bounded gates, e.g. GShardGate top2_gating).
+
+Both functions must run inside `shard_map` with `axis` in scope (the
+explicit-collective mode); the GSPMD path in MoELayer does not need them —
+XLA inserts the all-to-alls from sharding annotations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, axis: str = "ep"):
+    """Send expert-major local buffer to expert owners.
+
+    x: [num_experts_global, capacity, d] per rank (tokens this rank routed
+    to each global expert). Returns [num_local_experts, world * capacity, d]:
+    all ranks' tokens for the experts this rank owns, rank-major on dim 1.
+    """
+    world = lax.psum(1, axis)
+    e_global, cap, d = x.shape
+    assert e_global % world == 0, (e_global, world)
+    # split dim 0 (experts) across ranks, concat arrivals on a new dim
+    y = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+    # y: [world, e_local, capacity, d] (peer-major)
+    return y.reshape(world, e_global // world, cap, d).transpose(
+        1, 0, 2, 3).reshape(e_global // world, world * cap, d)
+
+
+def global_gather(y, axis: str = "ep"):
+    """Inverse of global_scatter: return expert outputs to token owners.
+
+    y: [num_local_experts, world * capacity, d] → [num_experts_global,
+    capacity, d] on every rank (this rank's tokens, now processed).
+    """
+    world = lax.psum(1, axis)
+    e_local, wc, d = y.shape
+    cap = wc // world
+    z = y.reshape(e_local, world, cap, d).transpose(1, 0, 2, 3)
+    # z: [world, e_local, capacity, d] — send block p back to peer p
+    out = lax.all_to_all(z, axis, split_axis=0, concat_axis=0, tiled=True)
+    # out: [world * e_local, capacity, d] = experts in global order
+    return out.reshape(world * e_local, cap, d)

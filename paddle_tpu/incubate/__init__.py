@@ -1,3 +1,4 @@
 """paddle.incubate equivalent namespace (fused-op API surface)."""
 
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

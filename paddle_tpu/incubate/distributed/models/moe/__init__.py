@@ -1,0 +1,12 @@
+"""MoE / expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/)."""
+
+from .gate import (BaseGate, GShardGate, NaiveGate, SwitchGate,  # noqa: F401
+                   TopKGate, compute_capacity)
+from .grad_clip import (ClipGradForMOEByGlobalNorm,  # noqa: F401
+                        clip_by_global_norm_with_moe)
+from .moe_layer import ExpertFFN, MoELayer  # noqa: F401
+
+__all__ = ["MoELayer", "ExpertFFN", "BaseGate", "NaiveGate", "GShardGate",
+           "SwitchGate", "TopKGate", "compute_capacity",
+           "ClipGradForMOEByGlobalNorm", "clip_by_global_norm_with_moe"]

@@ -1,0 +1,190 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— naive_gate.py, gshard_gate.py, switch_gate.py, base_gate.py).
+
+Each gate maps token activations to a capacity-bounded routing plan:
+
+  combine_weights [T, E, C] — weight each token contributes to each
+                              (expert, capacity-slot); zero where dropped
+  dispatch_mask   [T, E, C] — boolean one-hot of slot assignment
+  aux_loss        scalar    — load-balancing loss (0 for NaiveGate)
+
+The [T, E, C] formulation is the GShard einsum dispatch: on TPU the
+dispatch/combine einsums compile to MXU matmuls and the E dimension carries
+the expert-parallel sharding, so XLA lowers the token exchange to a single
+all-to-all over the 'ep' mesh axis. The reference instead materializes
+variable-length per-expert token lists and NCCL-alltoalls them
+(global_scatter) — dynamic shapes XLA cannot tile.
+
+All routing math is fully vectorized (cumsum-based position assignment,
+no data-dependent control flow) so it jits to one fused region.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.initializer import XavierUniform
+from .....nn.layer.layers import Layer, Parameter
+
+__all__ = ["BaseGate", "NaiveGate", "SwitchGate", "GShardGate", "TopKGate",
+           "compute_capacity"]
+
+
+def compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """Slots per expert. Reference gates bound tokens-per-expert the same
+    way (gshard_gate.py capacity arg)."""
+    cap = int(math.ceil(num_tokens * top_k / num_experts * capacity_factor))
+    return max(cap, top_k)
+
+
+def _one_hot(idx, num):
+    return jax.nn.one_hot(idx, num, dtype=jnp.float32)
+
+
+def _positions_in_expert(mask: jnp.ndarray) -> jnp.ndarray:
+    """mask [T, E] 0/1 → slot index each token takes in its expert's queue
+    (cumsum order = token order, the reference's prune_gate_by_capacity
+    semantics)."""
+    return (jnp.cumsum(mask, axis=0) - 1.0) * mask
+
+
+def _capacity_dispatch(expert_idx, gate_w, capacity, num_experts,
+                       prev_counts=None):
+    """Build (combine, dispatch, kept_mask, counts) for one routing choice.
+
+    expert_idx [T] int, gate_w [T] float. prev_counts [E] — slots already
+    taken by earlier choices (top-2's second expert queues behind the
+    first, matching GShard).
+    """
+    mask = _one_hot(expert_idx, num_experts)  # [T, E]
+    pos = _positions_in_expert(mask)
+    if prev_counts is not None:
+        pos = pos + prev_counts[None, :] * mask
+    keep = (pos < capacity) & (mask > 0)
+    pos_idx = pos.sum(axis=1).astype(jnp.int32)  # [T]
+    keep_tok = keep.any(axis=1)
+    combine = (gate_w * keep_tok)[:, None, None] * (
+        mask[:, :, None] * _one_hot(pos_idx, capacity)[:, None, :])
+    counts = mask.sum(axis=0)
+    return combine, keep_tok, counts
+
+
+class BaseGate(Layer):
+    """Reference: moe/gate/base_gate.py — holds expert counts and the
+    learned routing projection."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25, name: Optional[str] = None):
+        super().__init__(name_scope=name)
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierUniform())
+
+    def logits(self, x):
+        # route in fp32: softmax/cumsum numerics matter more than MXU speed
+        return jnp.asarray(x, jnp.float32) @ jnp.asarray(
+            self.weight.value, jnp.float32)
+
+    def capacity(self, num_tokens: int) -> int:
+        return compute_capacity(num_tokens, self.num_experts, self.top_k,
+                                self.capacity_factor)
+
+    def forward(self, x) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Reference: moe/gate/naive_gate.py — plain top-k, no aux loss. Kept
+    capacity-bounded here (capacity_factor defaults high enough that drops
+    are rare at test scale)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k, capacity_factor)
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topw, topi = jax.lax.top_k(probs, self.top_k)
+        topw = topw / jnp.clip(topw.sum(-1, keepdims=True), 1e-9)
+        cap = self.capacity(x.shape[0])
+        combine = jnp.zeros((x.shape[0], self.num_experts, cap), jnp.float32)
+        counts = None
+        for k in range(self.top_k):
+            c, _, n = _capacity_dispatch(topi[:, k], topw[:, k], cap,
+                                         self.num_experts, counts)
+            combine = combine + c
+            counts = n if counts is None else counts + n
+        return combine, combine > 0, jnp.zeros((), jnp.float32)
+
+
+class SwitchGate(BaseGate):
+    """Reference: moe/gate/switch_gate.py — top-1 routing with the Switch
+    Transformer load-balance loss E·Σ_e f_e·P_e."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25,
+                 jitter_eps: float = 0.0):
+        super().__init__(d_model, num_experts, top_k=1,
+                         capacity_factor=capacity_factor)
+        self.jitter_eps = jitter_eps
+
+    def forward(self, x):
+        logits = self.logits(x)
+        if self.jitter_eps > 0.0:
+            # Switch-Transformer multiplicative routing jitter; key drawn
+            # from the framework RNG so seeding stays reproducible.
+            from .....random import next_key
+            noise = jax.random.uniform(
+                next_key(), logits.shape, jnp.float32,
+                1.0 - self.jitter_eps, 1.0 + self.jitter_eps)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_w = probs.max(axis=-1)
+        expert = probs.argmax(axis=-1)
+        cap = self.capacity(x.shape[0])
+        combine, _, _ = _capacity_dispatch(expert, gate_w, cap,
+                                           self.num_experts)
+        me = probs.mean(axis=0)
+        ce = _one_hot(expert, self.num_experts).mean(axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        return combine, combine > 0, aux
+
+
+class GShardGate(BaseGate):
+    """Reference: moe/gate/gshard_gate.py — top-2 with aux loss on the
+    first choice and the second expert queued behind the first's slots."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k=2,
+                         capacity_factor=capacity_factor)
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)
+        e1 = probs.argmax(axis=-1)
+        w1 = probs.max(axis=-1)
+        masked = probs - _one_hot(e1, self.num_experts) * probs
+        e2 = masked.argmax(axis=-1)
+        w2 = masked.max(axis=-1)
+        denom = jnp.clip(w1 + w2, 1e-9)
+        w1n, w2n = w1 / denom, w2 / denom
+        cap = self.capacity(x.shape[0])
+        c1, _, n1 = _capacity_dispatch(e1, w1n, cap, self.num_experts)
+        c2, _, _ = _capacity_dispatch(e2, w2n, cap, self.num_experts, n1)
+        combine = c1 + c2
+        me = probs.mean(axis=0)
+        ce = _one_hot(e1, self.num_experts).mean(axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        return combine, combine > 0, aux
+
+
+class TopKGate(NaiveGate):
+    """General top-k alias (the reference exposes NaiveGate(topk=k))."""
+    pass

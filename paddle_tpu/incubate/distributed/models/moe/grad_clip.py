@@ -1,0 +1,81 @@
+"""MoE-aware global-norm gradient clipping (reference:
+python/paddle/incubate/distributed/models/moe/grad_clip.py —
+ClipGradForMOEByGlobalNorm: expert-parameter norms are summed ACROSS the
+expert-parallel group before forming the global norm, because each rank
+holds different experts).
+
+TPU design: under GSPMD the expert weights are one stacked global tensor,
+so a plain global norm is already correct — `clip_by_global_norm` here is
+mesh-oblivious. The `ep_axis` argument exists for the explicit shard_map
+mode where gradients are per-rank local shards: expert-param norm² is
+psum'd over the axis, shared-param norm² is NOT (it is replicated).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ClipGradForMOEByGlobalNorm", "clip_by_global_norm_with_moe"]
+
+
+def _sq_norm(tree):
+    leaves = [jnp.sum(jnp.square(jnp.asarray(l, jnp.float32)))
+              for l in jax.tree_util.tree_leaves(tree)]
+    return sum(leaves) if leaves else jnp.zeros((), jnp.float32)
+
+
+def clip_by_global_norm_with_moe(grads, clip_norm: float,
+                                 is_expert_param: Optional[Callable] = None,
+                                 ep_axis: Optional[str] = None):
+    """Clip a gradient pytree by global norm.
+
+    With `ep_axis` in scope (shard_map explicit mode), leaves for which
+    `is_expert_param(path_str)` is true are expert-SHARDED: their norm² is
+    psum'd over the axis. With ep_axis set and NO predicate, the WHOLE tree
+    is treated as expert-sharded (an expert-only subtree); a mixed tree with
+    replicated shared params MUST pass a predicate, or shared norms would be
+    counted world-size times."""
+    if is_expert_param is None or ep_axis is None:
+        gsq = _sq_norm(grads)
+        if ep_axis is not None:  # whole tree expert-sharded by contract
+            gsq = lax.psum(gsq, ep_axis)
+    else:
+        flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+        expert_sq = jnp.zeros((), jnp.float32)
+        shared_sq = jnp.zeros((), jnp.float32)
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            s = jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+            if is_expert_param(key):
+                expert_sq = expert_sq + s
+            else:
+                shared_sq = shared_sq + s
+        expert_sq = lax.psum(expert_sq, ep_axis)
+        gsq = expert_sq + shared_sq
+    gnorm = jnp.sqrt(gsq)
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (jnp.asarray(g, jnp.float32) * scale).astype(g.dtype),
+        grads)
+    return clipped, gnorm
+
+
+class ClipGradForMOEByGlobalNorm:
+    """Drop-in grad-clip object (reference class of the same name) for use
+    with optimizers: `opt = AdamW(..., grad_clip=ClipGradForMOEByGlobalNorm(1.0))`."""
+
+    def __init__(self, clip_norm: float,
+                 is_expert_param: Optional[Callable] = None,
+                 ep_axis: Optional[str] = None):
+        self.clip_norm = float(clip_norm)
+        self.is_expert_param = is_expert_param
+        self.ep_axis = ep_axis
+
+    def __call__(self, grads):
+        clipped, _ = clip_by_global_norm_with_moe(
+            grads, self.clip_norm, self.is_expert_param, self.ep_axis)
+        return clipped

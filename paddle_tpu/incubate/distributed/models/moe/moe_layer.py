@@ -1,0 +1,176 @@
+"""Mixture-of-Experts layer with expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer :263;
+dispatch via global_scatter/global_gather alltoall, experts as a LayerList).
+
+TPU design — one layer, two executions (same pattern as the TP layers in
+fleet/layers/mpu/mp_layers.py):
+
+* **auto (GSPMD, default):** experts are ONE stacked weight
+  w1 [E, D, F] / w2 [E, F, D] sharded on dim 0 over the expert-parallel
+  mesh axis. Routing builds the GShard [T, E, C] combine/dispatch tensors;
+  dispatch/expert-FFN/combine are three einsums. Under pjit XLA partitions
+  the E dimension and inserts the all-to-alls on ICI — the collective the
+  reference codes by hand with global_scatter (NCCL alltoall on computed
+  counts). Stacked experts also mean the per-expert GEMMs are ONE batched
+  MXU matmul instead of E small launches.
+
+* **explicit (inside shard_map over the ep axis):** `dispatch()` packs the
+  local [T, E, C] routing into [E, C, D], exchanges with
+  moe_utils.global_scatter, runs the LOCAL expert shard, and returns with
+  global_gather — bit-identical semantics to the auto path, for programs
+  that manage communication placement themselves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....nn.functional.activation import gelu
+from .....nn.initializer import Constant, XavierNormal
+from .....nn.layer.layers import Layer
+from .....distributed.utils.moe_utils import global_gather, global_scatter
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertFFN"]
+
+_GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+class ExpertFFN(Layer):
+    """Stacked expert FFN bank: E experts as leading-dim-stacked weights
+    (the reference holds a python list of Linear experts; stacking is what
+    lets the MXU run them as one batched GEMM and lets GSPMD shard E)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation=gelu):
+        super().__init__()
+        self.num_experts = num_experts
+        self.activation = activation
+        init = XavierNormal()
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=init)
+        self.b1 = self.create_parameter(
+            [num_experts, d_hidden], default_initializer=Constant(0.0))
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=init)
+        self.b2 = self.create_parameter(
+            [num_experts, d_model], default_initializer=Constant(0.0))
+
+    def forward(self, dispatched):
+        """dispatched [E, C, D] → [E, C, D]."""
+        return self.apply(dispatched, self.w1.value, self.b1.value,
+                          self.w2.value, self.b2.value)
+
+    def apply(self, dispatched, w1, b1, w2, b2):
+        h = jnp.einsum("ecd,edf->ecf", dispatched, w1) + b1[:, None, :]
+        h = self.activation(h)
+        return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+
+def _ep_info(moe_group=None, ep_axis: Optional[str] = None):
+    """(mesh, axis_name, world) for expert parallelism. Accepts an explicit
+    Group (like the reference's moe_group), else looks for an 'ep' axis on
+    the hybrid mesh, else falls back to the data-parallel axis (the
+    reference's default moe_group IS the world/data group)."""
+    from .....distributed.topology import get_hybrid_communicate_group
+    if moe_group is not None and getattr(moe_group, "mesh", None) is not None:
+        return (moe_group.mesh, moe_group.axis_name or "ep",
+                moe_group.nranks)
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        names = list(hcg.mesh.axis_names)
+        if ep_axis and ep_axis in names:
+            return hcg.mesh, ep_axis, dict(
+                zip(names, hcg.mesh.devices.shape))[ep_axis]
+        for cand in ("ep", "dp"):
+            if cand in names:
+                size = dict(zip(names, hcg.mesh.devices.shape))[cand]
+                if size > 1:
+                    return hcg.mesh, cand, size
+    return None, ep_axis or "ep", 1
+
+
+class MoELayer(Layer):
+    """Reference: moe_layer.py:263 MoELayer(d_model, experts, gate, moe_group).
+
+    forward(x): x [B, S, D] or [T, D] → same shape; `aux_loss` attribute
+    holds the last load-balance loss (the reference accumulates it into the
+    loss via MoE grad-clip helpers; here callers add `layer.aux_loss`).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: str | BaseGate = "gshard", top_k: int = 2,
+                 capacity_factor: float = 2.0, activation=gelu,
+                 moe_group=None, ep_axis: Optional[str] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        if isinstance(gate, str):
+            cls = _GATES[gate]
+            if cls is NaiveGate:
+                self.gate = cls(d_model, num_experts, top_k=top_k,
+                                capacity_factor=capacity_factor)
+            else:  # GShard is top-2, Switch is top-1 by construction
+                self.gate = cls(d_model, num_experts,
+                                capacity_factor=capacity_factor)
+        else:
+            self.gate = gate
+        self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
+        self.mesh, self.ep_axis, self.ep_world = _ep_info(moe_group, ep_axis)
+        if self.num_experts % self.ep_world != 0:
+            raise ValueError("num_experts must divide ep world size")
+        self.aux_loss = jnp.zeros((), jnp.float32)
+        if self.mesh is not None and self.ep_world > 1:
+            spec = P(self.ep_axis)
+            for p in (self.experts.w1, self.experts.b1, self.experts.w2,
+                      self.experts.b2):
+                p.value = jax.device_put(
+                    p.value, NamedSharding(self.mesh, spec))
+
+    # -- auto / GSPMD path --------------------------------------------------
+    def forward(self, x, return_aux: bool = False):
+        """With return_aux=True returns (y, aux_loss) — REQUIRED under jit:
+        a traced aux stashed on `self` would leak the tracer. The attribute
+        form (`layer.aux_loss`) is only valid in eager execution."""
+        orig_shape = x.shape
+        xt = x.reshape(-1, self.d_model)
+        combine, dispatch, aux = self.gate(xt)
+        if not isinstance(aux, jax.core.Tracer):
+            self.aux_loss = aux
+        dtype = xt.dtype
+        dispatched = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(dtype), xt)
+        dispatched = self._constrain(dispatched)
+        out_e = self.experts(dispatched)
+        out_e = self._constrain(out_e)
+        y = jnp.einsum("tec,ecd->td", combine.astype(dtype), out_e)
+        y = y.reshape(orig_shape)
+        return (y, aux) if return_aux else y
+
+    def _constrain(self, t):
+        if self.mesh is not None and self.ep_world > 1:
+            try:
+                return jax.lax.with_sharding_constraint(
+                    t, NamedSharding(self.mesh, P(self.ep_axis)))
+            except ValueError:
+                return t
+        return t
+
+    # -- explicit / shard_map path -----------------------------------------
+    def forward_shard_map(self, x, w1, b1, w2, b2, return_aux: bool = False):
+        """Per-rank body for shard_map over the ep axis. x is the LOCAL
+        token shard [T_local, D]; w* are the LOCAL expert shards
+        [E_local, ...]. Communication is two explicit all-to-alls
+        (global_scatter/global_gather), the reference's dispatch exactly."""
+        combine, dispatch, aux = self.gate(x)
+        dtype = x.dtype
+        dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), x)
+        arrived = global_scatter(dispatched, self.ep_axis)
+        out_local = self.experts.apply(arrived, w1, b1, w2, b2)
+        returned = global_gather(out_local, self.ep_axis)
+        y = jnp.einsum("tec,ecd->td", combine.astype(dtype), returned)
+        return (y, aux) if return_aux else y

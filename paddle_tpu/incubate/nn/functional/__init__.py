@@ -178,3 +178,8 @@ def fused_bias_act(x, bias=None, act_method="gelu", dequant_scales=None,
         a, b = jnp.split(x, 2, axis=-1)
         return jax.nn.gelu(a) * b
     return _ACTS[act_method](x)
+
+
+from .fused_moe import fused_moe  # noqa: F401,E402
+
+__all__.append("fused_moe")

@@ -1,0 +1,57 @@
+"""Dropless fused MoE via grouped GEMM (reference:
+python/paddle/incubate/nn/functional/fused_moe.py + the cutlass grouped-GEMM
+kernels in paddle/phi/kernels/fusion/cutlass/moe/).
+
+TPU design: the reference's cutlass moe_gemm batches variable-sized expert
+GEMMs on GPU. The TPU-native equivalent is `lax.ragged_dot` (the megablox
+pattern): sort token rows by expert id, compute per-expert group sizes, and
+run ONE ragged matmul per projection — XLA tiles it onto the MXU with no
+capacity padding and no token dropping. Differentiable end-to-end (sort is
+a gather; ragged_dot has transpose rules).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fused_moe"]
+
+
+def fused_moe(x, gate_weight, w1, b1, w2, b2, top_k: int = 2,
+              activation=None, norm_topk_prob: bool = True):
+    """x [T, D] (or [B, S, D]); gate_weight [D, E]; w1 [E, D, F]; b1 [E, F];
+    w2 [E, F, D]; b2 [E, D]. Returns (out, router_probs)."""
+    if activation is None:
+        from ....nn.functional.activation import gelu as activation
+    orig_shape = x.shape
+    d_model = x.shape[-1]
+    xt = x.reshape(-1, d_model)
+    t = xt.shape[0]
+    num_experts = gate_weight.shape[1]
+
+    logits = jnp.asarray(xt, jnp.float32) @ jnp.asarray(
+        gate_weight, jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = lax.top_k(probs, top_k)  # [T, k]
+    if norm_topk_prob:
+        top_w = top_w / jnp.clip(top_w.sum(-1, keepdims=True), 1e-9)
+
+    flat_expert = top_i.reshape(-1)                    # [T*k]
+    order = jnp.argsort(flat_expert)                   # stable, static shape
+    token_of = order // top_k                          # source token rows
+    xs = jnp.take(xt, token_of, axis=0)                # [T*k, D] sorted
+    expert_sorted = jnp.take(flat_expert, order)
+    group_sizes = jnp.bincount(flat_expert, length=num_experts)
+
+    h = lax.ragged_dot(xs, jnp.asarray(w1, xs.dtype), group_sizes)
+    h = h + jnp.take(jnp.asarray(b1, xs.dtype), expert_sorted, axis=0)
+    h = activation(h)
+    y = lax.ragged_dot(h, jnp.asarray(w2, xs.dtype), group_sizes)
+    y = y + jnp.take(jnp.asarray(b2, xs.dtype), expert_sorted, axis=0)
+
+    w_sorted = jnp.take(top_w.reshape(-1), order).astype(y.dtype)
+    out = jnp.zeros((t, d_model), y.dtype).at[token_of].add(
+        y * w_sorted[:, None])
+    return out.reshape(orig_shape), probs

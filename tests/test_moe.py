@@ -1,0 +1,245 @@
+"""MoE / expert-parallel tests (reference pattern:
+test/collective/collective_global_scatter.py + moe unit tests — parity of
+the parallel dispatch against the dense single-device computation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import shard_map
+from paddle_tpu.distributed.topology import (CommunicateTopology,
+                                             HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+from paddle_tpu.distributed.utils.moe_utils import (global_gather,
+                                                    global_scatter)
+from paddle_tpu.incubate.distributed.models.moe import (
+    ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate, SwitchGate,
+    clip_by_global_norm_with_moe, compute_capacity)
+from paddle_tpu.incubate.nn.functional import fused_moe
+
+
+@pytest.fixture
+def hcg_dp8():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [8, 1, 1, 1, 1])
+    hcg = HybridCommunicateGroup(topo, global_rank=0)
+    set_hybrid_communicate_group(hcg)
+    yield hcg
+    set_hybrid_communicate_group(None)
+
+
+def _gate_invariants(combine, dispatch, t, e, c):
+    assert combine.shape == (t, e, c)
+    # every capacity slot holds at most one token
+    per_slot = np.asarray(dispatch).astype(np.int32).sum(axis=0)
+    assert per_slot.max() <= 1
+    # each token occupies at most top_k slots and weights sum <= 1 + eps
+    w_per_tok = np.asarray(combine).sum(axis=(1, 2))
+    assert (w_per_tok <= 1.0 + 1e-5).all()
+
+
+@pytest.mark.parametrize("gate_cls,kw", [
+    (NaiveGate, dict(top_k=2)),
+    (SwitchGate, dict()),
+    (GShardGate, dict()),
+])
+def test_gate_routing_invariants(gate_cls, kw):
+    t, d, e = 64, 16, 4
+    gate = gate_cls(d, e, **kw)
+    x = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+    combine, dispatch, aux = gate(x)
+    _gate_invariants(combine, dispatch, t, e, combine.shape[2])
+    assert np.isfinite(float(aux))
+    if gate_cls is not NaiveGate:
+        assert float(aux) > 0.0
+
+
+def test_capacity_drops_overflow():
+    t, e = 32, 4
+    cap = compute_capacity(t, e, 1, 1.0)  # 8 slots/expert
+    gate = SwitchGate(16, e, capacity_factor=1.0)
+    # all tokens identical → all route to one expert → only cap survive
+    x = jnp.ones((t, 16), jnp.float32)
+    combine, dispatch, _ = gate(x)
+    kept = int(np.asarray(dispatch).sum())
+    assert kept == cap
+
+
+def test_moe_layer_dense_math():
+    """Single-device MoELayer equals a hand-rolled per-token expert mix."""
+    t, d, f, e = 32, 8, 16, 4
+    layer = MoELayer(d, f, e, gate="naive", top_k=2, capacity_factor=8.0)
+    x = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (t, d)
+
+    combine, dispatch, _ = layer.gate(x)
+    w1, b1 = layer.experts.w1.value, layer.experts.b1.value
+    w2, b2 = layer.experts.w2.value, layer.experts.b2.value
+    # exact reference via einsum of the same factorization
+    disp = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", disp, w1) + b1[:, None, :])
+    oe = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    ref = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), oe)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_global_scatter_gather_roundtrip():
+    """shard_map all-to-all exchange is a permutation + its exact inverse."""
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("ep",))
+    e, cap, d = 8, 4, 6
+    x = jnp.asarray(np.random.randn(8, e, cap, d).astype(np.float32))
+
+    def body(xl):
+        xl = xl[0]  # [E, C, D] local
+        arrived = global_scatter(xl, "ep")
+        back = global_gather(arrived, "ep")
+        return back[None]
+
+    out = shard_map(body, mesh=mesh, in_specs=P("ep"),
+                    out_specs=P("ep"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_moe_ep_parity_auto_vs_shard_map(hcg_dp8):
+    """GSPMD einsum path == explicit global_scatter/gather path, with the
+    same weights, on the 8-way ep (dp-axis) mesh."""
+    t_per, d, f, e = 16, 8, 16, 8
+    layer = MoELayer(d, f, e, gate="naive", top_k=2, capacity_factor=8.0,
+                     ep_axis="dp")
+    assert layer.ep_world == 8
+    mesh = layer.mesh
+    t = t_per * 8
+    x = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+
+    @jax.jit
+    def auto(x):
+        return layer(x)
+
+    out_auto = auto(x)
+
+    w1 = layer.experts.w1.value
+    b1 = layer.experts.b1.value
+    w2 = layer.experts.w2.value
+    b2 = layer.experts.b2.value
+
+    def body(xl, w1l, b1l, w2l, b2l):
+        return layer.forward_shard_map(xl, w1l, b1l, w2l, b2l)
+
+    out_sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(("dp",)), P(("dp",)), P(("dp",)), P(("dp",)),
+                  P(("dp",))),
+        out_specs=P(("dp",)))(x, w1, b1, w2, b2)
+    # NOTE: shard_map path routes per-rank (local gate, local capacity) —
+    # with capacity large enough no token drops, and expert math is
+    # identical, so results match.
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_sm),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_moe_matches_einsum_moe():
+    """Dropless ragged_dot path == capacity path when nothing is dropped."""
+    t, d, f, e = 48, 8, 16, 4
+    layer = MoELayer(d, f, e, gate="naive", top_k=2, capacity_factor=8.0)
+    x = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+    out_cap = layer(x)
+    out_fused, probs = fused_moe(
+        x, layer.gate.weight.value, layer.experts.w1.value,
+        layer.experts.b1.value, layer.experts.w2.value,
+        layer.experts.b2.value, top_k=2)
+    assert probs.shape == (t, e)
+    np.testing.assert_allclose(np.asarray(out_cap), np.asarray(out_fused),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_moe_grad_flows():
+    d, f, e = 8, 16, 4
+    layer = MoELayer(d, f, e, gate="naive", top_k=2)
+    x = jnp.asarray(np.random.randn(12, d).astype(np.float32))
+
+    def loss(w1):
+        out, _ = fused_moe(x, layer.gate.weight.value, w1,
+                           layer.experts.b1.value, layer.experts.w2.value,
+                           layer.experts.b2.value, top_k=2)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(layer.experts.w1.value)
+    assert g.shape == (e, d, f)
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
+
+
+def test_moe_grad_clip():
+    grads = {"expert_w": jnp.ones((4, 8)), "shared_w": jnp.ones((8,))}
+    clipped, gnorm = clip_by_global_norm_with_moe(grads, 1.0)
+    expected = np.sqrt(4 * 8 + 8)
+    np.testing.assert_allclose(float(gnorm), expected, rtol=1e-6)
+    total = np.sqrt(sum(float(jnp.sum(v ** 2)) for v in clipped.values()))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    clip = ClipGradForMOEByGlobalNorm(1.0)
+    c2 = clip(grads)
+    np.testing.assert_allclose(np.asarray(c2["expert_w"]),
+                               np.asarray(clipped["expert_w"]), rtol=1e-6)
+
+
+def test_moe_training_step_decreases_loss():
+    """End-to-end: jit train step on MoELayer + aux loss decreases."""
+    t, d, f, e = 64, 8, 16, 4
+    layer = MoELayer(d, f, e, gate="gshard", capacity_factor=4.0)
+    params = {
+        "gate": layer.gate.weight.value,
+        "w1": layer.experts.w1.value, "b1": layer.experts.b1.value,
+        "w2": layer.experts.w2.value, "b2": layer.experts.b2.value,
+    }
+    x = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+    y = jnp.asarray(np.random.randn(t, d).astype(np.float32))
+
+    def loss_fn(p):
+        gate = GShardGate(d, e, capacity_factor=4.0)
+        gate.weight.value = p["gate"]
+        combine, dispatch, aux = gate(x)
+        disp = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", disp, p["w1"])
+                        + p["b1"][:, None, :])
+        oe = jnp.einsum("ecf,efd->ecd", h, p["w2"]) + p["b2"][:, None, :]
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), oe)
+        return jnp.mean((out - y) ** 2) + 0.01 * aux
+
+    @jax.jit
+    def step(p):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return l, jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    l0, params = step(params)
+    for _ in range(10):
+        l1, params = step(params)
+    assert float(l1) < float(l0)
+
+
+def test_moe_return_aux_under_jit():
+    """aux loss must come OUT of the jitted function, not via a stashed
+    tracer on the layer (code-review finding)."""
+    layer = MoELayer(8, 16, 4, gate="switch")
+    x = jnp.asarray(np.random.randn(16, 8).astype(np.float32))
+
+    @jax.jit
+    def fwd(x):
+        return layer(x, return_aux=True)
+
+    out, aux = fwd(x)
+    assert out.shape == (16, 8)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_switch_gate_jitter():
+    gate = SwitchGate(8, 4, jitter_eps=0.5)
+    x = jnp.asarray(np.random.randn(32, 8).astype(np.float32))
+    c1, _, _ = gate(x)
+    c2, _, _ = gate(x)  # fresh RNG key → different routing weights
+    assert not np.allclose(np.asarray(c1), np.asarray(c2))
