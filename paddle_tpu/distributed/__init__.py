@@ -17,6 +17,8 @@ from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                          barrier, batch_isend_irecv, broadcast, get_group,
                          new_group, ppermute, recv, reduce, reduce_scatter,
                          scatter, send)
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model
@@ -47,4 +49,6 @@ __all__ = [
     "DataParallel", "shard_batch",
     # zero / group sharded
     "sharding", "group_sharded_parallel", "save_group_sharded_model",
+    # checkpoint
+    "checkpoint", "save_state_dict", "load_state_dict",
 ]

@@ -1,0 +1,13 @@
+"""Distributed (sharding-aware) checkpoint with reshard-on-load
+(reference: python/paddle/distributed/checkpoint/ — SURVEY §2.9)."""
+
+from .load_state_dict import load_metadata, load_state_dict
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict, wait_async_save
+from .utils import flatten_state_dict, unflatten_state_dict
+
+__all__ = [
+    "save_state_dict", "load_state_dict", "wait_async_save", "load_metadata",
+    "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
+    "flatten_state_dict", "unflatten_state_dict",
+]

@@ -1,0 +1,126 @@
+"""Sharded checkpoint load with reshard-on-load (reference:
+python/paddle/distributed/checkpoint/load_state_dict.py:467 load_state_dict;
+rank→file assignment :75-279; chunk overlap computation :335).
+
+For every target tensor we look at its OWN sharding (each addressable shard's
+global index), intersect with the saved chunks from the metadata, read only
+the overlapping file regions, and assemble per-device buffers with
+`jax.make_array_from_single_device_arrays`. Saving and loading parallelism
+configs are therefore fully decoupled (e.g. save at dp=8, load at mp=4×dp=2).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from .metadata import LocalTensorIndex, Metadata
+from .utils import (chunk_name, chunk_overlap, flatten_state_dict,
+                    index_to_offset_shape, unflatten_state_dict)
+
+__all__ = ["load_state_dict", "load_metadata"]
+
+
+def load_metadata(path: str) -> Metadata:
+    with open(os.path.join(path, "0.metadata"), "rb") as f:
+        return pickle.load(f)
+
+
+class _FileCache:
+    """Lazy npz reads; each data file is opened at most once."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._open: Dict[str, np.lib.npyio.NpzFile] = {}
+
+    def chunk(self, fname: str, key: str, offset) -> np.ndarray:
+        if fname not in self._open:
+            self._open[fname] = np.load(os.path.join(self.path, fname))
+        return self._open[fname][chunk_name(key, offset)]
+
+
+def _assemble_region(key: str, offset, shape, dtype, md: Metadata,
+                     files: _FileCache) -> np.ndarray:
+    """Fill the [offset, offset+shape) region of tensor `key` from saved
+    chunks."""
+    out = np.zeros(shape, dtype=dtype)
+    covered = 0
+    for chunk in md.state_dict_metadata.get(key, []):
+        ov = chunk_overlap(offset, shape, chunk.global_offset,
+                           chunk.local_shape)
+        if ov is None:
+            continue
+        dst_sl, src_sl = ov
+        fname = md.storage_metadata[
+            LocalTensorIndex(key, chunk.global_offset)]
+        src = files.chunk(fname, key, chunk.global_offset)
+        out[dst_sl] = src[src_sl]
+        covered += int(np.prod([s.stop - s.start for s in dst_sl]))
+    need = int(np.prod(shape)) if shape else 1
+    if covered < need:
+        raise ValueError(
+            f"checkpoint chunk coverage incomplete for '{key}': region "
+            f"offset={offset} shape={shape} covered {covered}/{need} elements")
+    return out
+
+
+def load_state_dict(state_dict: Dict, path: str,
+                    process_mesh=None,
+                    coordinator_rank: int = 0) -> Dict:
+    """Load into the shapes/shardings described by `state_dict` (its values
+    are template arrays — their shardings define the target placement).
+    Returns the loaded (nested) state dict; dict entries are also replaced
+    in place so callers using the reference's mutate-in-place idiom work.
+    """
+    md = load_metadata(path)
+    files = _FileCache(path)
+    flat, mapping = flatten_state_dict(state_dict)
+    out_flat: Dict[str, object] = {}
+
+    for key, target in flat.items():
+        if key not in md.state_dict_metadata:
+            if key in md.misc:
+                out_flat[key] = md.misc[key]
+                continue
+            raise KeyError(f"'{key}' not present in checkpoint {path}")
+        if isinstance(target, jax.Array) and hasattr(target, "sharding"):
+            gshape = tuple(target.shape)
+            sharding = target.sharding
+            bufs = []
+            regions = {}  # (offset, shape) -> host buffer; replicas share it
+            for shard in target.addressable_shards:
+                offset, shape = index_to_offset_shape(shard.index, gshape)
+                host = regions.get((offset, shape))
+                if host is None:
+                    host = _assemble_region(key, offset, shape,
+                                            np.dtype(target.dtype), md, files
+                                            ).astype(target.dtype)
+                    regions[(offset, shape)] = host
+                bufs.append(jax.device_put(host, shard.device))
+            out_flat[key] = jax.make_array_from_single_device_arrays(
+                gshape, sharding, bufs)
+        else:
+            tgt = np.asarray(target)
+            host = _assemble_region(key, (0,) * tgt.ndim, tuple(tgt.shape),
+                                    tgt.dtype, md, files)
+            out_flat[key] = host
+
+    nested = unflatten_state_dict(out_flat, mapping)
+
+    from ...nn.layer.layers import Parameter
+
+    def _inplace(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                _inplace(dst[k], v)
+            elif isinstance(dst.get(k), Parameter):
+                dst[k].value = v  # keep the Parameter object live
+            else:
+                dst[k] = v
+    if isinstance(state_dict, dict):
+        _inplace(state_dict, nested)
+    return nested

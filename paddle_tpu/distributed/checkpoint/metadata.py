@@ -1,0 +1,46 @@
+"""Checkpoint metadata types (reference:
+python/paddle/distributed/checkpoint/metadata.py:20-41 —
+LocalTensorMetadata / LocalTensorIndex / Metadata).
+
+A distributed checkpoint is a set of data files (one per writing process)
+plus one metadata file describing, for every tensor key, which global-offset
+chunks exist and which file holds each chunk. Loading reshards by computing
+chunk↔target-shard overlaps, so the saving and loading parallelism configs
+are independent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """Shape/offset/dtype of one saved chunk of a global tensor."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of a chunk: (tensor key, global offset). Hashable — used as
+    the storage-map key."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # tensor key -> every chunk that exists for it (across all files)
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # chunk identity -> data file that holds it
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    # flattened key -> original nested key-path (for unflatten on load)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # non-tensor leaves (python scalars etc.) stored inline
+    misc: Dict[str, Any] = field(default_factory=dict)

@@ -1,0 +1,115 @@
+"""Checkpoint helpers: state-dict flattening and chunk-overlap math
+(reference: python/paddle/distributed/checkpoint/utils.py —
+flatten_state_dict / compute_local_shape_and_global_offset).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "flatten_state_dict", "unflatten_state_dict", "chunk_overlap",
+    "shard_chunks", "to_host", "chunk_name", "index_to_offset_shape",
+]
+
+
+def chunk_name(key: str, offset) -> str:
+    """On-disk name of one chunk inside a .distcp npz — the single source of
+    truth shared by save and load."""
+    return key + "|" + ",".join(str(o) for o in offset)
+
+
+def _unwrap(v):
+    from ...nn.layer.layers import Parameter
+    if isinstance(v, Parameter):
+        return v.value
+    return v
+
+
+def flatten_state_dict(state_dict: Dict) -> Tuple[Dict[str, Any],
+                                                  Dict[str, Tuple[str, ...]]]:
+    """Flatten a nested dict into {'a.b.c': leaf} plus a mapping back to the
+    original key path."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple[str, ...]] = {}
+
+    def rec(prefix: Tuple[str, ...], d):
+        for k, v in d.items():
+            path = prefix + (str(k),)
+            v = _unwrap(v)
+            if isinstance(v, dict):
+                rec(path, v)
+            else:
+                key = ".".join(path)
+                assert key not in flat, f"duplicate flattened key {key}"
+                flat[key] = v
+                mapping[key] = path
+    rec((), state_dict)
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any],
+                         mapping: Dict[str, Tuple[str, ...]]) -> Dict:
+    out: Dict = {}
+    for key, value in flat.items():
+        path = mapping.get(key, (key,))
+        d = out
+        for p in path[:-1]:
+            d = d.setdefault(p, {})
+        d[path[-1]] = value
+    return out
+
+
+def chunk_overlap(offset_a: Tuple[int, ...], shape_a: Tuple[int, ...],
+                  offset_b: Tuple[int, ...], shape_b: Tuple[int, ...]
+                  ) -> Optional[Tuple[Tuple[slice, ...], Tuple[slice, ...]]]:
+    """Intersect two nd-chunks of the same global tensor. Returns
+    (slices_into_a, slices_into_b) covering the overlap, or None if disjoint.
+    (reference: load_state_dict.py:335 overlap computation)"""
+    sl_a, sl_b = [], []
+    for oa, sa, ob, sb in zip(offset_a, shape_a, offset_b, shape_b):
+        lo = max(oa, ob)
+        hi = min(oa + sa, ob + sb)
+        if lo >= hi:
+            return None
+        sl_a.append(slice(lo - oa, hi - oa))
+        sl_b.append(slice(lo - ob, hi - ob))
+    return tuple(sl_a), tuple(sl_b)
+
+
+def index_to_offset_shape(index: Tuple[slice, ...],
+                          global_shape: Tuple[int, ...]):
+    """Convert a jax shard .index (tuple of slices into the global shape)
+    into (global_offset, local_shape)."""
+    offset, shape = [], []
+    for sl, dim in zip(index, global_shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        offset.append(int(start))
+        shape.append(int(stop - start))
+    return tuple(offset), tuple(shape)
+
+
+def shard_chunks(x: jax.Array):
+    """Yield (global_offset, local_shape, replica_id, device, shard) for each
+    addressable shard of a jax.Array. For a numpy array yields the single
+    full chunk with replica_id 0."""
+    if isinstance(x, jax.Array):
+        gshape = tuple(x.shape)
+        for shard in x.addressable_shards:
+            offset, shape = index_to_offset_shape(shard.index, gshape)
+            yield offset, shape, shard.replica_id, shard.device, shard
+    else:
+        arr = np.asarray(x)
+        yield (0,) * arr.ndim, tuple(arr.shape), 0, None, arr
+
+
+def to_host(x) -> np.ndarray:
+    if isinstance(x, jax.Array):
+        return np.asarray(jax.device_get(x))
+    if hasattr(x, "data"):  # jax Shard
+        return np.asarray(x.data)
+    return np.asarray(x)

@@ -34,9 +34,11 @@ def global_scatter(x, axis: str = "ep"):
     world = lax.psum(1, axis)
     e_global, cap, d = x.shape
     assert e_global % world == 0, (e_global, world)
-    # split dim 0 (experts) across ranks, concat arrivals on a new dim
-    y = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
-    # y: [world, e_local, capacity, d] (peer-major)
+    # tiled: dim 0 is split into `world` contiguous expert blocks (peer p owns
+    # experts [p*e_local, (p+1)*e_local)); arrivals concatenate peer-major on
+    # dim 0. Untiled would require e_global == world, breaking e_local > 1.
+    y = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+    # y: [world * e_local, capacity, d] (peer-major blocks)
     return y.reshape(world, e_global // world, cap, d).transpose(
         1, 0, 2, 3).reshape(e_global // world, world * cap, d)
 

@@ -106,6 +106,39 @@ def test_global_scatter_gather_roundtrip():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
 
 
+def test_global_scatter_gather_multiple_local_experts():
+    """e_global > world (e_local = 2): tiled all_to_all layout. Checks both
+    the roundtrip inverse and that scatter delivers each expert's tokens to
+    its owning rank."""
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("ep",))
+    world, e, cap, d = 8, 16, 2, 4
+    # encode (src_rank, global_expert) in the values so ownership is checkable
+    vals = np.zeros((world, e, cap, d), np.float32)
+    for r in range(world):
+        for ex in range(e):
+            vals[r, ex] = 100 * r + ex
+    x = jnp.asarray(vals)
+
+    def body(xl):
+        xl = xl[0]
+        arrived = global_scatter(xl, "ep")   # [e_local, world*cap, d]
+        assert arrived.shape == (e // world, world * cap, d)
+        back = global_gather(arrived, "ep")
+        return arrived[None], back[None]
+
+    arrived, back = shard_map(body, mesh=mesh, in_specs=P("ep"),
+                              out_specs=(P("ep"), P("ep")))(x)
+    np.testing.assert_allclose(np.asarray(back), vals, rtol=1e-6)
+    a = np.asarray(arrived)  # [world, e_local, world*cap, d]
+    for r in range(world):
+        for el in range(e // world):
+            g = r * (e // world) + el  # global expert id owned by rank r
+            blocks = a[r, el].reshape(world, cap, d)
+            for src in range(world):
+                np.testing.assert_allclose(blocks[src], 100 * src + g)
+
+
 def test_moe_ep_parity_auto_vs_shard_map(hcg_dp8):
     """GSPMD einsum path == explicit global_scatter/gather path, with the
     same weights, on the 8-way ep (dp-axis) mesh."""
