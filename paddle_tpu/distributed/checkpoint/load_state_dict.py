@@ -42,6 +42,11 @@ class _FileCache:
             self._open[fname] = np.load(os.path.join(self.path, fname))
         return self._open[fname][chunk_name(key, offset)]
 
+    def close(self):
+        for f in self._open.values():
+            f.close()
+        self._open.clear()
+
 
 def _assemble_region(key: str, offset, shape, dtype, md: Metadata,
                      files: _FileCache) -> np.ndarray:
@@ -78,6 +83,14 @@ def load_state_dict(state_dict: Dict, path: str,
     """
     md = load_metadata(path)
     files = _FileCache(path)
+    try:
+        return _load_impl(state_dict, md, files)
+    finally:
+        files.close()
+
+
+def _load_impl(state_dict, md, files):
+    path = files.path
     flat, mapping = flatten_state_dict(state_dict)
     out_flat: Dict[str, object] = {}
 

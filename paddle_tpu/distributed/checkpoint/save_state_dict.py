@@ -28,13 +28,20 @@ from .utils import chunk_name, flatten_state_dict, shard_chunks, to_host
 __all__ = ["save_state_dict", "wait_async_save"]
 
 _PENDING: List[threading.Thread] = []
+_ASYNC_ERRORS: List[BaseException] = []
 
 
 def wait_async_save() -> None:
-    """Block until all in-flight async checkpoint writes complete."""
+    """Block until all in-flight async checkpoint writes complete. Re-raises
+    the first writer failure — a silently missing checkpoint must not look
+    like success."""
     while _PENDING:
         t = _PENDING.pop()
         t.join()
+    if _ASYNC_ERRORS:
+        err = _ASYNC_ERRORS[0]
+        _ASYNC_ERRORS.clear()
+        raise RuntimeError("async checkpoint save failed") from err
 
 
 atexit.register(wait_async_save)
@@ -91,6 +98,8 @@ def save_state_dict(state_dict: Dict, path: str,
                 value, "addressable_shards"):
             misc[key] = value
             continue
+        if not isinstance(value, jax.Array) and proc != coordinator_rank:
+            continue  # host numpy leaf: replicated everywhere; rank 0 writes
         entries = []
         for offset, shape, replica_id, _dev, shard in shard_chunks(value):
             if replica_id != 0:
@@ -121,7 +130,12 @@ def save_state_dict(state_dict: Dict, path: str,
                 pickle.dump(md, f)
 
     if async_save and jax.process_count() == 1:
-        t = threading.Thread(target=write_files, daemon=False)
+        def guarded():
+            try:
+                write_files()
+            except BaseException as e:  # surfaced by wait_async_save
+                _ASYNC_ERRORS.append(e)
+        t = threading.Thread(target=guarded, daemon=False)
         _PENDING.append(t)
         t.start()
     else:

@@ -6,6 +6,19 @@ Populated incrementally: layers/ (TP), utils/ (SP), recompute/, meta_parallel/
 (pipeline, sharding). The top-level fleet API object lives in fleet.py.
 """
 
-from . import layers, meta_parallel, recompute, utils  # noqa: F401
+from . import layers, meta_optimizers, meta_parallel, recompute, utils  # noqa: F401
+from .distributed_strategy import DistributedStrategy
+from .fleet import (Fleet, collective_perf, distributed_model,
+                    distributed_optimizer, fleet,
+                    get_hybrid_communicate_group, init)
+from .meta_optimizers import (HybridParallelClipGrad, HybridParallelGradScaler,
+                              HybridParallelOptimizer)
 
-__all__ = ["layers", "meta_parallel", "recompute", "utils"]
+# make `fleet.init(...)` work both as `from paddle_tpu.distributed import
+# fleet` (module with these names) and `fleet.fleet.init` (singleton).
+__all__ = ["layers", "meta_parallel", "meta_optimizers", "recompute", "utils",
+           "DistributedStrategy", "Fleet", "fleet", "init",
+           "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group", "collective_perf",
+           "HybridParallelOptimizer", "HybridParallelClipGrad",
+           "HybridParallelGradScaler"]

@@ -1,0 +1,124 @@
+"""DistributedStrategy (reference:
+python/paddle/distributed/fleet/base/distributed_strategy.py wrapping
+paddle/fluid/framework/distributed_strategy.proto — HybridConfig at :104,
+sharding :42-59, mp async-allreduce :64-78, pp overlap :82-91).
+
+The reference stores strategy in a protobuf so it can cross the Python/C++
+boundary into static-graph passes. Here the whole stack is Python driving
+XLA, so a plain validated object suffices; dict-style setters keep the
+reference's `strategy.hybrid_configs = {...}` idiom working.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+
+_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    "hybrid_configs": {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sep_degree": 1,
+        # reference order string, e.g. ["dp","pp","sharding","sep","mp"]
+        "order": ["dp", "pp", "sharding", "sep", "mp"],
+    },
+    "pipeline_configs": {
+        "micro_batch_size": 1,
+        "accumulate_steps": 1,
+        "schedule_mode": "1F1B",
+    },
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "use_dynamic_loss_scaling": True,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.5,
+        "use_pure_fp16": False,
+        "use_pure_bf16": False,
+        "custom_white_list": [],
+        "custom_black_list": [],
+    },
+    "sharding_configs": {
+        "stage": 1,
+        "split_param": False,
+        "comm_overlap": True,
+        "offload": False,
+    },
+    "recompute_configs": {
+        "checkpoints": [],
+        "enable_offload": False,
+    },
+    "gradient_merge_configs": {
+        "k_steps": 1,
+        "avg": True,
+    },
+    "tensor_parallel_configs": {
+        "tensor_parallel_degree": 1,
+        "tensor_init_seed": -1,
+    },
+}
+
+_SWITCHES = ("amp", "recompute", "pipeline", "sharding", "gradient_merge",
+             "sequence_parallel", "bf16", "fuse_all_reduce_ops",
+             "find_unused_parameters", "heter_ccl_mode", "without_graph_optimization")
+
+
+class DistributedStrategy:
+    def __init__(self):
+        for k, v in _DEFAULTS.items():
+            object.__setattr__(self, "_" + k, copy.deepcopy(v))
+        for s in _SWITCHES:
+            object.__setattr__(self, s, False)
+
+    # dict-merge setters: unknown keys rejected (the reference warns and
+    # drops them; rejecting catches typos in ported configs earlier).
+    def _merge(self, name: str, value: Dict[str, Any]):
+        cfg = getattr(self, "_" + name)
+        for k, v in value.items():
+            if k not in cfg:
+                raise KeyError(f"{name}: unknown key '{k}' "
+                               f"(valid: {sorted(cfg)})")
+            cfg[k] = v
+
+    def _make_cfg_property(name):  # noqa: N805
+        def getter(self):
+            return getattr(self, "_" + name)
+
+        def setter(self, value: Dict[str, Any]):
+            self._merge(name, value)
+        return property(getter, setter)
+
+    hybrid_configs = _make_cfg_property("hybrid_configs")
+    pipeline_configs = _make_cfg_property("pipeline_configs")
+    amp_configs = _make_cfg_property("amp_configs")
+    sharding_configs = _make_cfg_property("sharding_configs")
+    recompute_configs = _make_cfg_property("recompute_configs")
+    gradient_merge_configs = _make_cfg_property("gradient_merge_configs")
+    tensor_parallel_configs = _make_cfg_property("tensor_parallel_configs")
+    del _make_cfg_property
+
+    # --- derived views -----------------------------------------------------
+    def mesh_dims(self) -> Dict[str, int]:
+        """{axis: degree} in the configured order, for build_mesh."""
+        h = self._hybrid_configs
+        deg = {"dp": h["dp_degree"], "pp": h["pp_degree"],
+               "sharding": h["sharding_degree"], "sep": h["sep_degree"],
+               "mp": h["mp_degree"]}
+        order = list(h["order"])
+        assert sorted(order) == sorted(deg), f"bad hybrid order {order}"
+        return {a: int(deg[a]) for a in order}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k in _DEFAULTS:
+            lines.append(f"  {k}={getattr(self, '_' + k)!r},")
+        lines.append("  switches={" + ", ".join(
+            f"{s}={getattr(self, s)}" for s in _SWITCHES if getattr(self, s))
+            + "})")
+        return "\n".join(lines)

@@ -1,0 +1,6 @@
+from .hybrid_parallel_optimizer import (HybridParallelClipGrad,
+                                        HybridParallelGradScaler,
+                                        HybridParallelOptimizer)
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad",
+           "HybridParallelGradScaler"]

@@ -80,10 +80,17 @@ class _ShardedModelBase:
     def build_train_step(self, loss_fn, data_axes=("dp", "sharding")):
         """Functional ZeRO train step for this wrapper's level."""
         from ....sharding.group_sharded import build_sharded_train_step
-        level = {2: "os_g", 3: "p_g_os"}[self.stage]
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}[self.stage]
         return build_sharded_train_step(
             loss_fn, self._optimizer, self._mesh, level=level,
             data_axes=data_axes, shard_axis=self._axis)
+
+
+class GroupShardedStage1(_ShardedModelBase):
+    """Stage-1 (optimizer state only) wrapper — the reference reaches this
+    via DygraphShardingOptimizer without a model wrapper; fleet's
+    distributed_model keeps a wrapper for a uniform surface."""
+    stage = 1
 
 
 class GroupShardedStage2(_ShardedModelBase):

@@ -12,13 +12,17 @@ from .layer.container import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue, clip_grad_norm_, global_norm)
 
 from .layer import (activation, common, container, conv, loss, norm, pooling,
                     transformer)
 
 __all__ = (
     ["Layer", "Parameter", "functional_call", "functional_train_graph",
-     "ParamAttr", "functional", "initializer"]
+     "ParamAttr", "functional", "initializer", "ClipGradByValue",
+     "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_",
+     "global_norm"]
     + list(common.__all__) + list(conv.__all__) + list(norm.__all__)
     + list(activation.__all__) + list(container.__all__)
     + list(pooling.__all__) + list(loss.__all__) + list(transformer.__all__)

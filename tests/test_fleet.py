@@ -1,0 +1,113 @@
+"""Fleet facade tests (reference analog: fleet.init/distributed_model/
+distributed_optimizer usage in test/collective/fleet/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                          HybridParallelClipGrad,
+                                          HybridParallelOptimizer)
+
+
+def test_strategy_defaults_and_merge():
+    s = DistributedStrategy()
+    assert s.hybrid_configs["dp_degree"] == 1
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    assert s.hybrid_configs["dp_degree"] == 2
+    assert s.hybrid_configs["mp_degree"] == 4
+    assert s.hybrid_configs["pp_degree"] == 1  # untouched default
+    with pytest.raises(KeyError):
+        s.hybrid_configs = {"dp_degre": 2}  # typo rejected
+    assert s.mesh_dims() == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1,
+                             "mp": 4}
+
+
+def test_fleet_init_builds_hcg():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert set(hcg.mesh.axis_names) == {"dp", "pp", "sharding", "sep", "mp"}
+    assert fleet.fleet.is_initialized()
+
+
+def test_fleet_init_defaults_to_pure_dp():
+    fleet.init(is_collective=True)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == len(jax.devices())
+
+
+def test_distributed_model_dp_and_optimizer():
+    fleet.init(is_collective=True)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    dmodel = fleet.distributed_model(model)
+    out = dmodel(jnp.ones((8, 8)))
+    assert out.shape == (8, 2)
+
+    opt = paddle.optimizer.AdamW(
+        0.01, parameters=model.parameters(),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    dopt = fleet.distributed_optimizer(opt)
+    assert isinstance(dopt, HybridParallelOptimizer)
+    assert isinstance(opt._grad_clip, HybridParallelClipGrad)
+
+
+def test_distributed_model_sharding_mode():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"sharding_degree": 8}
+    s.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=s)
+    model = nn.Linear(8, 8)
+    wrapped = fleet.distributed_model(model)
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded_stage import (
+        GroupShardedStage2)
+    assert isinstance(wrapped, GroupShardedStage2)
+
+
+def test_hybrid_clip_grad_matches_global_norm():
+    clip = HybridParallelClipGrad(clip_norm=1.0)
+    g = {"a": jnp.full((4,), 3.0), "b": jnp.full((2, 2), -4.0)}
+    clipped = clip(g)
+    total = np.sqrt(sum(np.sum(np.square(np.asarray(v)))
+                        for v in g.values()))
+    for k in g:
+        np.testing.assert_allclose(np.asarray(clipped[k]),
+                                   np.asarray(g[k]) / total, rtol=1e-5)
+    # under the norm → untouched
+    g2 = {"a": jnp.full((2,), 1e-3)}
+    out2 = clip(g2)
+    np.testing.assert_allclose(np.asarray(out2["a"]), np.asarray(g2["a"]))
+
+
+def test_hybrid_optimizer_functional_core_jits():
+    fleet.init(is_collective=True)
+    opt = paddle.optimizer.AdamW(
+        0.01, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    dopt = fleet.distributed_optimizer(opt)
+    params = {"w": jnp.ones((4, 4))}
+    state = dopt.init_state(params)
+
+    @jax.jit
+    def step(p, s):
+        g = {"w": jnp.full((4, 4), 2.0)}
+        return dopt.apply(p, g, s, 0.1)
+
+    p2, s2 = step(params, state)
+    assert not np.allclose(np.asarray(p2["w"]), 1.0)
+    assert int(s2["step"]) == 1
+
+
+@pytest.mark.parametrize("op", ["allreduce", "allgather", "reduce_scatter",
+                                "broadcast", "alltoall"])
+def test_collective_perf_runs(op):
+    res = fleet.collective_perf(op, round=2, size_and_time={1: 0.0})
+    assert set(res) == {1}
+    assert res[1] > 0
