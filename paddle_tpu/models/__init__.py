@@ -1,0 +1,10 @@
+"""Model families (reference: the GPT and Llama models exercised by the
+hybrid-parallel and semi-auto-parallel test suites, plus paddle.vision for
+the conv families)."""
+
+from . import gpt, hybrid_engine, llama  # noqa: F401
+from .gpt import GPT, GPTConfig  # noqa: F401
+from .llama import Llama, LlamaConfig  # noqa: F401
+
+__all__ = ["gpt", "llama", "hybrid_engine", "GPT", "GPTConfig", "Llama",
+           "LlamaConfig"]

@@ -21,13 +21,12 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
@@ -365,50 +364,14 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
     """
-    from ..utils import shard_map as _shard_map
+    from .hybrid_engine import build_train_step
 
-    specs = hybrid_param_specs(cfg)
-    state_slot_specs = jax.tree.map(lambda s: s, specs)  # same layout per slot
+    def loss_fn(p, tokens, labels):
+        return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                              dp_axis, pp_axis, mp_axis)
 
-    def shard_params(params):
-        return jax.tree.map(
-            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-            params, specs)
-
-    def init_state(params):
-        # zeros_like under jit preserves input shardings
-        return jax.jit(optimizer.init_state)(params)
-
-    data_spec = P(dp_axis)
-
-    def local_step(params, opt_state, tokens, labels, lr):
-        def loss_fn(p):
-            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
-                                  dp_axis, pp_axis, mp_axis)
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # dp gradient reduction (the EagerReducer equivalent — one pmean,
-        # fused and overlapped by XLA)
-        reduce_axes = (dp_axis,) + tuple(extra_grad_axes)
-        grads = jax.tree.map(
-            lambda g: lax.pmean(g, reduce_axes), grads)
-        new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
-        return new_params, new_state, loss
-
-    def spec_tree_like(tree, leaf_spec_tree):
-        return jax.tree.map(lambda _: leaf_spec_tree, tree)
-
-    # optimizer state: {"step": P(), "slots": {param-path: {slot: spec}}}
-    def state_specs(params):
-        slots = jax.tree.map(
-            lambda s: s, specs)
-        return {"step": P(),
-                "slots": jax.tree.map(lambda s: {"moment1": s, "moment2": s},
-                                      specs, is_leaf=lambda x: isinstance(x, P))}
-
-    sspec = state_specs(None)
-
-    step = _shard_map(
-        local_step, mesh=mesh,
-        in_specs=(specs, sspec, data_spec, data_spec, P()),
-        out_specs=(specs, sspec, P()))
-    return jax.jit(step), shard_params, init_state
+    example = jax.eval_shape(
+        lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    return build_train_step(loss_fn, hybrid_param_specs(cfg), mesh, optimizer,
+                            dp_axis=dp_axis, extra_grad_axes=extra_grad_axes,
+                            example_params=example)

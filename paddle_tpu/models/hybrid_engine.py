@@ -1,0 +1,77 @@
+"""Generic hybrid-parallel train-step builder shared by the model families.
+
+Compiles ONE program containing: forward (vocab-parallel embed, pipelined
+blocks, TP collectives), backward, dp gradient pmean, and the optimizer
+update — the TPU-native equivalent of the reference's per-strategy wrapper
+stack (fleet/meta_parallel/*). Model files supply a per-device loss_fn and a
+PartitionSpec tree; XLA schedules every collective over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import shard_map as _shard_map
+
+__all__ = ["build_train_step", "state_specs_for"]
+
+
+def state_specs_for(optimizer, specs, example_params=None):
+    """Sharding specs for the optimizer state pytree: every slot inherits its
+    parameter's spec (this is what makes ZeRO composition free — sharding the
+    slot tree IS sharding the optimizer).
+
+    Slot structure can be dtype-dependent (e.g. AdamW multi_precision adds a
+    'master' slot for non-fp32 params), so when example_params is given the
+    structure is derived exactly via eval_shape; the fp32 probe is only the
+    no-params fallback."""
+    is_spec = lambda x: isinstance(x, P)
+    if example_params is not None:
+        state_shape = jax.eval_shape(optimizer.init_state, example_params)
+        slots = jax.tree.map(lambda s, sd: {n: s for n in sd},
+                             specs, state_shape["slots"], is_leaf=is_spec)
+    else:
+        slot_names = list(optimizer._init_slot(
+            jnp.zeros((2,), jnp.float32)).keys())
+        slots = jax.tree.map(lambda s: {n: s for n in slot_names}, specs,
+                             is_leaf=is_spec)
+    return {"step": P(), "slots": slots}
+
+
+def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
+                     optimizer, data_spec: P = None, dp_axis: str = "dp",
+                     extra_grad_axes=(), example_params=None):
+    """loss_fn(params, tokens, labels) -> scalar, running per-device inside
+    shard_map. Returns (jitted_step, shard_params, init_state)."""
+    data_spec = P(dp_axis) if data_spec is None else data_spec
+    sspec = state_specs_for(optimizer, specs, example_params)
+
+    def shard_params(params):
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            params, specs)
+
+    def init_state(params):
+        # zeros_like under jit preserves input shardings
+        return jax.jit(optimizer.init_state)(params)
+
+    def local_step(params, opt_state, tokens, labels, lr):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, labels))(params)
+        # dp gradient reduction (the EagerReducer equivalent — one pmean,
+        # fused and overlapped by XLA)
+        reduce_axes = (dp_axis,) + tuple(extra_grad_axes)
+        grads = jax.tree.map(lambda g: lax.pmean(g, reduce_axes), grads)
+        new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
+        return new_params, new_state, loss
+
+    step = _shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, sspec, data_spec, data_spec, P()),
+        out_specs=(specs, sspec, P()))
+    return jax.jit(step), shard_params, init_state

@@ -1,0 +1,377 @@
+"""Llama model family (reference: the Llama model exercised by semi-auto
+parallel tests — test/auto_parallel/hybrid_strategy/
+semi_auto_parallel_llama_model.py:93 LlamaAttentionAuto/LlamaMLPAuto/
+LlamaRMSNormAuto; BASELINE config 5 Llama-2 7B).
+
+Same two-execution design as gpt.py:
+
+* ``Llama`` — eager nn.Layer (RMSNorm pre-norm, RoPE, GQA attention, SwiGLU
+  MLP, untied vocab head) for single-device / GSPMD-auto use.
+
+* hybrid engine — stacked-parameter functional form for explicit SPMD:
+  vocab-parallel embedding + Megatron TP in every block over 'mp', scan +
+  ppermute pipeline over 'pp' (spmd_pipeline), built into one program by
+  models.hybrid_engine.build_train_step.
+
+GQA under TP: q heads and kv heads are both sharded contiguously over 'mp';
+rank r holds q heads [r·nh/mp, …) and kv heads [r·nkv/mp, …), and q head i
+attends kv head i // (nh/nkv), so the grouping never crosses ranks as long
+as num_kv_heads % mp == 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import spmd_pipeline
+from .gpt import _vocab_parallel_ce, _vocab_parallel_embed
+
+__all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama2_7b", "llama2_13b",
+           "llama3_8b", "init_hybrid_params", "hybrid_param_specs",
+           "hybrid_loss_fn", "build_hybrid_train_step", "dense_forward",
+           "dense_loss"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None → MHA
+    intermediate_size: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            # Llama sizing: 2/3 · 4H rounded up to a multiple of 256
+            self.intermediate_size = 256 * math.ceil(8 * self.hidden_size
+                                                     / 3 / 256)
+        assert self.hidden_size % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                       num_heads=4, num_kv_heads=2, intermediate_size=256,
+                       max_seq_len=256, **kw)
+
+
+def llama2_7b(**kw):
+    return LlamaConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                       intermediate_size=11008, **kw)
+
+
+def llama2_13b(**kw):
+    return LlamaConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                       intermediate_size=13824, **kw)
+
+
+def llama3_8b(**kw):
+    return LlamaConfig(vocab_size=128256, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                       max_seq_len=8192, rope_theta=500000.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# RoPE helpers (NeoX half-split convention, matching incubate fused_rope)
+# ---------------------------------------------------------------------------
+def rope_tables(cfg: LlamaConfig, seq_len: int):
+    inv = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
+    ang = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)  # [S, D/2]
+
+
+def _rope(x, cos, sin):
+    """x: [B, S, h, D] — rotate the half-split pairs."""
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _gqa_attention(q, k, v):
+    """Causal GQA attention. q: [B, S, hq, D], k/v: [B, S, hkv, D]."""
+    B, S, hq, D = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(B, S, hkv, g, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) / math.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, S, hq, D)
+
+
+# ---------------------------------------------------------------------------
+# Eager nn.Layer form
+# ---------------------------------------------------------------------------
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        H, D = cfg.hidden_size, cfg.head_dim
+        self.q_proj = nn.Linear(H, cfg.num_heads * D, bias_attr=False)
+        self.k_proj = nn.Linear(H, cfg.num_kv_heads * D, bias_attr=False)
+        self.v_proj = nn.Linear(H, cfg.num_kv_heads * D, bias_attr=False)
+        self.o_proj = nn.Linear(cfg.num_heads * D, H, bias_attr=False)
+
+    def forward(self, x, cos, sin):
+        cfg = self.cfg
+        B, S, H = x.shape
+        q = self.q_proj(x).reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+        # expand kv groups and ride the registry attention (Pallas flash
+        # kernel on TPU) instead of materializing S x S logits
+        g = cfg.num_heads // cfg.num_kv_heads
+        out = F.scaled_dot_product_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+            is_causal=True)
+        return self.o_proj(out.reshape(B, S, -1))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        H, I = cfg.hidden_size, cfg.intermediate_size
+        self.gate_proj = nn.Linear(H, I, bias_attr=False)
+        self.up_proj = nn.Linear(H, I, bias_attr=False)
+        self.down_proj = nn.Linear(I, H, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class Llama(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, tokens):
+        cfg = self.cfg
+        cos, sin = rope_tables(cfg, tokens.shape[1])
+        x = self.embed_tokens(tokens).astype(cfg.dtype)
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        x = self.norm(x)
+        return self.lm_head(x.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Hybrid (explicit SPMD) form: stacked params + shard_map engine
+# ---------------------------------------------------------------------------
+def init_hybrid_params(cfg: LlamaConfig, key) -> Dict[str, Any]:
+    H, L, I, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                  cfg.vocab_size)
+    D, nkv = cfg.head_dim, cfg.num_kv_heads
+    k = jax.random.split(key, 9)
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def nrm(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(pd)
+
+    return {
+        "wte": nrm(k[0], (V, H)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, H), pd),
+            "q_w": nrm(k[1], (L, H, H)),
+            "k_w": nrm(k[2], (L, H, nkv * D)),
+            "v_w": nrm(k[3], (L, H, nkv * D)),
+            "o_w": nrm(k[4], (L, H, H), std / math.sqrt(2 * L)),
+            "ln2_g": jnp.ones((L, H), pd),
+            "gate_w": nrm(k[5], (L, H, I)),
+            "up_w": nrm(k[6], (L, H, I)),
+            "down_w": nrm(k[7], (L, I, H), std / math.sqrt(2 * L)),
+        },
+        "lnf_g": jnp.ones((H,), pd),
+        "head_w": nrm(k[8], (H, V)),  # own key: head is untied from wte
+    }
+
+
+def hybrid_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Blocks stacked-L over 'pp'; Megatron column/row shardings over 'mp';
+    vocab-parallel embedding + head."""
+    return {
+        "wte": P("mp", None),
+        "blocks": {
+            "ln1_g": P("pp"),
+            "q_w": P("pp", None, "mp"),
+            "k_w": P("pp", None, "mp"),
+            "v_w": P("pp", None, "mp"),
+            "o_w": P("pp", "mp", None),
+            "ln2_g": P("pp"),
+            "gate_w": P("pp", None, "mp"),
+            "up_w": P("pp", None, "mp"),
+            "down_w": P("pp", "mp", None),
+        },
+        "lnf_g": P(),
+        "head_w": P(None, "mp"),
+    }
+
+
+def _rms(x, g, eps):
+    xf = x.astype(jnp.float32)
+    return (xf * lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True)
+                           + eps)).astype(x.dtype) * g
+
+
+def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp"):
+    """One decoder layer with explicit Megatron TP (inside shard_map).
+    Column shards hold complete heads: q_w's out dim is head-major [hq·D],
+    k_w/v_w's is [hkv·D] — contiguous mp shards keep q-head↔kv-head groups
+    rank-local (see module docstring)."""
+    mp = lax.axis_size(mp_axis)
+    hq, hkv = cfg.num_heads // mp, cfg.num_kv_heads // mp
+    B, S, H = x.shape
+    cd = cfg.dtype
+    from ..distributed.fleet.layers.mpu import mp_ops
+
+    h = _rms(x, p["ln1_g"], cfg.rms_eps)
+    hi = mp_ops.c_identity(h, mp_axis).astype(cd)
+    q = (hi @ p["q_w"].astype(cd)).reshape(B, S, hq, cfg.head_dim)
+    kk = (hi @ p["k_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
+    vv = (hi @ p["v_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
+    q, kk = _rope(q, cos, sin), _rope(kk, cos, sin)
+    attn = _gqa_attention(q, kk, vv).reshape(B, S, H // mp)
+    out = attn @ p["o_w"].astype(cd)  # row-parallel
+    x = x + mp_ops.mp_allreduce(out, mp_axis)
+
+    h = _rms(x, p["ln2_g"], cfg.rms_eps)
+    hi = mp_ops.c_identity(h, mp_axis).astype(cd)
+    m = jax.nn.silu((hi @ p["gate_w"].astype(cd)).astype(jnp.float32)
+                    ).astype(cd) * (hi @ p["up_w"].astype(cd))
+    m = m @ p["down_w"].astype(cd)  # row-parallel
+    return x + mp_ops.mp_allreduce(m, mp_axis)
+
+
+def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
+    """Single-device forward over the stacked pytree (no collectives); same
+    math/layout as the hybrid engine."""
+    cos, sin = rope_tables(cfg, tokens.shape[1])
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    cd = cfg.dtype
+
+    def block(p, x):
+        B, S, H = x.shape
+        h = _rms(x, p["ln1_g"], cfg.rms_eps).astype(cd)
+        q = (h @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
+                                              cfg.head_dim)
+        k = (h @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                              cfg.head_dim)
+        v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                              cfg.head_dim)
+        q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+        x = x + _gqa_attention(q, k, v).reshape(B, S, H) @ p["o_w"].astype(cd)
+        h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
+        m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
+                        ).astype(cd) * (h @ p["up_w"].astype(cd))
+        return x + m @ p["down_w"].astype(cd)
+
+    blk = jax.checkpoint(block) if remat else block
+
+    def body(carry, p):
+        return blk(p, carry), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _rms(x, params["lnf_g"], cfg.rms_eps)
+    return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+
+
+def dense_loss(params, tokens, labels, cfg: LlamaConfig):
+    logits = dense_forward(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
+                   num_microbatches: int, dp_axis="dp", pp_axis="pp",
+                   mp_axis="mp"):
+    """Per-device loss of the full hybrid Llama (inside shard_map)."""
+    b_local, S = tokens.shape
+    M = num_microbatches
+    assert b_local % M == 0, (b_local, M)
+    cos, sin = rope_tables(cfg, S)
+    x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
+    x = x.astype(cfg.dtype)
+    x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
+
+    def stage_fn(block_params, h):
+        def body(carry, p):
+            return _block_fn(p, carry, cos, sin, cfg, mp_axis), None
+        out, _ = lax.scan(body, h, block_params)
+        return out
+
+    out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+    out = out.reshape(b_local, S, cfg.hidden_size)
+    out = _rms(out, params["lnf_g"], cfg.rms_eps)
+    from ..distributed.fleet.layers.mpu import mp_ops
+    out = mp_ops.c_identity(out, mp_axis)  # column-parallel head
+    logits_local = out.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+    loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
+    total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    return lax.pmean(total, dp_axis)
+
+
+def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
+                            num_microbatches: int = 1, dp_axis="dp",
+                            pp_axis="pp", mp_axis="mp", extra_grad_axes=()):
+    from .hybrid_engine import build_train_step
+
+    def loss_fn(p, tokens, labels):
+        return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                              dp_axis, pp_axis, mp_axis)
+
+    example = jax.eval_shape(
+        lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    return build_train_step(loss_fn, hybrid_param_specs(cfg), mesh, optimizer,
+                            dp_axis=dp_axis, extra_grad_axes=extra_grad_axes,
+                            example_params=example)

@@ -1,0 +1,117 @@
+"""Llama family tests: eager forward, GQA correctness, RoPE properties,
+hybrid dp x pp x mp loss parity vs dense, train-step convergence
+(reference analog: test/auto_parallel/hybrid_strategy/
+semi_auto_parallel_llama_model.py acc-align tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import llama as L
+
+
+CFG = L.LlamaConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                    num_kv_heads=2, intermediate_size=48, max_seq_len=16,
+                    dtype=jnp.float32)
+
+
+def test_config_defaults():
+    cfg = L.llama2_7b()
+    assert cfg.intermediate_size == 11008
+    assert cfg.num_kv_heads == 32
+    cfg3 = L.llama3_8b()
+    assert cfg3.num_kv_heads == 8 and cfg3.rope_theta == 500000.0
+
+
+def test_eager_forward_shape_and_loss():
+    model = L.Llama(CFG)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+    logits = model(tokens)
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_gqa_equals_mha_with_repeated_kv():
+    """GQA must equal full MHA where kv heads are repeated group-wise."""
+    rng = np.random.RandomState(1)
+    B, S, hq, hkv, D = 2, 8, 4, 2, 6
+    q = jnp.asarray(rng.randn(B, S, hq, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, hkv, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, hkv, D).astype(np.float32))
+    out = L._gqa_attention(q, k, v)
+    k_full = jnp.repeat(k, hq // hkv, axis=2)
+    v_full = jnp.repeat(v, hq // hkv, axis=2)
+    ref = L._gqa_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_rope_preserves_norm_and_relative_position():
+    cos, sin = L.rope_tables(CFG, 16)
+    x = jnp.asarray(np.random.RandomState(2).randn(1, 16, 2, 8)
+                    .astype(np.float32))
+    r = L._rope(x, cos, sin)
+    # rotation preserves pairwise norms
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(r), axis=-1),
+                               np.linalg.norm(np.asarray(x), axis=-1),
+                               rtol=1e-5)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]),
+                               atol=1e-6)
+
+
+def test_dense_forward_matches_eager_math():
+    """Stacked dense_forward is finite & shaped; loss strictly below uniform
+    upper bound for a trained direction sanity check."""
+    params = L.init_hybrid_params(CFG, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    labels = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    loss = float(L.dense_loss(params, tokens, labels, CFG))
+    assert np.isfinite(loss)
+    assert abs(loss - np.log(64)) < 1.0  # near-uniform at init
+
+
+@pytest.fixture
+def setup():
+    mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    params = L.init_hybrid_params(CFG, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, (8, 16)))
+    labels = jnp.asarray(rng.randint(0, CFG.vocab_size, (8, 16)))
+    return mesh, params, tokens, labels
+
+
+def test_hybrid_loss_matches_dense(setup):
+    mesh, params, tokens, labels = setup
+    from paddle_tpu.utils import shard_map
+
+    def local(params, tokens, labels):
+        return L.hybrid_loss_fn(params, tokens, labels, CFG,
+                                num_microbatches=2)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(L.hybrid_param_specs(CFG), P("dp"), P("dp")),
+                   out_specs=P())
+    l_h = float(jax.jit(fn)(params, tokens, labels))
+    l_ref = float(L.dense_loss(params, tokens, labels, CFG))
+    assert abs(l_h - l_ref) < 1e-4, (l_h, l_ref)
+
+
+def test_hybrid_train_step_loss_decreases(setup):
+    mesh, params, tokens, labels = setup
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step, shard_params, init_state = L.build_hybrid_train_step(
+        CFG, mesh, opt, num_microbatches=2)
+    params = shard_params(params)
+    state = init_state(params)
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state, tokens, labels,
+                                   jnp.float32(1e-2))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(l) for l in losses)
