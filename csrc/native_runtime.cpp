@@ -1,0 +1,595 @@
+// paddle_tpu native runtime: TCP KV store + prefetch ring buffer +
+// tokenized-file reader.
+//
+// Reference components this replaces (behavior, not code):
+//   * TCPStore rank-0 rendezvous KV server —
+//     paddle/phi/core/distributed/store/tcp_store.h:121 (set/get/add/wait
+//     /barrier over a simple framed TCP protocol)
+//   * DataLoader native worker/buffer machinery —
+//     paddle/fluid/framework data feed + python/paddle/io multiprocess
+//     workers (here: a mutex/condvar ring buffer filled off-GIL, plus a
+//     C++ reader thread for flat token files)
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// All blocking entry points take a timeout in milliseconds; -1 waits
+// forever.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+bool wait_until(std::condition_variable &cv, std::unique_lock<std::mutex> &lk,
+                long timeout_ms, const std::function<bool()> &pred) {
+  if (timeout_ms < 0) {
+    cv.wait(lk, pred);
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+}
+
+// ---------------------------------------------------------------------------
+// framing helpers
+// ---------------------------------------------------------------------------
+bool read_full(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ops
+enum Op : uint8_t {
+  OP_SET = 1,
+  OP_GET = 2,     // blocking until key exists (timeout in payload)
+  OP_ADD = 3,     // payload int64 delta; returns new value
+  OP_WAIT = 4,    // wait until key exists
+  OP_DELETE = 5,
+  OP_NUM_KEYS = 6,
+  OP_COMPARE_SET = 7,  // payload: expected_len|expected|desired — CAS
+};
+
+struct Frame {
+  uint8_t op;
+  std::string key;
+  std::string payload;
+  int64_t timeout_ms;
+};
+
+bool read_frame(int fd, Frame *f) {
+  uint8_t op;
+  uint32_t klen;
+  uint64_t plen;
+  int64_t to;
+  if (!read_full(fd, &op, 1)) return false;
+  if (!read_full(fd, &klen, 4)) return false;
+  f->key.resize(klen);
+  if (klen && !read_full(fd, &f->key[0], klen)) return false;
+  if (!read_full(fd, &to, 8)) return false;
+  if (!read_full(fd, &plen, 8)) return false;
+  f->payload.resize(plen);
+  if (plen && !read_full(fd, &f->payload[0], plen)) return false;
+  f->op = op;
+  f->timeout_ms = to;
+  return true;
+}
+
+bool send_reply(int fd, int64_t status, const std::string &payload) {
+  uint64_t plen = payload.size();
+  if (!write_full(fd, &status, 8)) return false;
+  if (!write_full(fd, &plen, 8)) return false;
+  if (plen && !write_full(fd, payload.data(), plen)) return false;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// KV store server
+// ---------------------------------------------------------------------------
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+  std::mutex conn_mu;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+
+  // Blocking waits must bail out on shutdown, or pts_server_stop would
+  // destroy the mutex/cv under a parked waiter (use-after-free).
+  bool wait_key(std::unique_lock<std::mutex> &lk, long timeout_ms,
+                const std::string &key) {
+    wait_until(cv, lk, timeout_ms,
+               [&] { return !running.load() || data.count(key) > 0; });
+    return running.load() && data.count(key) > 0;
+  }
+
+  void handle_conn(int fd) {
+    Frame f;
+    while (running.load() && read_frame(fd, &f)) {
+      switch (f.op) {
+        case OP_SET: {
+          {
+            std::lock_guard<std::mutex> g(mu);
+            data[f.key] = f.payload;
+          }
+          cv.notify_all();
+          send_reply(fd, 0, "");
+          break;
+        }
+        case OP_GET: {
+          std::unique_lock<std::mutex> lk(mu);
+          bool ok = wait_key(lk, f.timeout_ms, f.key);
+          if (ok) {
+            std::string v = data[f.key];
+            lk.unlock();
+            send_reply(fd, 0, v);
+          } else {
+            lk.unlock();
+            send_reply(fd, -1, "");
+          }
+          break;
+        }
+        case OP_ADD: {
+          int64_t delta = 0;
+          if (f.payload.size() == 8) memcpy(&delta, f.payload.data(), 8);
+          int64_t now;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            int64_t cur = 0;
+            auto it = data.find(f.key);
+            if (it != data.end() && it->second.size() == 8)
+              memcpy(&cur, it->second.data(), 8);
+            now = cur + delta;
+            std::string v(8, '\0');
+            memcpy(&v[0], &now, 8);
+            data[f.key] = v;
+          }
+          cv.notify_all();
+          send_reply(fd, now, "");
+          break;
+        }
+        case OP_WAIT: {
+          std::unique_lock<std::mutex> lk(mu);
+          bool ok = wait_key(lk, f.timeout_ms, f.key);
+          lk.unlock();
+          send_reply(fd, ok ? 0 : -1, "");
+          break;
+        }
+        case OP_DELETE: {
+          size_t n;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            n = data.erase(f.key);
+          }
+          cv.notify_all();
+          send_reply(fd, static_cast<int64_t>(n), "");
+          break;
+        }
+        case OP_NUM_KEYS: {
+          int64_t n;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            n = static_cast<int64_t>(data.size());
+          }
+          send_reply(fd, n, "");
+          break;
+        }
+        case OP_COMPARE_SET: {
+          // payload: u64 explen | expected | desired
+          uint64_t elen = 0;
+          if (f.payload.size() < 8) {
+            send_reply(fd, -1, "");
+            break;
+          }
+          memcpy(&elen, f.payload.data(), 8);
+          std::string expected = f.payload.substr(8, elen);
+          std::string desired = f.payload.substr(8 + elen);
+          std::string out;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            auto it = data.find(f.key);
+            std::string cur = it == data.end() ? std::string() : it->second;
+            if ((it == data.end() && expected.empty()) || cur == expected) {
+              data[f.key] = desired;
+              out = desired;
+            } else {
+              out = cur;
+            }
+          }
+          cv.notify_all();
+          send_reply(fd, 0, out);
+          break;
+        }
+        default:
+          send_reply(fd, -2, "");
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    while (running.load()) {
+      sockaddr_in addr{};
+      socklen_t alen = sizeof(addr);
+      int fd = ::accept(listen_fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+      if (fd < 0) {
+        if (!running.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back(&StoreServer::handle_conn, this, fd);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// client
+// ---------------------------------------------------------------------------
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+
+  bool request(const Frame &f, int64_t *status, std::string *payload) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t klen = static_cast<uint32_t>(f.key.size());
+    uint64_t plen = f.payload.size();
+    if (!write_full(fd, &f.op, 1)) return false;
+    if (!write_full(fd, &klen, 4)) return false;
+    if (klen && !write_full(fd, f.key.data(), klen)) return false;
+    if (!write_full(fd, &f.timeout_ms, 8)) return false;
+    if (!write_full(fd, &plen, 8)) return false;
+    if (plen && !write_full(fd, f.payload.data(), plen)) return false;
+    uint64_t rlen;
+    if (!read_full(fd, status, 8)) return false;
+    if (!read_full(fd, &rlen, 8)) return false;
+    payload->resize(rlen);
+    if (rlen && !read_full(fd, &(*payload)[0], rlen)) return false;
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ring buffer (byte-blob queue)
+// ---------------------------------------------------------------------------
+struct RingBuffer {
+  explicit RingBuffer(size_t cap) : capacity(cap) {}
+  size_t capacity;
+  std::deque<std::string> items;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  bool closed = false;
+
+  int push(const char *data, size_t len, long timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    bool ok = wait_until(cv_push, lk, timeout_ms,
+                         [&] { return closed || items.size() < capacity; });
+    if (!ok) return -1;           // timeout
+    if (closed) return -2;        // closed
+    items.emplace_back(data, len);
+    cv_pop.notify_one();
+    return 0;
+  }
+
+  // returns malloc'd buffer (caller frees via ptn_free) or nullptr
+  char *pop(uint64_t *out_len, long timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    bool ok = wait_until(cv_pop, lk, timeout_ms,
+                         [&] { return closed || !items.empty(); });
+    *out_len = 0;
+    if (!ok) return nullptr;                    // timeout
+    if (items.empty()) return nullptr;          // closed and drained
+    std::string s = std::move(items.front());
+    items.pop_front();
+    cv_push.notify_one();
+    lk.unlock();
+    char *buf = static_cast<char *>(::malloc(s.size()));
+    memcpy(buf, s.data(), s.size());
+    *out_len = s.size();
+    return buf;
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      closed = true;
+    }
+    cv_push.notify_all();
+    cv_pop.notify_all();
+  }
+};
+
+// ---------------------------------------------------------------------------
+// token-file reader: streams [batch, seq+1] int32 windows into a ring
+// ---------------------------------------------------------------------------
+struct TokenReader {
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  RingBuffer *rb = nullptr;
+
+  void run(std::string path, long batch, long seqlen, long epochs,
+           long stride) {
+    FILE *fp = ::fopen(path.c_str(), "rb");
+    if (!fp) {
+      rb->close();
+      return;
+    }
+    ::fseek(fp, 0, SEEK_END);
+    long fsize = ::ftell(fp);
+    long n_tokens = fsize / 4;
+    long window = seqlen + 1;
+    long per_batch = batch * window;
+    std::vector<int32_t> buf(per_batch);
+    for (long e = 0; epochs < 0 || e < epochs; ++e) {
+      long pos = 0;
+      while (!stop.load() && pos + batch * stride + window <= n_tokens + stride) {
+        bool full = true;
+        for (long b = 0; b < batch; ++b) {
+          long off = pos + b * stride;
+          if (off + window > n_tokens) {
+            full = false;
+            break;
+          }
+          ::fseek(fp, off * 4, SEEK_SET);
+          if (::fread(buf.data() + b * window, 4, window, fp) !=
+              static_cast<size_t>(window)) {
+            full = false;
+            break;
+          }
+        }
+        if (!full) break;
+        int r = rb->push(reinterpret_cast<char *>(buf.data()),
+                         per_batch * 4, -1);
+        if (r != 0) {  // closed
+          ::fclose(fp);
+          return;
+        }
+        pos += batch * stride;
+      }
+      if (stop.load()) break;
+    }
+    ::fclose(fp);
+    rb->close();
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void *pts_server_start(int port) {
+  auto *s = new StoreServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->running = true;
+  s->accept_thread = std::thread(&StoreServer::accept_loop, s);
+  return s;
+}
+
+int pts_server_port(void *h) { return static_cast<StoreServer *>(h)->port; }
+
+void pts_server_stop(void *h) {
+  auto *s = static_cast<StoreServer *>(h);
+  s->running = false;
+  s->cv.notify_all();  // release waiters parked in OP_GET/OP_WAIT
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // unblock conn threads stuck in recv(), then JOIN them so none can
+    // touch the server after delete
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto &t : s->conn_threads)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+void *pts_client_connect(const char *host, int port, long timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  auto deadline = Clock::now() + std::chrono::milliseconds(
+                                     timeout_ms < 0 ? 30000 : timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                   sizeof(addr)) != 0) {
+    if (Clock::now() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto *c = new StoreClient();
+  c->fd = fd;
+  return c;
+}
+
+void pts_client_close(void *h) {
+  auto *c = static_cast<StoreClient *>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int pts_client_set(void *h, const char *key, const char *data, uint64_t len) {
+  Frame f{OP_SET, key, std::string(data, len), -1};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  return static_cast<int>(st);
+}
+
+// returns malloc'd payload via *out (caller: ptn_free); length via *out_len;
+// 0 on success, -1 timeout, -3 io error
+int pts_client_get(void *h, const char *key, long timeout_ms, char **out,
+                   uint64_t *out_len) {
+  Frame f{OP_GET, key, "", timeout_ms};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  if (st != 0) return static_cast<int>(st);
+  *out = static_cast<char *>(::malloc(pl.size()));
+  memcpy(*out, pl.data(), pl.size());
+  *out_len = pl.size();
+  return 0;
+}
+
+int64_t pts_client_add(void *h, const char *key, int64_t delta) {
+  std::string payload(8, '\0');
+  memcpy(&payload[0], &delta, 8);
+  Frame f{OP_ADD, key, payload, -1};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return INT64_MIN;
+  return st;
+}
+
+int pts_client_wait(void *h, const char *key, long timeout_ms) {
+  Frame f{OP_WAIT, key, "", timeout_ms};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  return static_cast<int>(st);
+}
+
+int64_t pts_client_delete(void *h, const char *key) {
+  Frame f{OP_DELETE, key, "", -1};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  return st;
+}
+
+int64_t pts_client_num_keys(void *h) {
+  Frame f{OP_NUM_KEYS, "", "", -1};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  return st;
+}
+
+int pts_client_compare_set(void *h, const char *key, const char *expected,
+                           uint64_t elen, const char *desired, uint64_t dlen,
+                           char **out, uint64_t *out_len) {
+  std::string payload(8, '\0');
+  memcpy(&payload[0], &elen, 8);
+  payload.append(expected, elen);
+  payload.append(desired, dlen);
+  Frame f{OP_COMPARE_SET, key, payload, -1};
+  int64_t st;
+  std::string pl;
+  if (!static_cast<StoreClient *>(h)->request(f, &st, &pl)) return -3;
+  *out = static_cast<char *>(::malloc(pl.size()));
+  memcpy(*out, pl.data(), pl.size());
+  *out_len = pl.size();
+  return static_cast<int>(st);
+}
+
+void ptn_free(void *p) { ::free(p); }
+
+// --- ring buffer -----------------------------------------------------------
+void *ptn_rb_create(uint64_t capacity) { return new RingBuffer(capacity); }
+
+int ptn_rb_push(void *h, const char *data, uint64_t len, long timeout_ms) {
+  return static_cast<RingBuffer *>(h)->push(data, len, timeout_ms);
+}
+
+char *ptn_rb_pop(void *h, uint64_t *out_len, long timeout_ms) {
+  return static_cast<RingBuffer *>(h)->pop(out_len, timeout_ms);
+}
+
+uint64_t ptn_rb_size(void *h) {
+  auto *rb = static_cast<RingBuffer *>(h);
+  std::lock_guard<std::mutex> g(rb->mu);
+  return rb->items.size();
+}
+
+void ptn_rb_close(void *h) { static_cast<RingBuffer *>(h)->close(); }
+
+void ptn_rb_destroy(void *h) {
+  auto *rb = static_cast<RingBuffer *>(h);
+  rb->close();
+  delete rb;
+}
+
+// --- token-file reader -----------------------------------------------------
+void *ptn_reader_start(const char *path, long batch, long seqlen, long epochs,
+                       long stride, void *rb) {
+  auto *r = new TokenReader();
+  r->rb = static_cast<RingBuffer *>(rb);
+  r->worker = std::thread(&TokenReader::run, r, std::string(path), batch,
+                          seqlen, epochs, stride <= 0 ? seqlen : stride);
+  return r;
+}
+
+void ptn_reader_stop(void *h) {
+  auto *r = static_cast<TokenReader *>(h);
+  r->stop = true;
+  r->rb->close();
+  if (r->worker.joinable()) r->worker.join();
+  delete r;
+}
+
+}  // extern "C"

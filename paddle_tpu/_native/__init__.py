@@ -1,0 +1,93 @@
+"""Loader for the native C++ runtime (csrc/native_runtime.cpp).
+
+Builds the shared library on first use with g++ (the image's baked-in
+toolchain; no pip deps) and caches it next to the source keyed by an mtime
+check. Consumers must handle `load() is None` (toolchain missing) and fall
+back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "native_runtime.cpp")
+_OUT = os.path.join(os.path.dirname(_SRC), "build", "libpaddle_tpu_native.so")
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    if (os.path.exists(_OUT)
+            and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+        return _OUT
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _OUT]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _OUT
+
+
+def _declare(lib):
+    c = ctypes
+    P, I, L, U64, CP = (c.c_void_p, c.c_int, c.c_long, c.c_uint64,
+                        c.c_char_p)
+    sigs = {
+        "pts_server_start": ([I], P),
+        "pts_server_port": ([P], I),
+        "pts_server_stop": ([P], None),
+        "pts_client_connect": ([CP, I, L], P),
+        "pts_client_close": ([P], None),
+        "pts_client_set": ([P, CP, CP, U64], I),
+        "pts_client_get": ([P, CP, L, c.POINTER(P), c.POINTER(U64)], I),
+        "pts_client_add": ([P, CP, c.c_int64], c.c_int64),
+        "pts_client_wait": ([P, CP, L], I),
+        "pts_client_delete": ([P, CP], c.c_int64),
+        "pts_client_num_keys": ([P], c.c_int64),
+        "pts_client_compare_set": ([P, CP, CP, U64, CP, U64,
+                                    c.POINTER(P), c.POINTER(U64)], I),
+        "ptn_free": ([P], None),
+        "ptn_rb_create": ([U64], P),
+        "ptn_rb_push": ([P, CP, U64, L], I),
+        "ptn_rb_pop": ([P, c.POINTER(U64), L], P),
+        "ptn_rb_size": ([P], U64),
+        "ptn_rb_close": ([P], None),
+        "ptn_rb_destroy": ([P], None),
+        "ptn_reader_start": ([CP, L, L, L, L, P], P),
+        "ptn_reader_stop": ([P], None),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load():
+    """Return the ctypes library, or None when the native build fails."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            _LIB = _declare(ctypes.CDLL(_build()))
+        except (OSError, subprocess.CalledProcessError):
+            _LIB = None
+        return _LIB
+
+
+def take_bytes(lib, ptr, length) -> bytes:
+    """Copy a malloc'd native buffer into Python bytes and free it."""
+    if not ptr or not length:
+        if ptr:
+            lib.ptn_free(ptr)
+        return b""
+    out = ctypes.string_at(ptr, length)
+    lib.ptn_free(ptr)
+    return out

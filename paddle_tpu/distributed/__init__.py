@@ -18,6 +18,7 @@ from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                          new_group, ppermute, recv, reduce, reduce_scatter,
                          scatter, send)
 from . import checkpoint  # noqa: F401
+from .store import MasterStore, TCPStore
 from .checkpoint import load_state_dict, save_state_dict
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
@@ -51,4 +52,5 @@ __all__ = [
     "sharding", "group_sharded_parallel", "save_group_sharded_model",
     # checkpoint
     "checkpoint", "save_state_dict", "load_state_dict",
+    "TCPStore", "MasterStore",
 ]

@@ -1,0 +1,232 @@
+"""TCPStore: rank-0 TCP KV rendezvous store (reference:
+paddle/phi/core/distributed/store/tcp_store.h:121 — set/get/add/wait/
+barrier used to exchange NCCL unique ids and synchronize bootstrap).
+
+The server and client hot paths are native C++ (csrc/native_runtime.cpp,
+loaded via ctypes); a pure-Python socketserver fallback keeps the API alive
+when no toolchain is present. On TPU pods the coordination service normally
+plays this role, but a framework-owned store is still needed for
+launcher-level rendezvous and elastic membership (reference launcher master
+KV, launch/controllers/master.py:73).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from .. import _native
+
+__all__ = ["TCPStore", "MasterStore"]
+
+
+class _PyStoreServer:
+    """Pure-Python fallback: an in-process KV shared by all TCPStore
+    instances that name the same port (single-host tests without g++).
+    A framed-protocol socket server is deliberately not reimplemented —
+    real multi-process use requires the native build."""
+
+    _registry = {}
+    _registry_lock = threading.Lock()
+    _next_port = [50000]
+
+    def __init__(self):
+        self.data = {}
+        self.cond = threading.Condition()
+
+    @classmethod
+    def for_port(cls, port: int, create: bool):
+        with cls._registry_lock:
+            if port == 0 and create:
+                cls._next_port[0] += 1
+                port = cls._next_port[0]
+            if port not in cls._registry:
+                cls._registry[port] = cls()
+            return port, cls._registry[port]
+
+
+class TCPStore:
+    """KV store client; rank 0 (is_master=True) also hosts the server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 world_size: int = 1, is_master: bool = False,
+                 timeout: float = 300.0):
+        self._lib = _native.load()
+        self._timeout_ms = int(timeout * 1000)
+        self._server = None
+        self._client = None
+        self._fallback: Optional[_PyStoreServer] = None
+        self.host = host
+        self.world_size = world_size
+        self.is_master = is_master
+
+        if self._lib is None:
+            # in-process fallback: only valid when all participants share the
+            # process (unit tests); real multi-proc needs the native build
+            self.port, self._fallback = _PyStoreServer.for_port(
+                port, create=is_master)
+            return
+
+        if is_master:
+            self._server = self._lib.pts_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            self.port = self._lib.pts_server_port(self._server)
+        else:
+            self.port = port
+        self._client = self._lib.pts_client_connect(
+            host.encode(), self.port, self._timeout_ms)
+        if not self._client:
+            raise TimeoutError(
+                f"TCPStore: cannot reach {host}:{self.port}")
+
+    # -- API (reference surface) --------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._fallback is not None:
+            with self._fallback.cond:
+                self._fallback.data[key] = bytes(value)
+                self._fallback.cond.notify_all()
+            return
+        rc = self._lib.pts_client_set(self._client, key.encode(), value,
+                                      len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        to_ms = self._timeout_ms if timeout is None else int(timeout * 1000)
+        if self._fallback is not None:
+            deadline = time.time() + to_ms / 1000
+            with self._fallback.cond:
+                while key not in self._fallback.data:
+                    rem = deadline - time.time()
+                    if rem <= 0:
+                        raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+                    self._fallback.cond.wait(rem)
+                return self._fallback.data[key]
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pts_client_get(self._client, key.encode(), to_ms,
+                                      ctypes.byref(out),
+                                      ctypes.byref(out_len))
+        if rc == -1:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed rc={rc}")
+        return _native.take_bytes(self._lib, out.value, out_len.value)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._fallback is not None:
+            with self._fallback.cond:
+                raw = self._fallback.data.get(key, b"")
+                # match the native server: anything not exactly 8 bytes
+                # counts as 0 rather than erroring
+                cur = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+                now = cur + amount
+                self._fallback.data[key] = struct.pack("<q", now)
+                self._fallback.cond.notify_all()
+                return now
+        rc = self._lib.pts_client_add(self._client, key.encode(), amount)
+        if rc == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) io error")
+        return int(rc)
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            if self._fallback is not None:
+                self.get(k, timeout)
+                continue
+            to_ms = (self._timeout_ms if timeout is None
+                     else int(timeout * 1000))
+            rc = self._lib.pts_client_wait(self._client, k.encode(), to_ms)
+            if rc == -1:
+                raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.wait({k!r}) failed rc={rc}")
+
+    def delete_key(self, key: str) -> bool:
+        if self._fallback is not None:
+            with self._fallback.cond:
+                return self._fallback.data.pop(key, None) is not None
+        return self._lib.pts_client_delete(self._client, key.encode()) > 0
+
+    def num_keys(self) -> int:
+        if self._fallback is not None:
+            with self._fallback.cond:
+                return len(self._fallback.data)
+        return int(self._lib.pts_client_num_keys(self._client))
+
+    def compare_set(self, key: str, expected: bytes, desired: bytes) -> bytes:
+        if isinstance(expected, str):
+            expected = expected.encode()
+        if isinstance(desired, str):
+            desired = desired.encode()
+        if self._fallback is not None:
+            with self._fallback.cond:
+                cur = self._fallback.data.get(key, b"")
+                if cur == expected or (not cur and not expected):
+                    self._fallback.data[key] = desired
+                    self._fallback.cond.notify_all()
+                    return desired
+                return cur
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pts_client_compare_set(
+            self._client, key.encode(), expected, len(expected), desired,
+            len(desired), ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.compare_set({key!r}) rc={rc}")
+        return _native.take_bytes(self._lib, out.value, out_len.value)
+
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None):
+        """All world_size participants arrive before any leaves. Reusable:
+        each instance tracks a per-name round so repeated barriers on the
+        same name synchronize independently (all participants must call the
+        same barriers in the same order)."""
+        rounds = self.__dict__.setdefault("_barrier_rounds", {})
+        r = rounds.get(name, 0)
+        rounds[name] = r + 1
+        n = self.add(f"__barrier/{name}/{r}/count", 1)
+        if n == self.world_size:
+            self.set(f"__barrier/{name}/{r}/go", b"1")
+        self.wait([f"__barrier/{name}/{r}/go"], timeout)
+
+    def close(self):
+        if self._lib is None:
+            return
+        if self._client:
+            self._lib.pts_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def MasterStore(endpoint: str, world_size: int, rank: int,
+                timeout: float = 300.0) -> TCPStore:
+    """Build a store from a 'host:port' endpoint (launcher convention:
+    rank 0 hosts)."""
+    host, port = endpoint.rsplit(":", 1)
+    return TCPStore(host, int(port), world_size, is_master=(rank == 0),
+                    timeout=timeout)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p

@@ -11,6 +11,7 @@ the bottleneck is HBM/compute, not Python, so process pools are optional
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset, random_split)
 from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .token_loader import TokenFileLoader
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)
@@ -21,4 +22,5 @@ __all__ = [
     "DataLoader", "default_collate_fn", "get_worker_info",
     "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
     "DistributedBatchSampler", "WeightedRandomSampler", "SubsetRandomSampler",
+    "TokenFileLoader",
 ]

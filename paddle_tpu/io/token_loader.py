@@ -1,0 +1,136 @@
+"""Native token-stream loader for LM pretraining (reference: the C++ data
+feed under paddle/fluid/framework + python/paddle/io DataLoader workers —
+here the reader thread is C++ (csrc/native_runtime.cpp TokenReader) filling
+a C++ ring buffer off-GIL; Python only wraps batches into arrays).
+
+File format: a flat binary stream of little-endian int32 token ids. Each
+batch is [batch_size, seq_len+1] consecutive windows; tokens = [:, :-1],
+labels = [:, 1:] (next-token prediction).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import _native
+
+__all__ = ["TokenFileLoader"]
+
+
+class TokenFileLoader:
+    """Iterate (tokens, labels) int32 batches from a flat token file.
+
+    Uses the native C++ reader+ring when available; otherwise a Python
+    thread with numpy memmap (same semantics, GIL-bound)."""
+
+    def __init__(self, path: str, batch_size: int, seq_len: int,
+                 epochs: int = 1, stride: Optional[int] = None,
+                 buffer_batches: int = 8):
+        self.path = path
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.epochs = epochs
+        self.stride = seq_len if stride is None else stride
+        self.buffer_batches = buffer_batches
+        self._lib = _native.load()
+
+    # -- native path ---------------------------------------------------------
+    def _iter_native(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        lib = self._lib
+        rb = lib.ptn_rb_create(self.buffer_batches)
+        reader = lib.ptn_reader_start(
+            self.path.encode(), self.batch_size, self.seq_len, self.epochs,
+            self.stride, rb)
+        window = self.seq_len + 1
+        try:
+            while True:
+                out_len = ctypes.c_uint64()
+                ptr = lib.ptn_rb_pop(rb, ctypes.byref(out_len), -1)
+                if not ptr:
+                    break  # reader finished and ring drained
+                raw = _native.take_bytes(lib, ptr, out_len.value)
+                arr = np.frombuffer(raw, dtype=np.int32).reshape(
+                    self.batch_size, window)
+                yield arr[:, :-1], arr[:, 1:]
+        finally:
+            lib.ptn_reader_stop(reader)
+            lib.ptn_rb_destroy(rb)
+
+    # -- fallback path -------------------------------------------------------
+    def _iter_python(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        data = np.memmap(self.path, dtype=np.int32, mode="r")
+        n = data.shape[0]
+        window = self.seq_len + 1
+        import itertools
+        import queue
+        q: "queue.Queue" = queue.Queue(self.buffer_batches)
+        DONE = object()
+        stop = threading.Event()
+
+        # epochs < 0 = infinite, matching the native reader's contract
+        epoch_iter = (itertools.count() if self.epochs < 0
+                      else range(self.epochs))
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            for _ in epoch_iter:
+                pos = 0
+                while not stop.is_set():
+                    rows = []
+                    ok = True
+                    for b in range(self.batch_size):
+                        off = pos + b * self.stride
+                        if off + window > n:
+                            ok = False
+                            break
+                        rows.append(np.asarray(data[off:off + window]))
+                    if not ok:
+                        break
+                    if not put(np.stack(rows)):
+                        return
+                    pos += self.batch_size * self.stride
+                if stop.is_set():
+                    return
+            put(DONE)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                yield item[:, :-1], item[:, 1:]
+        finally:
+            stop.set()  # abandoning the iterator must not strand the thread
+
+    def __iter__(self):
+        if self._lib is not None:
+            return self._iter_native()
+        return self._iter_python()
+
+    def __len__(self):
+        if self.epochs < 0:
+            raise TypeError("TokenFileLoader with epochs<0 is an infinite "
+                            "stream and has no length")
+        data_len = np.memmap(self.path, dtype=np.int32, mode="r").shape[0]
+        window = self.seq_len + 1
+        per_step = self.batch_size * self.stride
+        steps = 0
+        pos = 0
+        while pos + (self.batch_size - 1) * self.stride + window <= data_len:
+            steps += 1
+            pos += per_step
+        return steps * self.epochs

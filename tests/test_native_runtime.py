@@ -1,0 +1,154 @@
+"""Native runtime tests: TCPStore (in-thread and cross-process via the
+reference's subprocess-spawn pattern, test_dist_base.py:954), ring buffer,
+and the native token-file loader."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io import TokenFileLoader
+
+NATIVE = _native.load() is not None
+pytestmark = pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+
+
+def test_store_set_get_add_wait():
+    master = TCPStore("127.0.0.1", 0, world_size=2, is_master=True)
+    client = TCPStore("127.0.0.1", master.port, world_size=2)
+    master.set("alpha", b"hello")
+    assert client.get("alpha") == b"hello"
+    assert client.add("cnt", 3) == 3
+    assert master.add("cnt", 4) == 7
+    client.set("k2", "strval")
+    assert master.get("k2") == b"strval"
+    assert master.num_keys() == 3
+    assert master.delete_key("k2")
+    assert master.num_keys() == 2
+    with pytest.raises(TimeoutError):
+        client.get("missing", timeout=0.2)
+    client.close()
+    master.close()
+
+
+def test_store_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
+    got = {}
+
+    def waiter():
+        c = TCPStore("127.0.0.1", master.port)
+        c.wait("late", timeout=5)
+        got["v"] = c.get("late")
+        c.close()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    master.set("late", b"xyz")
+    t.join(5)
+    assert got["v"] == b"xyz"
+    master.close()
+
+
+def test_store_compare_set():
+    master = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
+    out = master.compare_set("lock", b"", b"owner1")
+    assert out == b"owner1"
+    out = master.compare_set("lock", b"", b"owner2")
+    assert out == b"owner1"  # CAS failed, current value returned
+    out = master.compare_set("lock", b"owner1", b"owner2")
+    assert out == b"owner2"
+    master.close()
+
+
+def test_store_barrier_cross_process(tmp_path):
+    """Reference pattern: spawn worker subprocesses, rendezvous over the
+    store, each contributes a key, all pass the barrier."""
+    master = TCPStore("127.0.0.1", 0, world_size=3, is_master=True)
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {repr(os.getcwd())})
+from paddle_tpu.distributed.store import TCPStore
+rank = int(sys.argv[1])
+s = TCPStore("127.0.0.1", {master.port}, world_size=3)
+s.set(f"from_rank_{{rank}}", str(rank))
+s.barrier("b0", timeout=20)
+print("rank", rank, "passed", flush=True)
+""")
+    procs = [subprocess.Popen([sys.executable, str(worker), str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT,
+                              env={**os.environ, "JAX_PLATFORMS": "cpu"})
+             for r in (1, 2)]
+    master.set("from_rank_0", "0")
+    master.barrier("b0", timeout=20)
+    for p in procs:
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0, out.decode()
+        assert b"passed" in out
+    for r in range(3):
+        assert master.get(f"from_rank_{r}") == str(r).encode()
+    master.close()
+
+
+def test_ring_buffer_fifo_and_close():
+    lib = _native.load()
+    import ctypes
+    rb = lib.ptn_rb_create(4)
+    for i in range(4):
+        assert lib.ptn_rb_push(rb, bytes([i]) * 8, 8, 100) == 0
+    # full: push times out
+    assert lib.ptn_rb_push(rb, b"x", 1, 50) == -1
+    outs = []
+    for _ in range(4):
+        ln = ctypes.c_uint64()
+        p = lib.ptn_rb_pop(rb, ctypes.byref(ln), 100)
+        outs.append(_native.take_bytes(lib, p, ln.value))
+    assert outs == [bytes([i]) * 8 for i in range(4)]
+    lib.ptn_rb_close(rb)
+    ln = ctypes.c_uint64()
+    assert not lib.ptn_rb_pop(rb, ctypes.byref(ln), 100)  # closed+empty
+    lib.ptn_rb_destroy(rb)
+
+
+def _write_tokens(path, n):
+    arr = np.arange(n, dtype=np.int32)
+    arr.tofile(path)
+    return arr
+
+
+def test_token_loader_windows(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    _write_tokens(path, 1000)
+    loader = TokenFileLoader(path, batch_size=2, seq_len=8, epochs=1)
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    tok0, lab0 = batches[0]
+    assert tok0.shape == (2, 8) and lab0.shape == (2, 8)
+    # next-token alignment
+    np.testing.assert_array_equal(lab0, tok0 + 1)
+    # first window starts at 0, second row strides by seq_len
+    np.testing.assert_array_equal(tok0[0], np.arange(8))
+    np.testing.assert_array_equal(tok0[1], np.arange(8, 16))
+    # consecutive batches continue the stream
+    tok1, _ = batches[1]
+    np.testing.assert_array_equal(tok1[0], np.arange(16, 24))
+
+
+def test_token_loader_epochs_and_python_parity(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    _write_tokens(path, 200)
+    nat = list(TokenFileLoader(path, batch_size=2, seq_len=4, epochs=2))
+    loader = TokenFileLoader(path, batch_size=2, seq_len=4, epochs=2)
+    py = list(loader._iter_python())
+    assert len(nat) == len(py) > 0
+    for (a, b), (c, d) in zip(nat, py):
+        np.testing.assert_array_equal(a, c)
+        np.testing.assert_array_equal(b, d)
