@@ -90,6 +90,8 @@ struct Frame {
   int64_t timeout_ms;
 };
 
+constexpr uint64_t kMaxFrameBytes = 1ull << 30;  // corrupt-frame guard
+
 bool read_frame(int fd, Frame *f) {
   uint8_t op;
   uint32_t klen;
@@ -97,10 +99,12 @@ bool read_frame(int fd, Frame *f) {
   int64_t to;
   if (!read_full(fd, &op, 1)) return false;
   if (!read_full(fd, &klen, 4)) return false;
+  if (klen > kMaxFrameBytes) return false;  // drop the connection
   f->key.resize(klen);
   if (klen && !read_full(fd, &f->key[0], klen)) return false;
   if (!read_full(fd, &to, 8)) return false;
   if (!read_full(fd, &plen, 8)) return false;
+  if (plen > kMaxFrameBytes) return false;
   f->payload.resize(plen);
   if (plen && !read_full(fd, &f->payload[0], plen)) return false;
   f->op = op;
@@ -220,6 +224,10 @@ struct StoreServer {
             break;
           }
           memcpy(&elen, f.payload.data(), 8);
+          if (elen > f.payload.size() - 8) {  // corrupt frame: error reply,
+            send_reply(fd, -1, "");           // never substr past the end
+            break;
+          }
           std::string expected = f.payload.substr(8, elen);
           std::string desired = f.payload.substr(8 + elen);
           std::string out;

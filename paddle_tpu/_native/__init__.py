@@ -27,9 +27,14 @@ def _build() -> str:
     if (os.path.exists(_OUT)
             and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
         return _OUT
+    # multiple ranks may race the first build: compile to a private temp
+    # name, then atomically rename — losers just overwrite with an
+    # identical file, and no rank can mmap a half-written .so
+    tmp = f"{_OUT}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _OUT]
+           _SRC, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _OUT)
     return _OUT
 
 

@@ -93,8 +93,10 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     import jax
     if _initialized[0]:
         return ParallelEnv()
-    addr = coordinator_address or os.environ.get("PADDLE_MASTER") or \
-        os.environ.get("COORDINATOR_ADDRESS")
+    # NOTE: PADDLE_MASTER is the launcher's KV-store endpoint (different
+    # port/protocol) — the jax coordinator address is its own env var.
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS") \
+        or os.environ.get("COORDINATOR_ADDRESS")
     world = num_processes if num_processes is not None else get_world_size()
     if world > 1 or addr:
         rank = process_id if process_id is not None else get_rank()

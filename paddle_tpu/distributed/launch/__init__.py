@@ -1,0 +1,15 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/ —
+`fleetrun` / `python -m paddle.distributed.launch`, entry launch/main.py:23).
+"""
+
+from .context import Context
+from .controllers import (CollectiveController, ELASTIC_EXIT_CODE,
+                          ELASTIC_AUTO_PARALLEL_EXIT_CODE)
+
+__all__ = ["Context", "CollectiveController", "launch", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+
+def launch(argv=None) -> int:
+    ctx = Context(argv)
+    return CollectiveController(ctx).run()

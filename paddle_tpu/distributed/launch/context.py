@@ -1,0 +1,83 @@
+"""Launch context: args/env parsing + node resource detection (reference:
+python/paddle/distributed/launch/context/__init__.py:24 Context;
+args/env mapping launch/context/args_envs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Context", "parse_args"]
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher (fleetrun equivalent)")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="rank-0 KV endpoint host:port (auto on single node)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "0")),
+                   help="0 = one process per visible device group")
+    p.add_argument("--log_dir", default=os.environ.get("PADDLE_LOG_DIR",
+                                                       "log"))
+    p.add_argument("--job_id", default=os.environ.get("PADDLE_JOB_ID",
+                                                      "default"))
+    p.add_argument("--devices", default=os.environ.get("PADDLE_DEVICES"),
+                   help="comma list of device ids for this node")
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_LEVEL", "0")),
+                   help="0 = no restart; 1 = restart failed pod up to "
+                        "--max_restarts")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_RESTARTS", "3")))
+    p.add_argument("--rdzv_timeout", type=float, default=120.0)
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+@dataclass
+class Node:
+    """Local resources (reference: launch/context/node.py device detect)."""
+
+    ip: str = field(default_factory=lambda: _local_ip())
+    device_ids: List[str] = field(default_factory=list)
+
+    @classmethod
+    def detect(cls, devices_arg: Optional[str]) -> "Node":
+        if devices_arg:
+            return cls(device_ids=devices_arg.split(","))
+        # TPU hosts expose their chips to one process; CPU fallback = 1
+        return cls(device_ids=["0"])
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class Context:
+    def __init__(self, argv: Optional[List[str]] = None):
+        self.args = parse_args(argv)
+        self.node = Node.detect(self.args.devices)
+        self.nproc = self.args.nproc_per_node or len(self.node.device_ids)
+        self.envs = dict(os.environ)
+
+    @property
+    def is_multi_node(self):
+        return self.args.nnodes > 1

@@ -1,0 +1,185 @@
+"""Collective controller: rendezvous + pod build + watch loop (reference:
+launch/controllers/collective.py:22 CollectiveController.build_pod — peer
+sync via master KV :37, worker env injection :120-133;
+launch/controllers/master.py:73 HTTPMaster/ETCDMaster sync_peers;
+elastic restart: fleet/elastic/manager.py:125, exit codes :33-34).
+
+TPU shape: the master KV is our native TCPStore (csrc/native_runtime.cpp);
+worker processes get both the reference env names (PADDLE_TRAINER_ID, ...)
+and the knobs jax.distributed.initialize reads, so user scripts can call
+paddle_tpu.distributed.init_parallel_env() unchanged on a pod slice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+from .context import Context
+
+__all__ = ["CollectiveController", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+           "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101           # worker requests rescheduling
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class Master:
+    """Rendezvous over the TCPStore: every node publishes its endpoints,
+    node 0 aggregates and republishes the full list."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        args = ctx.args
+        if args.master:
+            host, port = args.master.rsplit(":", 1)
+            self.store = TCPStore(host, int(port),
+                                  world_size=args.nnodes,
+                                  is_master=(args.node_rank == 0),
+                                  timeout=args.rdzv_timeout)
+        else:
+            assert args.nnodes == 1, "--master required for multi-node"
+            self.store = TCPStore("127.0.0.1", 0, world_size=1,
+                                  is_master=True,
+                                  timeout=args.rdzv_timeout)
+
+    def sync_peers(self, my_endpoints: List[str], generation: int = 0):
+        """Returns the globally-ordered endpoint list."""
+        args = self.ctx.args
+        key = f"rdzv/{args.job_id}/{generation}"
+        self.store.set(f"{key}/node_{args.node_rank}",
+                       json.dumps(my_endpoints))
+        if args.node_rank == 0:
+            all_eps: List[str] = []
+            for n in range(args.nnodes):
+                eps = json.loads(self.store.get(
+                    f"{key}/node_{n}", timeout=self.ctx.args.rdzv_timeout))
+                all_eps.extend(eps)
+            self.store.set(f"{key}/all", json.dumps(all_eps))
+        raw = self.store.get(f"{key}/all",
+                             timeout=self.ctx.args.rdzv_timeout)
+        return json.loads(raw)
+
+
+class Container:
+    """One worker process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd: List[str], env: dict, log_path: str):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(self.cmd, env=self.env,
+                                     stdout=self._log,
+                                     stderr=subprocess.STDOUT)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, grace: float = 5.0):
+        if not self.proc or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(grace)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.master = Master(ctx)
+        self.containers: List[Container] = []
+        self.restarts = 0
+
+    # -- pod build -----------------------------------------------------------
+    def _worker_env(self, global_rank: int, local_rank: int,
+                    endpoints: List[str], coordinator: str) -> dict:
+        ctx = self.ctx
+        env = dict(ctx.envs)
+        env.update({
+            # reference names (ported scripts keep working)
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[global_rank],
+            "PADDLE_MASTER": ctx.args.master or "",
+            # jax.distributed knobs (read by init_parallel_env)
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(len(endpoints)),
+            "JAX_PROCESS_ID": str(global_rank),
+        })
+        if ctx.node.device_ids and len(ctx.node.device_ids) > 1:
+            env["PADDLE_DEVICE_ID"] = ctx.node.device_ids[
+                local_rank % len(ctx.node.device_ids)]
+        return env
+
+    def build_pod(self, generation: int = 0) -> List[str]:
+        ctx = self.ctx
+        base_port = 37000 + (os.getpid() + generation * 131) % 2000
+        my_eps = [f"{ctx.node.ip}:{base_port + i}" for i in range(ctx.nproc)]
+        endpoints = self.master.sync_peers(my_eps, generation)
+        coordinator = endpoints[0].rsplit(":", 1)[0] + ":" + str(
+            int(endpoints[0].rsplit(":", 1)[1]) + 1000)
+
+        self.containers = []
+        first_global = ctx.args.node_rank * ctx.nproc
+        for lr in range(ctx.nproc):
+            gr = first_global + lr
+            env = self._worker_env(gr, lr, endpoints, coordinator)
+            cmd = [sys.executable, ctx.args.training_script,
+                   *ctx.args.training_script_args]
+            log = os.path.join(ctx.args.log_dir,
+                               f"{ctx.args.job_id}.rank{gr}.log")
+            self.containers.append(Container(cmd, env, log))
+        for c in self.containers:
+            c.start()
+        return endpoints
+
+    # -- watch / elastic -----------------------------------------------------
+    def watch(self, poll_interval: float = 0.2) -> int:
+        """Wait for the pod; on failure either tear down (level 0) or
+        rebuild the pod up to max_restarts (level >= 1). Returns exit
+        code."""
+        ctx = self.ctx
+        while True:
+            codes = [c.poll() for c in self.containers]
+            if all(c == 0 for c in codes):
+                return 0
+            failed = [(i, c) for i, c in enumerate(codes)
+                      if c is not None and c != 0]
+            if failed:
+                for c in self.containers:
+                    c.terminate()
+                if (ctx.args.elastic_level >= 1
+                        and self.restarts < ctx.args.max_restarts):
+                    self.restarts += 1
+                    self.build_pod(generation=self.restarts)
+                    continue
+                return failed[0][1]
+            time.sleep(poll_interval)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+        self.master.store.close()
+
+    def run(self) -> int:
+        self.build_pod()
+        try:
+            return self.watch()
+        finally:
+            self.stop()

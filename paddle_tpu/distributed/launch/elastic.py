@@ -1,0 +1,87 @@
+"""Elastic membership manager (reference: fleet/elastic/manager.py:125
+ElasticManager — etcd-backed membership with heartbeats :253, fault-
+tolerance levels :177-186, scale in/out via PADDLE_ELASTIC_NP watch).
+
+TPU shape: membership rides the job's TCPStore instead of etcd. On TPU
+slices a failed host kills the whole slice, so "elastic" degrades to
+checkpoint-restart of the pod (SURVEY §5 failure detection) — the manager
+therefore exposes exactly what the controller's restart loop needs:
+register/heartbeat/dead-member detection and a desired-world watch key.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticLevel"]
+
+
+class ElasticLevel:
+    NONE = 0          # crash the job on any failure
+    RESTART_POD = 1   # rebuild the whole pod from the last checkpoint
+
+
+class ElasticManager:
+    def __init__(self, store, job_id: str, np: int,
+                 heartbeat_interval: float = 1.0,
+                 heartbeat_timeout: float = 5.0):
+        self.store = store
+        self.job_id = job_id
+        self.np = np
+        self.interval = heartbeat_interval
+        self.timeout = heartbeat_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _key(self, *parts) -> str:
+        return "/".join(("elastic", self.job_id) + tuple(map(str, parts)))
+
+    # -- membership ----------------------------------------------------------
+    def register(self, rank: int):
+        self.store.set(self._key("member", rank), str(time.time()))
+
+    def start_heartbeat(self, rank: int):
+        def beat():
+            while not self._stop.is_set():
+                self.store.set(self._key("hb", rank), repr(time.time()))
+                self._stop.wait(self.interval)
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(self.interval + 1)
+
+    def last_heartbeat(self, rank: int) -> Optional[float]:
+        try:
+            return float(self.store.get(self._key("hb", rank), timeout=0.05))
+        except (TimeoutError, ValueError):
+            return None
+
+    def dead_members(self) -> List[int]:
+        now = time.time()
+        dead = []
+        for r in range(self.np):
+            hb = self.last_heartbeat(r)
+            if hb is None or now - hb > self.timeout:
+                dead.append(r)
+        return dead
+
+    def all_alive(self) -> bool:
+        return not self.dead_members()
+
+    # -- desired world size (scale in/out) -----------------------------------
+    def set_desired_np(self, np: int):
+        self.store.set(self._key("desired_np"), str(np))
+
+    def desired_np(self) -> int:
+        try:
+            return int(self.store.get(self._key("desired_np"), timeout=0.05))
+        except TimeoutError:
+            return self.np
+
+    def need_rescale(self) -> bool:
+        return self.desired_np() != self.np

@@ -13,7 +13,6 @@ KV, launch/controllers/master.py:73).
 from __future__ import annotations
 
 import ctypes
-import socket
 import struct
 import threading
 import time
@@ -222,11 +221,3 @@ def MasterStore(endpoint: str, world_size: int, rank: int,
     host, port = endpoint.rsplit(":", 1)
     return TCPStore(host, int(port), world_size, is_master=(rank == 0),
                     timeout=timeout)
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p

@@ -30,6 +30,11 @@ class TokenFileLoader:
     def __init__(self, path: str, batch_size: int, seq_len: int,
                  epochs: int = 1, stride: Optional[int] = None,
                  buffer_batches: int = 8):
+        import os
+        if not os.path.exists(path):
+            # the native reader cannot raise across the ABI — fail here so a
+            # mistyped path errors identically with and without the toolchain
+            raise FileNotFoundError(path)
         self.path = path
         self.batch_size = batch_size
         self.seq_len = seq_len
@@ -53,7 +58,8 @@ class TokenFileLoader:
                 if not ptr:
                     break  # reader finished and ring drained
                 raw = _native.take_bytes(lib, ptr, out_len.value)
-                arr = np.frombuffer(raw, dtype=np.int32).reshape(
+                # bytearray keeps the array writable, matching the fallback
+                arr = np.frombuffer(bytearray(raw), dtype=np.int32).reshape(
                     self.batch_size, window)
                 yield arr[:, :-1], arr[:, 1:]
         finally:

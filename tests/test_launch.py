@@ -1,0 +1,105 @@
+"""Launcher tests (reference pattern: subprocess-spawn with env rendezvous,
+test_dist_base.py:954; elastic restart fleet/elastic)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu import _native
+from paddle_tpu.distributed.launch import (CollectiveController, Context,
+                                           launch)
+from paddle_tpu.distributed.launch.elastic import ElasticManager
+from paddle_tpu.distributed.store import TCPStore
+
+NATIVE = _native.load() is not None
+pytestmark = pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+
+
+def test_launch_two_workers_env(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        out = sys.argv[1]
+        info = {k: os.environ.get(k) for k in
+                ["PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                 "PADDLE_TRAINER_ENDPOINTS", "PADDLE_LOCAL_RANK",
+                 "JAX_PROCESS_ID", "JAX_NUM_PROCESSES"]}
+        with open(os.path.join(out, "rank_%s.json" %
+                               os.environ["PADDLE_TRAINER_ID"]), "w") as f:
+            json.dump(info, f)
+    """))
+    rc = launch(["--nproc_per_node", "2", "--log_dir",
+                 str(tmp_path / "log"), str(script), str(tmp_path)])
+    assert rc == 0
+    for r in range(2):
+        info = json.load(open(tmp_path / f"rank_{r}.json"))
+        assert info["PADDLE_TRAINER_ID"] == str(r)
+        assert info["PADDLE_TRAINERS_NUM"] == "2"
+        assert info["JAX_PROCESS_ID"] == str(r)
+        assert len(info["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 2
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    rc = launch(["--nproc_per_node", "1", "--log_dir",
+                 str(tmp_path / "log"), str(script)])
+    assert rc == 7
+
+
+def test_elastic_restart_recovers(tmp_path):
+    """Worker fails on first attempt (marker file absent), succeeds on the
+    restart — elastic_level 1 must retry and exit 0."""
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempted"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").write("1")
+            sys.exit(101)
+        sys.exit(0)
+    """))
+    rc = launch(["--nproc_per_node", "1", "--elastic_level", "1",
+                 "--max_restarts", "2", "--log_dir", str(tmp_path / "log"),
+                 str(script)])
+    assert rc == 0
+    assert marker.exists()
+
+
+def test_elastic_level0_no_restart(tmp_path):
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempted"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").write("1")
+            sys.exit(101)
+        sys.exit(0)
+    """))
+    rc = launch(["--nproc_per_node", "1", "--log_dir",
+                 str(tmp_path / "log"), str(script)])
+    assert rc == 101
+
+
+def test_elastic_manager_heartbeats():
+    store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
+    em = ElasticManager(store, "job1", np=2, heartbeat_interval=0.1,
+                        heartbeat_timeout=0.5)
+    em.register(0)
+    em.start_heartbeat(0)
+    import time
+    time.sleep(0.3)
+    assert 0 not in em.dead_members()
+    assert 1 in em.dead_members()  # never heartbeated
+    em.stop()
+    time.sleep(0.7)
+    assert 0 in em.dead_members()  # heartbeat went stale after stop
+    assert em.desired_np() == 2
+    em.set_desired_np(3)
+    assert em.desired_np() == 3 and em.need_rescale()
+    store.close()
