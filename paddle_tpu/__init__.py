@@ -34,6 +34,7 @@ from . import incubate  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 

@@ -87,14 +87,16 @@ class Container:
         return self.proc.poll() if self.proc else None
 
     def terminate(self, grace: float = 5.0):
-        if not self.proc or self.proc.poll() is not None:
-            return
-        self.proc.send_signal(signal.SIGTERM)
-        try:
-            self.proc.wait(grace)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
-            self.proc.wait()
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(grace)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if getattr(self, "_log", None) is not None:
+            self._log.close()  # elastic restarts must not leak worker fds
+            self._log = None
 
 
 class CollectiveController:
@@ -103,6 +105,20 @@ class CollectiveController:
         self.master = Master(ctx)
         self.containers: List[Container] = []
         self.restarts = 0
+        self.generation = 0
+        self._elastic = None
+        if ctx.args.elastic_level >= 1:
+            from .elastic import ElasticManager
+            world = ctx.args.nnodes * ctx.nproc
+            self._elastic = ElasticManager(
+                self.master.store, ctx.args.job_id, np=world)
+
+    def _gen_key(self) -> str:
+        return f"rdzv/{self.ctx.args.job_id}/generation"
+
+    def _current_generation(self) -> int:
+        # add(key, 0) = atomic non-blocking read of the counter
+        return self.master.store.add(self._gen_key(), 0)
 
     # -- pod build -----------------------------------------------------------
     def _worker_env(self, global_rank: int, local_rank: int,
@@ -117,6 +133,10 @@ class CollectiveController:
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_CURRENT_ENDPOINT": endpoints[global_rank],
             "PADDLE_MASTER": ctx.args.master or "",
+            "PADDLE_JOB_ID": ctx.args.job_id,
+            # workers may opt into heartbeats via launch.elastic
+            "PADDLE_ELASTIC_STORE_ENDPOINT":
+                f"{self.master.store.host}:{self.master.store.port}",
             # jax.distributed knobs (read by init_parallel_env)
             "JAX_COORDINATOR_ADDRESS": coordinator,
             "JAX_NUM_PROCESSES": str(len(endpoints)),
@@ -128,6 +148,14 @@ class CollectiveController:
         return env
 
     def build_pod(self, generation: int = 0) -> List[str]:
+        self.generation = generation
+        if self._elastic is not None:
+            # stale membership from the previous generation must not trip
+            # the hang detector while the new pod is still registering
+            for r in range(self._elastic.np):
+                self._elastic.store.delete_key(
+                    self._elastic._key("member", r))
+                self._elastic.store.delete_key(self._elastic._key("hb", r))
         ctx = self.ctx
         base_port = 37000 + (os.getpid() + generation * 131) % 2000
         my_eps = [f"{ctx.node.ip}:{base_port + i}" for i in range(ctx.nproc)]
@@ -150,26 +178,56 @@ class CollectiveController:
         return endpoints
 
     # -- watch / elastic -----------------------------------------------------
+    def _restartable(self, code: int) -> bool:
+        """Level 1 restarts only explicit reschedule requests (reference
+        exit-code contract); level >= 2 restarts any failure."""
+        if self.ctx.args.elastic_level >= 2:
+            return True
+        return code in (ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE)
+
+    def _restart_pod(self):
+        """Bump the shared generation counter so EVERY node (not just the
+        failing one) tears down and re-rendezvouses at the new generation."""
+        new_gen = self.master.store.add(self._gen_key(), 1)
+        self.restarts += 1
+        self.build_pod(generation=new_gen)
+
     def watch(self, poll_interval: float = 0.2) -> int:
-        """Wait for the pod; on failure either tear down (level 0) or
-        rebuild the pod up to max_restarts (level >= 1). Returns exit
-        code."""
+        """Wait for the pod. On worker failure: tear down (level 0), or
+        rebuild across all nodes up to max_restarts (level >= 1 for
+        reschedule exit codes, level >= 2 for any failure). Hung workers
+        that opted into heartbeats (launch.elastic.worker_heartbeat) are
+        treated as failures. Returns the job exit code."""
         ctx = self.ctx
         while True:
             codes = [c.poll() for c in self.containers]
             if all(c == 0 for c in codes):
                 return 0
+
+            # another node already moved to a newer generation: follow it
+            if ctx.args.elastic_level >= 1 and ctx.is_multi_node:
+                cur = self._current_generation()
+                if cur > self.generation:
+                    for c in self.containers:
+                        c.terminate()
+                    self.restarts += 1
+                    self.build_pod(generation=cur)
+                    continue
+
             failed = [(i, c) for i, c in enumerate(codes)
                       if c is not None and c != 0]
-            if failed:
+            hung = (self._elastic.dead_registered_members()
+                    if self._elastic else [])
+            if failed or hung:
                 for c in self.containers:
                     c.terminate()
+                code = failed[0][1] if failed else ELASTIC_EXIT_CODE
                 if (ctx.args.elastic_level >= 1
-                        and self.restarts < ctx.args.max_restarts):
-                    self.restarts += 1
-                    self.build_pod(generation=self.restarts)
+                        and self.restarts < ctx.args.max_restarts
+                        and self._restartable(code)):
+                    self._restart_pod()
                     continue
-                return failed[0][1]
+                return code
             time.sleep(poll_interval)
 
     def stop(self):

@@ -70,6 +70,23 @@ class ElasticManager:
                 dead.append(r)
         return dead
 
+    def registered_members(self) -> List[int]:
+        out = []
+        for r in range(self.np):
+            try:
+                self.store.get(self._key("member", r), timeout=0.05)
+                out.append(r)
+            except TimeoutError:
+                pass
+        return out
+
+    def dead_registered_members(self) -> List[int]:
+        """Hang detection: only ranks that opted in (registered) are judged
+        by heartbeat staleness — scripts that never call worker_heartbeat
+        are watched by exit code alone."""
+        dead = set(self.dead_members())
+        return [r for r in self.registered_members() if r in dead]
+
     def all_alive(self) -> bool:
         return not self.dead_members()
 
@@ -85,3 +102,23 @@ class ElasticManager:
 
     def need_rescale(self) -> bool:
         return self.desired_np() != self.np
+
+
+def worker_heartbeat(interval: float = 1.0) -> Optional[ElasticManager]:
+    """Called from a training script launched by the launcher: registers
+    this rank and starts a background heartbeat so the controller's watch
+    loop can detect hangs (not just exits). No-op outside a launch job."""
+    import os
+    ep = os.environ.get("PADDLE_ELASTIC_STORE_ENDPOINT")
+    if not ep:
+        return None
+    from ..store import TCPStore
+    host, port = ep.rsplit(":", 1)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    store = TCPStore(host, int(port), world_size=world)
+    em = ElasticManager(store, job, np=world, heartbeat_interval=interval)
+    em.register(rank)
+    em.start_heartbeat(rank)
+    return em

@@ -1,0 +1,11 @@
+"""paddle.profiler equivalent (reference: python/paddle/profiler/ +
+C++ tracers paddle/fluid/platform/profiler/ — SURVEY §5 tracing)."""
+
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, SummaryView,
+                       export_chrome_tracing, make_scheduler)
+from .timer import Benchmark, benchmark
+from .utils import RecordEvent
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "RecordEvent", "SummaryView",
+           "Benchmark", "benchmark"]

@@ -1,0 +1,98 @@
+"""Step benchmark timer (reference: python/paddle/profiler/timer.py —
+`paddle.profiler.benchmark()` Timer: per-step reader/batch cost and ips
+with warmup skipping).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class _Stat:
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    """Measures reader (data-wait) and full-step cost; `ips` = samples/sec
+    over recorded steps (warmup steps skipped)."""
+
+    def __init__(self, warmup_steps: int = 3):
+        self.warmup_steps = warmup_steps
+        self.reset()
+
+    def reset(self):
+        self.step_count = 0
+        self.reader = _Stat()
+        self.step = _Stat()
+        self._step_start: Optional[float] = None
+        self._reader_start: Optional[float] = None
+        self._samples = 0
+
+    # reader span: time spent waiting on the data pipeline
+    def before_reader(self):
+        self._reader_start = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_start is None:
+            return
+        dt = time.perf_counter() - self._reader_start
+        if self.step_count >= self.warmup_steps:
+            self.reader.add(dt)
+        self._reader_start = None
+
+    def step_begin(self):
+        self._step_start = time.perf_counter()
+
+    def step_end(self, num_samples: int = 0):
+        if self._step_start is None:
+            return
+        dt = time.perf_counter() - self._step_start
+        if self.step_count >= self.warmup_steps:
+            self.step.add(dt)
+            self._samples += num_samples
+        self.step_count += 1
+        self._step_start = None
+
+    @property
+    def ips(self) -> float:
+        return self._samples / self.step.total if self.step.total else 0.0
+
+    def report(self) -> Dict[str, float]:
+        return {
+            "steps": self.step.count,
+            "avg_step_ms": self.step.avg * 1e3,
+            "min_step_ms": (0.0 if self.step.count == 0
+                            else self.step.min * 1e3),
+            "max_step_ms": self.step.max * 1e3,
+            "avg_reader_ms": self.reader.avg * 1e3,
+            "reader_ratio": (self.reader.total / self.step.total
+                             if self.step.total else 0.0),
+            "ips": self.ips,
+        }
+
+
+_global_benchmark: Optional[Benchmark] = None
+
+
+def benchmark() -> Benchmark:
+    global _global_benchmark
+    if _global_benchmark is None:
+        _global_benchmark = Benchmark()
+    return _global_benchmark

@@ -1,0 +1,108 @@
+"""Host-side event recording (reference: RecordEvent spans emitted by the
+C++ HostTracer, paddle/fluid/platform/profiler/host_tracer.cc; Python
+surface python/paddle/profiler/utils.py RecordEvent).
+
+TPU design: device-side tracing belongs to jax.profiler (XPlane/Perfetto);
+host spans are collected in-process so the Profiler can build the summary
+tables and a chrome trace without any vendor tooling, and are mirrored into
+jax.profiler.TraceAnnotation so they also appear on the device timeline
+when a jax trace is active.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["RecordEvent", "HostEvent", "EventCollector", "collector"]
+
+
+@dataclass
+class HostEvent:
+    name: str
+    start: float          # perf_counter seconds
+    end: float
+    tid: int
+    event_type: str = "UserDefined"
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class EventCollector:
+    """Process-global host event sink; enabled by an active Profiler."""
+
+    def __init__(self):
+        self._events: List[HostEvent] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, ev: HostEvent):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(ev)
+
+    def drain(self) -> List[HostEvent]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+    def clear(self):
+        self.drain()
+
+
+collector = EventCollector()
+
+
+class RecordEvent:
+    """Context manager/decorator recording one host span.
+
+    Usage: ``with profiler.RecordEvent("forward"): ...`` — nesting works,
+    and spans show on the jax device trace via TraceAnnotation."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start: Optional[float] = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+        if collector.enabled:
+            try:
+                import jax.profiler
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+
+    def end(self):
+        if self._start is None:
+            return
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        collector.add(HostEvent(self.name, self._start, time.perf_counter(),
+                                threading.get_ident(), self.event_type))
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name or fn.__qualname__, self.event_type):
+                return fn(*a, **kw)
+        return wrapped

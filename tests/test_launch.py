@@ -86,6 +86,29 @@ def test_elastic_level0_no_restart(tmp_path):
     assert rc == 101
 
 
+def test_hung_worker_detected_via_heartbeat(tmp_path):
+    """A worker that registers a heartbeat then deadlocks must be detected
+    and the job failed (level 0 → exit ELASTIC_EXIT_CODE=101)."""
+    script = tmp_path / "hang.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        sys.path.insert(0, %r)
+        from paddle_tpu.distributed.launch.elastic import worker_heartbeat
+        em = worker_heartbeat(interval=0.1)
+        em.interval = 0.1
+        time.sleep(0.5)   # heartbeat alive...
+        em.stop()         # ...then the 'hang': beats stop, process lives
+        time.sleep(60)
+    """ % os.getcwd()))
+    import time
+    t0 = time.time()
+    rc = launch(["--nproc_per_node", "1", "--elastic_level", "1",
+                 "--max_restarts", "0", "--log_dir", str(tmp_path / "log"),
+                 str(script)])
+    assert rc == 101
+    assert time.time() - t0 < 40, "hang was not detected promptly"
+
+
 def test_elastic_manager_heartbeats():
     store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
     em = ElasticManager(store, "job1", np=2, heartbeat_interval=0.1,

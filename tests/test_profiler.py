@@ -1,0 +1,115 @@
+"""Profiler tests (reference analog: test/legacy_test/test_profiler.py,
+test_newprofiler.py — scheduler windows, chrome export, summary)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.profiler import (Benchmark, Profiler, ProfilerState,
+                                 RecordEvent, export_chrome_tracing,
+                                 make_scheduler)
+
+
+def test_make_scheduler_windows():
+    s = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [s(i) for i in range(7)]
+    assert states == [
+        ProfilerState.CLOSED,            # skip_first
+        ProfilerState.CLOSED,            # closed
+        ProfilerState.READY,             # ready
+        ProfilerState.RECORD,            # record
+        ProfilerState.RECORD_AND_RETURN,  # last record of window
+        ProfilerState.CLOSED,            # repeat exhausted
+        ProfilerState.CLOSED,
+    ]
+
+
+def test_scheduler_repeat_forever():
+    s = make_scheduler(closed=1, ready=0, record=1)
+    assert s(0) == ProfilerState.CLOSED
+    assert s(1) == ProfilerState.RECORD_AND_RETURN
+    assert s(100) == ProfilerState.CLOSED
+    assert s(101) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_profiler_records_and_summarizes(tmp_path):
+    traces = []
+    p = Profiler(targets=[prof.ProfilerTarget.CPU],
+                 scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=1),
+                 on_trace_ready=lambda pr: traces.append(len(pr._recorded)))
+    p.start()
+    for i in range(4):
+        with RecordEvent("train_step"):
+            time.sleep(0.01)
+            with RecordEvent("inner"):
+                time.sleep(0.005)
+        p.step()
+    p.stop()
+    view = p.summary()
+    assert view.rows["train_step"]["calls"] == 2  # only the record window
+    assert view.rows["inner"]["calls"] == 2
+    assert view.rows["train_step"]["avg"] >= 0.01
+    assert traces, "on_trace_ready never fired"
+    assert "train_step" in str(view)
+
+
+def test_chrome_trace_export(tmp_path):
+    p = Profiler(targets=[prof.ProfilerTarget.CPU],
+                 on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    p.start()
+    with RecordEvent("alpha"):
+        time.sleep(0.002)
+    p.stop()
+    data = json.load(open(p.last_export_path))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "alpha" in names
+    ev = data["traceEvents"][names.index("alpha")]
+    assert ev["dur"] >= 2000  # microseconds
+
+
+def test_record_event_outside_profiler_is_noop():
+    from paddle_tpu.profiler.utils import collector
+    collector.clear()
+    with RecordEvent("ignored"):
+        pass
+    assert collector.drain() == []
+
+
+def test_tuple_scheduler_shorthand():
+    p = Profiler(targets=[prof.ProfilerTarget.CPU], scheduler=(1, 3))
+    p.start()
+    seen = [p.state]
+    for _ in range(4):
+        p.step()
+        seen.append(p.state)
+    p.stop()
+    assert ProfilerState.RECORD in seen or \
+        ProfilerState.RECORD_AND_RETURN in seen
+
+
+def test_benchmark_timer():
+    b = Benchmark(warmup_steps=1)
+    for i in range(4):
+        b.before_reader()
+        time.sleep(0.002)
+        b.after_reader()
+        b.step_begin()
+        time.sleep(0.008)
+        b.step_end(num_samples=32)
+    r = b.report()
+    assert r["steps"] == 3  # warmup skipped
+    assert r["avg_step_ms"] >= 8
+    assert r["ips"] > 0
+    assert 0 < r["reader_ratio"] < 1
+
+
+def test_profiler_as_context_manager():
+    with Profiler(targets=[prof.ProfilerTarget.CPU]) as p:
+        with RecordEvent("x"):
+            pass
+    assert p.summary().rows.get("x", {}).get("calls") == 1
