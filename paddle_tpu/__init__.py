@@ -33,6 +33,8 @@ from . import kernels  # noqa: F401  (registers Pallas fast paths)
 from . import incubate  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import vision  # noqa: F401

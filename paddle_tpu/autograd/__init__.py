@@ -1,0 +1,15 @@
+"""paddle.autograd equivalent (reference: python/paddle/autograd/ —
+PyLayer custom autograd py_layer.py, paddle.grad double-grad,
+saved_tensors_hooks; functional jacobian/hessian/vjp/jvp).
+
+TPU design: autodiff is jax's transform stack, so PyLayer lowers onto
+jax.custom_vjp (higher-order works for free), grad/jacobian/hessian/vjp/jvp
+are thin functional wrappers, and saved_tensors_hooks packs/unpacks the
+residuals PyLayer saves (the reference hooks the tape's TensorWrappers;
+here the ctx is the tape)."""
+
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks
+from .functional import grad, hessian, jacobian, jvp, vjp
+
+__all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks",
+           "grad", "jacobian", "hessian", "vjp", "jvp"]

@@ -1,0 +1,60 @@
+"""Functional autograd (reference: python/paddle/autograd/ — paddle.grad
+(double-grad capable), incubate.autograd jacobian/hessian/vjp/jvp)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "jacobian", "hessian", "vjp", "jvp"]
+
+
+def grad(func: Callable, argnums: Union[int, Sequence[int]] = 0,
+         has_aux: bool = False, allow_unused: bool = False,
+         create_graph: bool = True):
+    """Gradient transform. create_graph/allow_unused exist for surface
+    parity: jax grads are always differentiable (higher-order free), and
+    unused inputs get zero cotangents rather than None."""
+    del allow_unused, create_graph
+    return jax.grad(func, argnums=argnums, has_aux=has_aux)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    """Dense jacobian of func at xs (forward-over-reverse choice left to
+    jax). xs: array or tuple of arrays."""
+    del create_graph
+    if isinstance(xs, (tuple, list)):
+        return jax.jacrev(lambda *a: func(*a))(*xs)
+    return jax.jacrev(func)(xs)
+
+
+def hessian(func: Callable, xs, create_graph: bool = False):
+    del create_graph
+    if isinstance(xs, (tuple, list)):
+        return jax.hessian(lambda *a: func(*a))(*xs)
+    return jax.hessian(func)(xs)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Returns (outputs, vjp_result). v defaults to ones like the output
+    (reference behavior)."""
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    out, pullback = jax.vjp(func, *args)
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, out)
+    grads = pullback(v)
+    return out, grads[0] if single else grads
+
+
+def jvp(func: Callable, xs, v=None):
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    if v is None:
+        tangents = jax.tree.map(jnp.ones_like, args)
+    else:
+        tangents = (v,) if single else tuple(v)
+    out, tangent_out = jax.jvp(func, args, tangents)
+    return out, tangent_out

@@ -1,0 +1,169 @@
+"""PyLayer: user-defined forward/backward (reference:
+python/paddle/autograd/py_layer.py — PyLayer.apply drives a C++
+PyLayerNode on the tape; here it lowers to jax.custom_vjp so it composes
+with jit/grad/vmap and higher-order AD).
+
+Contract (reference-compatible):
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return 3 * x ** 2 * dy
+
+    y = Cube.apply(x)
+
+forward may return a single array or a tuple; backward must return one
+cotangent per differentiable forward input (same order).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+
+__all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+
+_hooks = threading.local()
+
+
+def _current_hooks():
+    return getattr(_hooks, "stack", [])
+
+
+class saved_tensors_hooks:
+    """Context manager transforming tensors as they are saved/restored for
+    backward (reference: paddle.autograd.saved_tensors_hooks — e.g. save to
+    host / recompute packs). Applies to PyLayerContext.save_for_backward."""
+
+    def __init__(self, pack_hook: Callable, unpack_hook: Callable):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        if not hasattr(_hooks, "stack"):
+            _hooks.stack = []
+        _hooks.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _hooks.stack.pop()
+        return False
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple[Any, ...] = ()
+        self._unpack: Optional[Callable] = None
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        hooks = _current_hooks()
+        if hooks:
+            h = hooks[-1]
+            self._saved = tuple(h.pack_hook(t) for t in tensors)
+            self._unpack = h.unpack_hook
+        else:
+            self._saved = tensors
+
+    def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(t) for t in self._saved)
+        return self._saved
+
+    # attribute stash (reference allows ctx.attr = value)
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def mark_not_inplace(self, *a, **kw):
+        pass
+
+    def mark_non_differentiable(self, *a, **kw):
+        raise NotImplementedError(
+            "mark_non_differentiable: return stop_gradient outputs instead")
+
+
+def _ctx_flatten(ctx: PyLayerContext):
+    # saved tensors are pytree children (traced values survive jit);
+    # everything else — unpack hook and user attrs — must be static
+    static_attrs = tuple(sorted(
+        (k, v) for k, v in ctx.__dict__.items()
+        if k not in ("_saved", "_unpack")))
+    return ctx._saved, (ctx._unpack, static_attrs)
+
+
+def _ctx_unflatten(aux, saved):
+    ctx = PyLayerContext.__new__(PyLayerContext)
+    object.__setattr__(ctx, "_saved", tuple(saved))
+    object.__setattr__(ctx, "_unpack", aux[0])
+    for k, v in aux[1]:
+        object.__setattr__(ctx, k, v)
+    return ctx
+
+
+jax.tree_util.register_pytree_node(PyLayerContext, _ctx_flatten,
+                                   _ctx_unflatten)
+
+
+class _PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        cls._cvjp_cache = None
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def _build(cls):
+        if cls._cvjp_cache is not None:
+            return cls._cvjp_cache
+
+        def fwd_plain(*args):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *args)
+            return out
+
+        @jax.custom_vjp
+        def op(*args):
+            return fwd_plain(*args)
+
+        def op_fwd(*args):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *args)
+            # residuals: the ctx payload (saved tensors + attrs travel as
+            # aux data; jax requires them to be jax types or static)
+            return out, (ctx, len(args))
+
+        def op_bwd(res, g):
+            ctx, n_in = res
+            grads = cls.backward(ctx, *(g if isinstance(g, tuple) else (g,)))
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            assert len(grads) == n_in, (
+                f"{cls.__name__}.backward returned {len(grads)} grads for "
+                f"{n_in} inputs")
+            return grads
+
+        op.defvjp(op_fwd, op_bwd)
+        cls._cvjp_cache = op
+        return op
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if kwargs:
+            raise TypeError("PyLayer.apply takes positional tensor args "
+                            "only (reference behavior for tensors)")
+        return cls._build()(*args)

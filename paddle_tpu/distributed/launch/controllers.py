@@ -156,6 +156,8 @@ class CollectiveController:
                 self._elastic.store.delete_key(
                     self._elastic._key("member", r))
                 self._elastic.store.delete_key(self._elastic._key("hb", r))
+            self._elastic.store.delete_key(
+                self._elastic._key("registered_count"))
         ctx = self.ctx
         base_port = 37000 + (os.getpid() + generation * 131) % 2000
         my_eps = [f"{ctx.node.ip}:{base_port + i}" for i in range(ctx.nproc)]
@@ -216,8 +218,16 @@ class CollectiveController:
 
             failed = [(i, c) for i, c in enumerate(codes)
                       if c is not None and c != 0]
-            hung = (self._elastic.dead_registered_members()
-                    if self._elastic else [])
+            # hang check is scoped to LOCAL ranks whose process is still
+            # alive: finished ranks are never re-judged, and heartbeat
+            # timestamps are compared against the clock that wrote them
+            hung = []
+            if self._elastic is not None:
+                first = ctx.args.node_rank * ctx.nproc
+                running = [first + i for i, c in enumerate(codes)
+                           if c is None]
+                if running:
+                    hung = self._elastic.dead_registered_members(running)
             if failed or hung:
                 for c in self.containers:
                     c.terminate()

@@ -39,8 +39,16 @@ class ElasticManager:
         return "/".join(("elastic", self.job_id) + tuple(map(str, parts)))
 
     # -- membership ----------------------------------------------------------
-    def register(self, rank: int):
+    def register(self, rank: int, interval: Optional[float] = None):
+        """Registers AND writes the first heartbeat atomically-enough: a
+        controller poll can never see a registered rank with no heartbeat.
+        The rank's own interval is published so the controller can scale
+        its staleness threshold instead of assuming the default."""
+        iv = self.interval if interval is None else interval
+        self.store.set(self._key("hb", rank), repr(time.time()))
+        self.store.set(self._key("hb_interval", rank), repr(iv))
         self.store.set(self._key("member", rank), str(time.time()))
+        self.store.add(self._key("registered_count"), 1)
 
     def start_heartbeat(self, rank: int):
         def beat():
@@ -61,18 +69,34 @@ class ElasticManager:
         except (TimeoutError, ValueError):
             return None
 
-    def dead_members(self) -> List[int]:
+    def _rank_timeout(self, rank: int) -> float:
+        """Staleness threshold scaled to the rank's published interval (a
+        worker beating every 10s must not be judged by a 5s default)."""
+        try:
+            iv = float(self.store.get(self._key("hb_interval", rank),
+                                      timeout=0.05))
+        except (TimeoutError, ValueError):
+            iv = self.interval
+        return max(self.timeout, 3.0 * iv)
+
+    def any_registered(self) -> bool:
+        # one cheap counter read; avoids 2*np store RPCs per watch tick
+        # when the training script never opted into heartbeats
+        return self.store.add(self._key("registered_count"), 0) > 0
+
+    def dead_members(self, ranks: Optional[List[int]] = None) -> List[int]:
         now = time.time()
         dead = []
-        for r in range(self.np):
+        for r in (range(self.np) if ranks is None else ranks):
             hb = self.last_heartbeat(r)
-            if hb is None or now - hb > self.timeout:
+            if hb is None or now - hb > self._rank_timeout(r):
                 dead.append(r)
         return dead
 
-    def registered_members(self) -> List[int]:
+    def registered_members(self, ranks: Optional[List[int]] = None
+                           ) -> List[int]:
         out = []
-        for r in range(self.np):
+        for r in (range(self.np) if ranks is None else ranks):
             try:
                 self.store.get(self._key("member", r), timeout=0.05)
                 out.append(r)
@@ -80,12 +104,18 @@ class ElasticManager:
                 pass
         return out
 
-    def dead_registered_members(self) -> List[int]:
+    def dead_registered_members(self, ranks: Optional[List[int]] = None
+                                ) -> List[int]:
         """Hang detection: only ranks that opted in (registered) are judged
         by heartbeat staleness — scripts that never call worker_heartbeat
-        are watched by exit code alone."""
-        dead = set(self.dead_members())
-        return [r for r in self.registered_members() if r in dead]
+        are watched by exit code alone. Pass `ranks` to scope the check
+        (the controller passes its LOCAL still-running ranks: heartbeats
+        are then compared against the same host's clock, and finished
+        ranks are never re-judged)."""
+        if not self.any_registered():
+            return []
+        reg = self.registered_members(ranks)
+        return self.dead_members(reg) if reg else []
 
     def all_alive(self) -> bool:
         return not self.dead_members()
@@ -119,6 +149,6 @@ def worker_heartbeat(interval: float = 1.0) -> Optional[ElasticManager]:
     job = os.environ.get("PADDLE_JOB_ID", "default")
     store = TCPStore(host, int(port), world_size=world)
     em = ElasticManager(store, job, np=world, heartbeat_interval=interval)
-    em.register(rank)
+    em.register(rank, interval)
     em.start_heartbeat(rank)
     return em

@@ -1,0 +1,16 @@
+"""paddle.jit equivalent: program capture + export (reference:
+python/paddle/jit/ — @to_static dy2static/SOT program_translator.py,
+jit.save/load via translated_layer.py, paddle.static.InputSpec).
+
+TPU design: jax.jit tracing IS the capture mechanism (no bytecode
+translator needed — SURVEY §7 item 10), so @to_static is a thin
+shape-keyed program cache over jax.jit that also handles Layers (params
+captured functionally). jit.save serializes the traced program as
+portable StableHLO via jax.export; jit.load rehydrates a TranslatedLayer
+that runs it — the AnalysisPredictor-style deploy artifact.
+"""
+
+from .api import InputSpec, TranslatedLayer, load, not_to_static, save, to_static
+
+__all__ = ["to_static", "not_to_static", "save", "load", "InputSpec",
+           "TranslatedLayer"]

@@ -1,0 +1,226 @@
+"""jit API implementation (reference: python/paddle/jit/api.py to_static/
+save/load; python/paddle/static/input_spec.py InputSpec)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer, functional_call, functional_train_graph
+
+__all__ = ["InputSpec", "to_static", "not_to_static", "save", "load",
+           "TranslatedLayer"]
+
+
+class InputSpec:
+    """Shape/dtype signature of one input; None dims mean dynamic in the
+    reference — here they must be bound before export (XLA wants static
+    shapes), so save() substitutes 1 for unknown batch dims by default."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+
+    def to_sds(self, dynamic_fill: int = 1) -> jax.ShapeDtypeStruct:
+        shape = tuple(dynamic_fill if d is None or d < 0 else int(d)
+                      for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    @classmethod
+    def from_tensor(cls, t, name=None) -> "InputSpec":
+        return cls(tuple(t.shape), t.dtype, name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class StaticFunction:
+    """@to_static product: shape-keyed cache of jitted programs.
+
+    For a Layer, params/buffers are captured once (functionally) so the
+    traced program is pure; `rollback` and train/eval mode pass through to
+    the underlying layer."""
+
+    def __init__(self, fn_or_layer, input_spec=None, full_graph=True,
+                 **options):
+        del full_graph, options
+        self._input_spec = input_spec
+        if isinstance(fn_or_layer, Layer):
+            self._layer = fn_or_layer
+            self._fn = None
+        else:
+            self._layer = None
+            self._fn = fn_or_layer
+        self._jitted = None
+
+    @property
+    def _callable(self) -> Callable:
+        if self._fn is not None:
+            return self._fn
+        layer = self._layer
+
+        def call(*args, **kw):
+            return layer(*args, **kw)
+        return call
+
+    def _build(self):
+        if self._jitted is None:
+            if self._layer is not None:
+                layer = self._layer
+                params, _, buffers = functional_train_graph(layer)
+                self._captured = (params, buffers)
+
+                def pure(params, buffers, *args, **kw):
+                    out, _ = functional_call(layer, params, buffers, *args,
+                                             **kw)
+                    return out
+                self._pure = pure
+                self._jitted = jax.jit(pure)
+            else:
+                self._pure = self._fn
+                self._captured = None
+                self._jitted = jax.jit(self._fn)
+        return self._jitted
+
+    def __call__(self, *args, **kw):
+        jitted = self._build()
+        if self._captured is not None:
+            params, buffers = self._captured
+            return jitted(params, buffers, *args, **kw)
+        return jitted(*args, **kw)
+
+    # -- introspection (reference surface) -----------------------------------
+    def concrete_program_specs(self) -> Optional[List[InputSpec]]:
+        return self._input_spec
+
+    def rollback(self):
+        """Return the original dygraph callable/layer."""
+        return self._layer if self._layer is not None else self._fn
+
+    def __get__(self, instance, owner):
+        # support decorating methods: bind like a normal function
+        if instance is None:
+            return self
+        import functools
+        return functools.partial(self.__call__, instance)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **options):
+    """Decorator: capture the callable as a compiled program (jax.jit)."""
+    del build_strategy, backend
+
+    def wrap(f):
+        if getattr(f, "_paddle_not_to_static", False):
+            return f
+        return StaticFunction(f, input_spec=input_spec, **options)
+
+    if function is None:
+        return wrap
+    return wrap(function)
+
+
+def not_to_static(fn):
+    """Mark a function to be skipped by to_static (reference surface)."""
+    fn._paddle_not_to_static = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load (TranslatedLayer): StableHLO artifact + params
+# ---------------------------------------------------------------------------
+def _example_inputs(input_spec, example_args):
+    if input_spec is not None:
+        return tuple(s.to_sds() if isinstance(s, InputSpec) else s
+                     for s in input_spec)
+    if example_args is not None:
+        return tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+                     for a in example_args)
+    raise ValueError("save() needs input_spec or example inputs")
+
+
+def save(obj, path: str, input_spec=None, example_args=None, **configs):
+    """Export `obj` (Layer, StaticFunction, or function) to `path`
+    (creates `path.pdmodel`-style pair: <path>.stablehlo + <path>.pdiparams).
+
+    The program is serialized as StableHLO (jax.export) with the params
+    BAKED IN as constants for Layers — the deploy artifact is
+    self-contained like the reference's combined save."""
+    from jax import export as jexport
+
+    if isinstance(obj, StaticFunction):
+        sf = obj
+    elif isinstance(obj, Layer) or callable(obj):
+        sf = to_static(obj, input_spec=input_spec)
+    else:
+        raise TypeError(f"cannot save {type(obj)}")
+    sf._build()
+
+    inputs = _example_inputs(input_spec or sf._input_spec, example_args)
+    if sf._captured is not None:
+        params, buffers = sf._captured
+
+        def deploy(*args):
+            return sf._pure(params, buffers, *args)
+    else:
+        deploy = sf._pure
+
+    exp = jexport.export(jax.jit(deploy))(*inputs)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(bytes(exp.serialize()))
+    meta = {
+        "in_specs": [(tuple(a.shape), str(a.dtype)) for a in exp.in_avals],
+        "out_specs": [(tuple(a.shape), str(a.dtype))
+                      for a in exp.out_avals],
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded deploy artifact (reference: translated_layer.py). Callable;
+    params are inside the program."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self._meta = meta
+
+    def __call__(self, *args):
+        args = tuple(jnp.asarray(a) for a in args)
+        out = self._exported.call(*args)
+        return out
+
+    @property
+    def input_spec(self):
+        return [InputSpec(s, d) for s, d in self._meta["in_specs"]]
+
+    @property
+    def output_spec(self):
+        return [InputSpec(s, d) for s, d in self._meta["out_specs"]]
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (params are "
+                           "baked into the exported program)")
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    from jax import export as jexport
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    with open(path + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, meta)

@@ -96,16 +96,13 @@ class SummaryView:
     summary tables)."""
 
     def __init__(self, events: Sequence[HostEvent]):
-        agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+        from .utils import Stat
+        agg = defaultdict(Stat)
         for e in events:
-            a = agg[e.name]
-            a[0] += 1
-            a[1] += e.duration
-            a[2] = min(a[2], e.duration)
-            a[3] = max(a[3], e.duration)
-        self.rows = {k: {"calls": v[0], "total": v[1], "min": v[2],
-                         "max": v[3], "avg": v[1] / v[0]}
-                     for k, v in agg.items()}
+            agg[e.name].add(e.duration)
+        self.rows = {k: {"calls": s.count, "total": s.total, "min": s.min,
+                         "max": s.max, "avg": s.avg}
+                     for k, s in agg.items()}
 
     def __str__(self):
         if not self.rows:

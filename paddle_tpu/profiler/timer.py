@@ -8,25 +8,9 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from .utils import Stat as _Stat
+
 __all__ = ["Benchmark", "benchmark"]
-
-
-class _Stat:
-    def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.min = float("inf")
-        self.max = 0.0
-
-    def add(self, v: float):
-        self.count += 1
-        self.total += v
-        self.min = min(self.min, v)
-        self.max = max(self.max, v)
-
-    @property
-    def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
 
 
 class Benchmark:

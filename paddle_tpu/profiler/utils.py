@@ -16,7 +16,28 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-__all__ = ["RecordEvent", "HostEvent", "EventCollector", "collector"]
+__all__ = ["RecordEvent", "HostEvent", "EventCollector", "collector", "Stat"]
+
+
+class Stat:
+    """count/total/min/max/avg accumulator shared by the timer and the
+    profiler summary."""
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
 
 
 @dataclass

@@ -1,0 +1,164 @@
+"""jit (to_static/save/load) + autograd (PyLayer, functional) tests
+(reference analogs: test/dygraph_to_static/, test/legacy_test/
+test_pylayer_op.py, test_autograd_functional.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.autograd import (PyLayer, grad, hessian, jacobian, jvp,
+                                 saved_tensors_hooks, vjp)
+from paddle_tpu.jit import InputSpec, load, save, to_static
+
+
+# ---------------------------------------------------------------------------
+# to_static
+# ---------------------------------------------------------------------------
+def test_to_static_function():
+    calls = []
+
+    @to_static
+    def f(x):
+        calls.append(1)  # traced once per shape
+        return jnp.sin(x) * 2
+
+    a = f(jnp.ones(4))
+    b = f(jnp.zeros(4))
+    np.testing.assert_allclose(np.asarray(a), np.sin(1.0) * 2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b), 0.0, atol=1e-7)
+    assert len(calls) == 1  # second call hit the program cache
+
+
+def test_to_static_layer():
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    static = to_static(layer)
+    x = jnp.ones((3, 4))
+    np.testing.assert_allclose(np.asarray(static(x)),
+                               np.asarray(layer(x)), rtol=1e-5)
+    assert static.rollback() is layer
+
+
+def test_jit_save_load_function(tmp_path):
+    @to_static
+    def f(x):
+        return x @ x.T + 1.0
+
+    p = str(tmp_path / "model")
+    save(f, p, input_spec=[InputSpec([3, 4], "float32")])
+    tl = load(p)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(tl(x)), np.asarray(f(x)),
+                               rtol=1e-5)
+    assert tl.input_spec[0].shape == (3, 4)
+
+
+def test_jit_save_load_layer_params_baked(tmp_path):
+    layer = nn.Linear(4, 2)
+    p = str(tmp_path / "linear")
+    save(layer, p, input_spec=[InputSpec([5, 4], "float32")])
+    tl = load(p)
+    x = jnp.ones((5, 4))
+    np.testing.assert_allclose(np.asarray(tl(x)), np.asarray(layer(x)),
+                               rtol=1e-5)
+    with pytest.raises(RuntimeError):
+        tl.train()
+
+
+# ---------------------------------------------------------------------------
+# PyLayer
+# ---------------------------------------------------------------------------
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x ** 3
+
+    @staticmethod
+    def backward(ctx, dy):
+        (x,) = ctx.saved_tensor()
+        return 3 * x ** 2 * dy
+
+
+def test_pylayer_forward_backward():
+    x = jnp.asarray(2.0)
+    y = Cube.apply(x)
+    assert float(y) == 8.0
+    g = jax.grad(lambda x: Cube.apply(x))(x)
+    assert float(g) == 12.0
+
+
+def test_pylayer_under_jit_and_higher_order():
+    x = jnp.asarray(3.0)
+    g = jax.jit(jax.grad(lambda x: Cube.apply(x)))(x)
+    assert float(g) == 27.0
+    gg = jax.grad(jax.grad(lambda x: Cube.apply(x)))(x)
+    assert float(gg) == 18.0  # d2/dx2 x^3 = 6x
+
+
+class TwoIn(PyLayer):
+    @staticmethod
+    def forward(ctx, a, b):
+        ctx.save_for_backward(a, b)
+        return a * b + a
+
+    @staticmethod
+    def backward(ctx, dy):
+        a, b = ctx.saved_tensor()
+        return dy * (b + 1), dy * a
+
+
+def test_pylayer_multiple_inputs():
+    a, b = jnp.asarray(2.0), jnp.asarray(5.0)
+    ga, gb = jax.grad(lambda a, b: TwoIn.apply(a, b), argnums=(0, 1))(a, b)
+    assert float(ga) == 6.0 and float(gb) == 2.0
+
+
+def test_saved_tensors_hooks():
+    packed, unpacked = [], []
+
+    def pack(t):
+        packed.append(t)
+        return np.asarray(t)  # e.g. offload to host
+
+    def unpack(t):
+        unpacked.append(t)
+        return jnp.asarray(t)
+
+    x = jnp.asarray(2.0)
+    with saved_tensors_hooks(pack, unpack):
+        g = jax.grad(lambda x: Cube.apply(x))(x)
+    assert float(g) == 12.0
+    assert packed and unpacked
+
+
+# ---------------------------------------------------------------------------
+# functional autograd
+# ---------------------------------------------------------------------------
+def test_grad_and_double_grad():
+    f = lambda x: jnp.sum(x ** 3)
+    x = jnp.asarray([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(grad(f)(x)), [3.0, 12.0])
+    gg = grad(lambda x: jnp.sum(grad(f)(x)))(x)
+    np.testing.assert_allclose(np.asarray(gg), [6.0, 12.0])
+
+
+def test_jacobian_hessian():
+    f = lambda x: jnp.stack([x[0] * x[1], x[0] ** 2])
+    x = jnp.asarray([2.0, 3.0])
+    J = jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(J), [[3.0, 2.0], [4.0, 0.0]])
+    h = hessian(lambda x: jnp.sum(x ** 3), x)
+    np.testing.assert_allclose(np.asarray(h), [[12.0, 0.0], [0.0, 18.0]])
+
+
+def test_vjp_jvp():
+    f = lambda x: x ** 2
+    x = jnp.asarray([1.0, 2.0])
+    out, g = vjp(f, x, jnp.asarray([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 4.0])
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+    out, t = jvp(f, x, jnp.asarray([1.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(t), [2.0, 0.0])

@@ -109,6 +109,29 @@ def test_hung_worker_detected_via_heartbeat(tmp_path):
     assert time.time() - t0 < 40, "hang was not detected promptly"
 
 
+def test_finished_rank_not_judged_hung(tmp_path):
+    """Rank 0 exits cleanly early; rank 1 keeps training past rank 0's
+    heartbeat staleness. The job must still succeed — finished ranks are
+    excluded from hang detection."""
+    script = tmp_path / "uneven.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        sys.path.insert(0, %r)
+        from paddle_tpu.distributed.launch.elastic import worker_heartbeat
+        em = worker_heartbeat(interval=0.2)
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        if rank == 0:
+            sys.exit(0)         # finishes immediately; hb goes stale
+        time.sleep(7)           # > heartbeat_timeout while rank 0 is stale
+        em.stop()
+        sys.exit(0)
+    """ % os.getcwd()))
+    rc = launch(["--nproc_per_node", "2", "--elastic_level", "1",
+                 "--max_restarts", "0", "--log_dir", str(tmp_path / "log"),
+                 str(script)])
+    assert rc == 0
+
+
 def test_elastic_manager_heartbeats():
     store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
     em = ElasticManager(store, "job1", np=2, heartbeat_interval=0.1,
