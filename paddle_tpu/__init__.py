@@ -34,6 +34,7 @@ from . import incubate  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import autograd  # noqa: F401
+from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401

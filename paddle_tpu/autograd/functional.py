@@ -22,18 +22,22 @@ def grad(func: Callable, argnums: Union[int, Sequence[int]] = 0,
 
 
 def jacobian(func: Callable, xs, create_graph: bool = False):
-    """Dense jacobian of func at xs (forward-over-reverse choice left to
-    jax). xs: array or tuple of arrays."""
+    """Dense jacobian of func at xs. xs: array, or tuple of arrays — the
+    tuple form differentiates w.r.t. EVERY input and returns a per-input
+    tuple (reference behavior)."""
     del create_graph
     if isinstance(xs, (tuple, list)):
-        return jax.jacrev(lambda *a: func(*a))(*xs)
+        argnums = tuple(range(len(xs)))
+        return jax.jacrev(func, argnums=argnums)(*xs)
     return jax.jacrev(func)(xs)
 
 
 def hessian(func: Callable, xs, create_graph: bool = False):
+    """Hessian blocks; tuple xs → tuple-of-tuples over all input pairs."""
     del create_graph
     if isinstance(xs, (tuple, list)):
-        return jax.hessian(lambda *a: func(*a))(*xs)
+        argnums = tuple(range(len(xs)))
+        return jax.hessian(func, argnums=argnums)(*xs)
     return jax.hessian(func)(xs)
 
 

@@ -150,14 +150,20 @@ class CollectiveController:
     def build_pod(self, generation: int = 0) -> List[str]:
         self.generation = generation
         if self._elastic is not None:
-            # stale membership from the previous generation must not trip
-            # the hang detector while the new pod is still registering
-            for r in range(self._elastic.np):
+            self._elastic.invalidate_cache()
+            # Stale membership from the previous generation must not trip
+            # the hang detector while the new pod registers. Only node 0
+            # cleans: workers start strictly after node 0 publishes its
+            # endpoints (sync_peers), which happens after this block — a
+            # per-node delete would race new registrations on fast nodes.
+            if self.ctx.args.node_rank == 0:
+                for r in range(self._elastic.np):
+                    self._elastic.store.delete_key(
+                        self._elastic._key("member", r))
+                    self._elastic.store.delete_key(
+                        self._elastic._key("hb", r))
                 self._elastic.store.delete_key(
-                    self._elastic._key("member", r))
-                self._elastic.store.delete_key(self._elastic._key("hb", r))
-            self._elastic.store.delete_key(
-                self._elastic._key("registered_count"))
+                    self._elastic._key("registered_count"))
         ctx = self.ctx
         base_port = 37000 + (os.getpid() + generation * 131) % 2000
         my_eps = [f"{ctx.node.ip}:{base_port + i}" for i in range(ctx.nproc)]

@@ -71,13 +71,20 @@ class ElasticManager:
 
     def _rank_timeout(self, rank: int) -> float:
         """Staleness threshold scaled to the rank's published interval (a
-        worker beating every 10s must not be judged by a 5s default)."""
-        try:
-            iv = float(self.store.get(self._key("hb_interval", rank),
-                                      timeout=0.05))
-        except (TimeoutError, ValueError):
-            iv = self.interval
-        return max(self.timeout, 3.0 * iv)
+        worker beating every 10s must not be judged by a 5s default).
+        The interval is immutable per generation, so it's fetched once."""
+        cache = self.__dict__.setdefault("_interval_cache", {})
+        if rank not in cache:
+            try:
+                cache[rank] = float(self.store.get(
+                    self._key("hb_interval", rank), timeout=0.05))
+            except (TimeoutError, ValueError):
+                return max(self.timeout, 3.0 * self.interval)  # not cached:
+                # the rank may simply not have registered yet
+        return max(self.timeout, 3.0 * cache[rank])
+
+    def invalidate_cache(self):
+        self.__dict__.pop("_interval_cache", None)
 
     def any_registered(self) -> bool:
         # one cheap counter read; avoids 2*np store RPCs per watch tick

@@ -1,0 +1,14 @@
+"""Inference engine (reference: paddle/fluid/inference/ —
+AnalysisConfig/AnalysisPredictor api/analysis_predictor.h, zero-copy
+tensors api/details/zero_copy_tensor.cc, create_predictor).
+
+TPU design: the reference's IR-analysis + TensorRT engine pipeline is
+XLA's job here. A deploy artifact is the StableHLO export from jit.save
+(params baked in); Predictor AOT-compiles it once at construction and
+runs with device-resident input handles — the zero-copy surface
+(copy_from_cpu / copy_to_cpu) maps to device_put / device_get.
+"""
+
+from .predictor import Config, Predictor, PredictorTensor, create_predictor
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]

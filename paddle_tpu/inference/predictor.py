@@ -1,0 +1,193 @@
+"""Predictor implementation (reference: AnalysisPredictor —
+paddle/fluid/inference/api/analysis_predictor.cc; Python surface
+paddle.inference.Config/create_predictor)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+
+
+class Config:
+    """Deploy configuration (reference: AnalysisConfig). Switches that XLA
+    owns natively (IR passes, memory optim, TensorRT) are accepted and
+    recorded for API parity but have no effect."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # jit.save writes <path>.stablehlo + <path>.pdiparams; accept either
+        # the bare prefix or the .stablehlo file
+        if model_path and model_path.endswith(".stablehlo"):
+            model_path = model_path[: -len(".stablehlo")]
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = "float32"
+        self._switches: Dict[str, bool] = {}
+
+    # -- model ---------------------------------------------------------------
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        if model_path.endswith(".stablehlo"):
+            model_path = model_path[: -len(".stablehlo")]
+        self._model_path = model_path
+        self._params_path = params_path
+
+    def model_path(self) -> Optional[str]:
+        return self._model_path
+
+    # -- device --------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        # GPU request maps to the accelerator backend (TPU here)
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "tpu"
+
+    def device(self):
+        devs = jax.devices()
+        accel = [d for d in devs if d.platform != "cpu"]
+        if self._device == "tpu" and accel:
+            return accel[min(self._device_id, len(accel) - 1)]
+        cpus = [d for d in devs if d.platform == "cpu"] or devs
+        return cpus[0]
+
+    # -- precision / passes (parity no-ops) ----------------------------------
+    def enable_memory_optim(self, *a, **kw):
+        self._switches["memory_optim"] = True
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switches["ir_optim"] = flag
+
+    def enable_mkldnn(self):
+        self._switches["mkldnn"] = True
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._switches["cpu_threads"] = n
+
+    def enable_bf16(self):
+        self._precision = "bfloat16"
+
+    def precision(self) -> str:
+        return self._precision
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_path}, device={self._device}:"
+                f"{self._device_id}, precision={self._precision})")
+
+
+class PredictorTensor:
+    """Zero-copy-style handle (reference: ZeroCopyTensor). copy_from_cpu
+    places data on the predictor's device; copy_to_cpu fetches results."""
+
+    def __init__(self, name: str, device, spec=None):
+        self.name = name
+        self._device = device
+        self._spec = spec  # (shape, dtype) expected by the program
+        self._value: Optional[jax.Array] = None
+
+    def reshape(self, shape: Sequence[int]):
+        pass  # shapes are fixed by the exported program
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if self._spec is not None:
+            shape, dtype = self._spec
+            data = np.ascontiguousarray(data, dtype=dtype)
+            if tuple(data.shape) != tuple(shape):
+                raise ValueError(
+                    f"input '{self.name}' expects shape {tuple(shape)}, "
+                    f"got {tuple(data.shape)}")
+        self._value = jax.device_put(data, self._device)
+
+    def share_external_data(self, array):
+        """Adopt an already-device-resident array without a copy."""
+        self._value = array
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert self._value is not None, f"tensor '{self.name}' is empty"
+        return np.asarray(jax.device_get(self._value))
+
+    @property
+    def shape(self):
+        if self._value is not None:
+            return tuple(self._value.shape)
+        return tuple(self._spec[0]) if self._spec else None
+
+
+class Predictor:
+    """Loads a jit.save artifact (or wraps a live callable), AOT-compiles
+    for the configured device, and runs with device-resident handles."""
+
+    def __init__(self, config: Config, fn=None):
+        self.config = config
+        self._device = config.device()
+        if fn is not None:
+            self._callable = fn
+            self._in_specs = None
+        else:
+            assert config.model_path(), "Config has no model path"
+            from ..jit import load as jit_load
+            tl = jit_load(config.model_path())
+            self._callable = tl
+            self._in_specs = [(s.shape, s.dtype) for s in tl.input_spec]
+            self._out_specs = [(s.shape, s.dtype) for s in tl.output_spec]
+        n_in = len(self._in_specs) if self._in_specs else 1
+        self._inputs: Dict[str, PredictorTensor] = {
+            f"input_{i}": PredictorTensor(
+                f"input_{i}", self._device,
+                self._in_specs[i] if self._in_specs else None)
+            for i in range(n_in)}
+        self._outputs: Dict[str, PredictorTensor] = {}
+
+    # -- reference surface ---------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs) or ["output_0"]
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Either positional `inputs` or previously-filled input handles."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        args = []
+        for name, h in self._inputs.items():
+            if h._value is None:
+                raise ValueError(f"input '{name}' not set")
+            args.append(h._value)
+        out = self._callable(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._outputs = {}
+        results = []
+        for i, o in enumerate(outs):
+            t = PredictorTensor(f"output_{i}", self._device)
+            t.share_external_data(o)
+            self._outputs[f"output_{i}"] = t
+            results.append(np.asarray(jax.device_get(o)))
+        return results
+
+    def clear_intermediate_tensor(self):
+        pass  # XLA owns buffer lifetimes
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -71,30 +71,43 @@ class StaticFunction:
             return layer(*args, **kw)
         return call
 
+    def _layer_state(self):
+        trainable, frozen, buffers = functional_train_graph(self._layer)
+        return {**trainable, **frozen}, buffers
+
     def _build(self):
         if self._jitted is None:
             if self._layer is not None:
                 layer = self._layer
-                params, _, buffers = functional_train_graph(layer)
-                self._captured = (params, buffers)
 
                 def pure(params, buffers, *args, **kw):
-                    out, _ = functional_call(layer, params, buffers, *args,
-                                             **kw)
-                    return out
+                    # returns new_buffers too: BatchNorm-style running
+                    # stats must flow back to the eager layer
+                    return functional_call(layer, params, buffers, *args,
+                                           **kw)
                 self._pure = pure
                 self._jitted = jax.jit(pure)
             else:
                 self._pure = self._fn
-                self._captured = None
                 self._jitted = jax.jit(self._fn)
         return self._jitted
 
+    def _write_buffers(self, new_buffers):
+        for lp, sub in self._layer.named_sublayers(include_self=True):
+            for name in sub._buffers:
+                key = f"{lp}.{name}" if lp else name
+                if key in new_buffers:
+                    sub._buffers[name] = new_buffers[key]
+
     def __call__(self, *args, **kw):
         jitted = self._build()
-        if self._captured is not None:
-            params, buffers = self._captured
-            return jitted(params, buffers, *args, **kw)
+        if self._layer is not None:
+            # read params FRESH each call (no retrace — same pytree shape):
+            # optimizer steps on the layer must be visible to the program
+            params, buffers = self._layer_state()
+            out, new_buffers = jitted(params, buffers, *args, **kw)
+            self._write_buffers(new_buffers)
+            return out
         return jitted(*args, **kw)
 
     # -- introspection (reference surface) -----------------------------------
@@ -106,11 +119,19 @@ class StaticFunction:
         return self._layer if self._layer is not None else self._fn
 
     def __get__(self, instance, owner):
-        # support decorating methods: bind like a normal function
+        # decorating methods: `self` must be CLOSED OVER, not traced —
+        # jitting the instance as an argument would try to abstract it
         if instance is None:
             return self
-        import functools
-        return functools.partial(self.__call__, instance)
+        cache = self.__dict__.setdefault("_bound", {})
+        key = id(instance)
+        if key not in cache:
+            fn = self._fn
+
+            def bound(*args, **kw):
+                return fn(instance, *args, **kw)
+            cache[key] = jax.jit(bound)
+        return cache[key]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
@@ -165,11 +186,12 @@ def save(obj, path: str, input_spec=None, example_args=None, **configs):
     sf._build()
 
     inputs = _example_inputs(input_spec or sf._input_spec, example_args)
-    if sf._captured is not None:
-        params, buffers = sf._captured
+    if sf._layer is not None:
+        params, buffers = sf._layer_state()  # snapshot at export time
 
         def deploy(*args):
-            return sf._pure(params, buffers, *args)
+            out, _ = sf._pure(params, buffers, *args)
+            return out
     else:
         deploy = sf._pure
 

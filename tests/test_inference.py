@@ -1,0 +1,84 @@
+"""Inference predictor tests (reference analog:
+test/legacy_test/test_inference_api.py — Config + create_predictor +
+zero-copy handles)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, Predictor, create_predictor
+from paddle_tpu.jit import InputSpec, save
+
+
+@pytest.fixture
+def artifact(tmp_path):
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path / "model")
+    save(layer, path, input_spec=[InputSpec([2, 4], "float32")])
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    ref = np.asarray(layer(jnp.asarray(x)))
+    return path, x, ref
+
+
+def test_predictor_run_positional(artifact):
+    path, x, ref = artifact
+    pred = create_predictor(Config(path))
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_predictor_handles_roundtrip(artifact):
+    path, x, ref = artifact
+    cfg = Config()
+    cfg.set_model(path + ".stablehlo")  # file-style path accepted
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert names == ["input_0"]
+    h = pred.get_input_handle("input_0")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_predictor_shape_validation(artifact):
+    path, x, ref = artifact
+    pred = create_predictor(Config(path))
+    with pytest.raises(ValueError):
+        pred.get_input_handle("input_0").copy_from_cpu(
+            np.zeros((3, 4), np.float32))
+
+
+def test_predictor_missing_input_raises(artifact):
+    path, _, _ = artifact
+    pred = create_predictor(Config(path))
+    with pytest.raises(ValueError):
+        pred.run()
+
+
+def test_config_surface():
+    cfg = Config("m")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    cfg.enable_bf16()
+    cfg.disable_gpu()
+    assert not cfg.use_gpu()
+    assert cfg.precision() == "bfloat16"
+    assert "m" in cfg.summary()
+
+
+def test_predictor_wraps_live_callable():
+    f = lambda x: x * 2 + 1
+    pred = Predictor(Config(), fn=f)
+    (out,) = pred.run([np.ones((3,), np.float32)])
+    np.testing.assert_allclose(out, np.full((3,), 3.0))
+
+
+def test_predictor_repeated_runs(artifact):
+    path, x, ref = artifact
+    pred = create_predictor(Config(path))
+    for _ in range(3):
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5)

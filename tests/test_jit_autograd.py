@@ -41,6 +41,39 @@ def test_to_static_layer():
     assert static.rollback() is layer
 
 
+def test_to_static_layer_sees_param_updates():
+    """Static layer must track eager parameter mutation (training loops)."""
+    layer = nn.Linear(4, 2)
+    static = to_static(layer)
+    x = jnp.ones((3, 4))
+    before = np.asarray(static(x))
+    layer.weight.value = layer.weight.value + 1.0
+    after = np.asarray(static(x))
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after, np.asarray(layer(x)), rtol=1e-5)
+
+
+def test_to_static_method_decorator():
+    class M:
+        def __init__(self, k):
+            self.k = k
+
+        @to_static
+        def f(self, x):
+            return x * self.k
+
+    m = M(3.0)
+    np.testing.assert_allclose(np.asarray(m.f(jnp.ones(4))), 3.0)
+    m2 = M(5.0)
+    np.testing.assert_allclose(np.asarray(m2.f(jnp.ones(4))), 5.0)
+
+
+def test_jacobian_tuple_inputs_all_args():
+    f = lambda x, y: x * y
+    Jx, Jy = jacobian(f, (jnp.asarray(2.0), jnp.asarray(3.0)))
+    assert float(Jx) == 3.0 and float(Jy) == 2.0
+
+
 def test_jit_save_load_function(tmp_path):
     @to_static
     def f(x):
