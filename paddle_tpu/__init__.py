@@ -34,6 +34,9 @@ from . import incubate  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import autograd  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401

@@ -1,0 +1,291 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+Distribution base, Normal, Uniform, Categorical, Bernoulli, ... and
+kl_divergence registry kl.py).
+
+TPU design: pure jnp math + threefry sampling (rng keys in, arrays out) so
+every method composes with jit/vmap/grad; the reference's curand-backed
+in-place samplers become functional `sample(shape, key)`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "Gumbel", "LogNormal", "kl_divergence",
+           "register_kl"]
+
+
+class Distribution:
+    def sample(self, shape=(), key=None):
+        raise NotImplementedError
+
+    def rsample(self, shape=(), key=None):
+        return self.sample(shape, key)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def _key(self, key):
+        return next_key() if key is None else key
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(self._key(key), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        var = jnp.square(self.scale)
+        return (-jnp.square(value - self.loc) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            self.scale) + jnp.zeros_like(self.loc)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(jnp.square(self.scale),
+                                jnp.broadcast_shapes(self.loc.shape,
+                                                     self.scale.shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+
+    def sample(self, shape=(), key=None):
+        return jnp.exp(self.base.sample(shape, key))
+
+    def log_prob(self, value):
+        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.base.loc + jnp.square(self.base.scale) / 2)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(self._key(key), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return jnp.square(self.high - self.low) / 12
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        assert (logits is None) != (probs is None), \
+            "exactly one of logits/probs"
+        if probs is not None:
+            probs = jnp.asarray(probs, jnp.float32)
+            self.logits = jnp.log(probs / jnp.sum(probs, -1, keepdims=True))
+        else:
+            self.logits = jnp.asarray(logits, jnp.float32)
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, -1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(self._key(key), self.logits,
+                                      shape=tuple(shape) + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return jnp.take_along_axis(
+            logp, value[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def probs(self):
+        return self.probs_
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.probs_.shape
+        return jax.random.bernoulli(self._key(key), self.probs_,
+                                    shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return self.probs_
+
+    @property
+    def variance(self):
+        return self.probs_ * (1 - self.probs_)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.exponential(self._key(key), shape) / self.rate
+
+    def log_prob(self, value):
+        lp = jnp.log(self.rate) - self.rate * value
+        return jnp.where(value >= 0, lp, -jnp.inf)  # support is [0, inf)
+
+    def entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.laplace(self._key(key),
+                                                          shape)
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return 1.0 + jnp.log(2 * self.scale) + jnp.zeros_like(self.loc)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.gumbel(self._key(key),
+                                                         shape)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+
+# -- KL registry -------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p: Categorical, q: Categorical):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), -1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p: Bernoulli, q: Bernoulli):
+    pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (
+        jnp.log1p(-pp) - jnp.log1p(-qq))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p: Uniform, q: Uniform):
+    # KL is finite only when support(p) ⊆ support(q)
+    inside = (p.low >= q.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where(inside, kl, jnp.inf)

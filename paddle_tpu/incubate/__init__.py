@@ -1,4 +1,5 @@
 """paddle.incubate equivalent namespace (fused-op API surface)."""
 
+from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401

@@ -1,0 +1,127 @@
+"""ASP: automatic 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/asp.py — calculate_density, create_mask 2:4
+patterns, decorate/prune_model maintaining masks through the optimizer).
+
+TPU note: TPUs have no sparse-tensor-core equivalent, so 2:4 here is a
+model-compression/regularization tool (mask maintained through training);
+the masked weights still run dense on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2_4",
+           "prune_model", "decorate", "get_masks"]
+
+# masks are keyed by id(Parameter) (identity survives renames and multiple
+# models with colliding tree names); the tree-name index is per-model-object
+_MASKS_BY_PARAM: Dict[int, jax.Array] = {}
+_MASKS_BY_NAME: Dict[str, jax.Array] = {}  # last prune_model's tree names
+
+
+def calculate_density(x) -> float:
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / max(x.size, 1)
+
+
+def create_mask(w, n: int = 2, m: int = 4):
+    """Keep the n largest-|w| of every m consecutive weights on the last
+    axis (reference mask_1d pattern)."""
+    shape = w.shape
+    assert shape[-1] % m == 0, f"last dim {shape[-1]} not divisible by {m}"
+    grouped = jnp.abs(jnp.asarray(w)).reshape(-1, m)
+    # threshold = n-th largest per group; ties broken by index via argsort
+    order = jnp.argsort(-grouped, axis=-1)
+    keep = order[:, :n]
+    mask = jnp.zeros_like(grouped)
+    rows = jnp.arange(grouped.shape[0])[:, None]
+    mask = mask.at[rows, keep].set(1.0)
+    return mask.reshape(shape)
+
+
+def check_mask_2_4(mask, n: int = 2, m: int = 4) -> bool:
+    g = np.asarray(mask).reshape(-1, m)
+    return bool(np.all(g.sum(-1) == n))
+
+
+def _eligible(p) -> bool:
+    return p.value.ndim == 2 and p.value.shape[-1] % 4 == 0
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True) -> Dict:
+    """Apply 2:4 masks to every eligible weight now; masks are remembered
+    so `decorate`d optimizers keep enforcing them. Tree-name masks returned
+    here feed the functional apply() path; the eager step() path matches by
+    Parameter identity, so multiple pruned models coexist."""
+    del mask_algo
+    out = {}
+    for name, p in model.named_parameters():
+        if not _eligible(p):
+            continue
+        mask = create_mask(p.value, n, m)
+        p.value = p.value * mask
+        if with_mask:
+            _MASKS_BY_PARAM[id(p)] = mask
+            out[name] = mask
+    _MASKS_BY_NAME.clear()
+    _MASKS_BY_NAME.update(out)
+    return out
+
+
+def get_masks() -> Dict[str, jax.Array]:
+    return dict(_MASKS_BY_NAME)
+
+
+def decorate(optimizer, masks: Optional[Dict[str, jax.Array]] = None):
+    """Wrap an optimizer so every step re-applies the sparsity masks
+    (reference: asp.decorate → OptimizerWithSparsityGuarantee).
+
+    The functional apply() path uses `masks` (tree-name keyed), snapshotted
+    at decorate time — pass prune_model's return value when training more
+    than one pruned model."""
+    snapshot = dict(_MASKS_BY_NAME) if masks is None else dict(masks)
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def init_state(self, params):
+            return self._inner.init_state(params)
+
+        def apply(self, params, grads, state, lr=None):
+            new_params, new_state = self._inner.apply(params, grads, state,
+                                                      lr)
+
+            def mask_leaf(path, v):
+                key = ".".join(str(getattr(p, "key", p)) for p in path)
+                m = snapshot.get(key)
+                return v * m if m is not None else v
+            new_params = jax.tree_util.tree_map_with_path(mask_leaf,
+                                                          new_params)
+            return new_params, new_state
+
+        def step(self):
+            out = self._inner.step()
+            # eager surface: re-mask by Parameter identity (tree names and
+            # Parameter.name spellings differ — identity always matches)
+            params = getattr(self._inner, "_parameter_list", None) or []
+            for p in params:
+                m = _MASKS_BY_PARAM.get(id(p))
+                if m is not None:
+                    p.value = p.value * m
+            return out
+
+        def __getattr__(self, item):
+            if item == "_inner":
+                raise AttributeError(item)
+            return getattr(self._inner, item)
+
+    return _ASPOptimizer(optimizer)

@@ -130,12 +130,19 @@ class Predictor:
     """Loads a jit.save artifact (or wraps a live callable), AOT-compiles
     for the configured device, and runs with device-resident handles."""
 
-    def __init__(self, config: Config, fn=None):
+    def __init__(self, config: Config, fn=None, num_inputs: int = None):
         self.config = config
         self._device = config.device()
         if fn is not None:
             self._callable = fn
             self._in_specs = None
+            if num_inputs is None:
+                import inspect
+                try:
+                    num_inputs = len(inspect.signature(fn).parameters)
+                except (TypeError, ValueError):
+                    num_inputs = 1
+            self._n_in = max(num_inputs, 1)
         else:
             assert config.model_path(), "Config has no model path"
             from ..jit import load as jit_load
@@ -143,7 +150,8 @@ class Predictor:
             self._callable = tl
             self._in_specs = [(s.shape, s.dtype) for s in tl.input_spec]
             self._out_specs = [(s.shape, s.dtype) for s in tl.output_spec]
-        n_in = len(self._in_specs) if self._in_specs else 1
+            self._n_in = len(self._in_specs)
+        n_in = self._n_in
         self._inputs: Dict[str, PredictorTensor] = {
             f"input_{i}": PredictorTensor(
                 f"input_{i}", self._device,

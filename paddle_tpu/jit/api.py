@@ -119,19 +119,35 @@ class StaticFunction:
         return self._layer if self._layer is not None else self._fn
 
     def __get__(self, instance, owner):
-        # decorating methods: `self` must be CLOSED OVER, not traced —
-        # jitting the instance as an argument would try to abstract it
+        # decorating methods: `self` cannot be traced as a jit argument, so
+        # it rides as a STATIC argument with the instance's current scalar
+        # attributes folded into the trace key — mutating e.g. `self.k`
+        # between calls retraces instead of silently returning stale
+        # results (array-valued attrs are still baked per trace).
         if instance is None:
             return self
-        cache = self.__dict__.setdefault("_bound", {})
-        key = id(instance)
-        if key not in cache:
-            fn = self._fn
+        import functools
+        fn = self._fn
 
-            def bound(*args, **kw):
-                return fn(instance, *args, **kw)
-            cache[key] = jax.jit(bound)
-        return cache[key]
+        @functools.wraps(fn)
+        def bound(*args, **kw):
+            statics = tuple(sorted(
+                (k, v) for k, v in vars(instance).items()
+                if isinstance(v, (int, float, bool, str, type(None)))))
+            return _method_jit(fn)(statics, instance, *args, **kw)
+        return bound
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _method_jit(fn):
+    """One jitted entry per decorated method; `statics` (hashable instance
+    attrs) is a static argument so attribute changes retrace, and the
+    instance itself is closed over per call via static_argnums."""
+    return jax.jit(lambda statics, inst, *args, **kw: fn(inst, *args, **kw),
+                   static_argnums=(0, 1))
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

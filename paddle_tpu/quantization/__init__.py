@@ -1,0 +1,190 @@
+"""Quantization (reference: python/paddle/quantization/ — QAT fake-quant
+framework, PTQ observers; kernels paddle/phi/kernels/.../quantize_*).
+
+TPU design: fake-quant as straight-through-estimator ops (custom_vjp),
+QuantConfig + QAT wrapper inserting FakeQuant layers around Linear/Conv;
+PTQ observers collect absmax ranges. int8 execution itself is left to XLA
+(native int8 matmul on TPU via preferred_element_type) — the framework
+layer's job is producing the quantized weights + scales.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quant", "dequantize", "quantize_weights", "AbsmaxObserver",
+           "FakeQuant", "QuantConfig", "QAT", "PTQ"]
+
+
+def absmax_scale(x):
+    """Symmetric per-tensor scale — THE quantization range used by fake-
+    quant, weight quantization and observers alike (one floor constant)."""
+    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+
+
+@jax.custom_vjp
+def fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _fq_fwd(x, scale, bits=8):
+    return fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through: pass gradient inside the clip range, zero outside
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_weights(w, bits: int = 8):
+    """Symmetric per-tensor int quantization. Returns (int_values, scale)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = absmax_scale(w)
+    q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale, bits: int = 8):
+    qmax = 2.0 ** (bits - 1) - 1
+    return q.astype(jnp.float32) * scale / qmax
+
+
+class AbsmaxObserver:
+    """PTQ range observer (reference: quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(x))))
+        return x
+
+    @property
+    def scale(self) -> float:
+        return max(self._absmax, 1e-8)
+
+
+class FakeQuant(Layer):
+    """QAT fake-quant node with a learned-from-data running scale."""
+
+    def __init__(self, bits: int = 8, momentum: float = 0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", jnp.asarray(1.0))
+
+    def forward(self, x):
+        if self.training:
+            cur = absmax_scale(x)
+            new = self.momentum * self.scale + (1 - self.momentum) * cur
+            self.scale = new
+        return fake_quant(x, jnp.asarray(self.scale), self.bits)
+
+
+class QuantConfig:
+    """(reference: quantization/config.py) — which layer types to quantize
+    and with how many bits."""
+
+    def __init__(self, activation_bits: int = 8, weight_bits: int = 8,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self.types = tuple(quantizable_layer_type)
+
+
+class _QuantWrapper(Layer):
+    def __init__(self, inner: Layer, cfg: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_q = FakeQuant(cfg.activation_bits)
+        self.w_bits = cfg.weight_bits
+
+    def forward(self, x):
+        x = self.act_q(x)
+        w = self.inner.weight.value
+        scale = absmax_scale(w)
+        orig = w
+        self.inner.weight.value = fake_quant(w, scale, self.w_bits)
+        try:
+            out = self.inner(x)
+        finally:
+            self.inner.weight.value = orig
+        return out
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py
+    QAT.quantize wraps eligible layers with fake-quant)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer) -> Layer:
+        def convert(layer: Layer) -> Layer:
+            for name, sub in list(layer._sub_layers.items()):
+                if type(sub).__name__ in self.config.types and hasattr(
+                        sub, "weight"):
+                    layer._sub_layers[name] = _QuantWrapper(sub, self.config)
+                else:
+                    convert(sub)
+            return layer
+        return convert(model)
+
+    def convert(self, model: Layer) -> Dict[str, tuple]:
+        """Produce deploy weights: {param_name: (int8_values, scale)}."""
+        out = {}
+        for name, p in model.named_parameters():
+            if p.value.ndim >= 2:
+                out[name] = quantize_weights(p.value,
+                                             self.config.weight_bits)
+        return out
+
+
+class PTQ:
+    """Post-training quantization: observe activations on calibration data,
+    then emit scales (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self.observers: Dict[str, AbsmaxObserver] = {}
+
+    def quantize(self, model: Layer) -> Layer:
+        ptq = self
+
+        class _Observed(Layer):
+            def __init__(self, inner, name):
+                super().__init__()
+                self.inner = inner
+                self._obs_name = name
+
+            def forward(self, x):
+                ptq.observers[self._obs_name].observe(x)
+                return self.inner(x)
+
+        def convert(layer: Layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                path = f"{prefix}.{name}" if prefix else name
+                if type(sub).__name__ in self.config.types:
+                    self.observers[path] = AbsmaxObserver(
+                        self.config.activation_bits)
+                    layer._sub_layers[name] = _Observed(sub, path)
+                else:
+                    convert(sub, path)
+            return layer
+        return convert(model)
+
+    def scales(self) -> Dict[str, float]:
+        return {k: o.scale for k, o in self.observers.items()}

@@ -1,0 +1,94 @@
+"""Sparse tensors (reference: paddle/phi/core/sparse_coo_tensor.h /
+sparse_csr_tensor.h, kernels paddle/phi/kernels/sparse/, Python
+python/paddle/sparse/).
+
+TPU design: wraps jax.experimental.sparse BCOO (TPU-lowerable; XLA turns
+sparse@dense matmuls into gather/scatter + MXU tiles). CSR is kept as a
+view-format conversion — BCOO is the compute format on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_sparse", "to_dense", "to_sparse_coo", "add", "matmul",
+           "masked_matmul", "nnz", "relu", "tanh"]
+
+SparseCooTensor = jsparse.BCOO
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """indices: [ndim, nnz] (reference layout); values: [nnz]."""
+    del place, stop_gradient
+    indices = jnp.asarray(indices, jnp.int32).T  # BCOO wants [nnz, ndim]
+    values = jnp.asarray(values, dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in jnp.max(indices, axis=0))
+    return jsparse.BCOO((values, indices), shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """Build from CSR triplets; stored as BCOO (the TPU compute format)."""
+    crows = np.asarray(crows)
+    cols = np.asarray(cols)
+    values = jnp.asarray(values, dtype)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.asarray(np.stack([rows, cols]), jnp.int32).T
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, jsparse.JAXSparse)
+
+
+def to_dense(x):
+    return x.todense() if is_sparse(x) else jnp.asarray(x)
+
+
+def to_sparse_coo(x, sparse_dim: Optional[int] = None):
+    del sparse_dim
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+def nnz(x) -> int:
+    return int(x.nse)
+
+
+def add(a, b):
+    if is_sparse(a) and is_sparse(b):
+        return jsparse.bcoo_add(a, b) if hasattr(jsparse, "bcoo_add") else \
+            to_sparse_coo(a.todense() + b.todense())
+    return to_dense(a) + to_dense(b)
+
+
+def matmul(a, b):
+    """sparse @ dense (or dense @ sparse) — XLA lowers the gather/dot."""
+    return a @ b
+
+
+def masked_matmul(a, b, mask):
+    """(a @ b) sampled at mask's sparsity pattern (reference:
+    paddle.sparse.masked_matmul) — SDDMM."""
+    dense = jnp.asarray(a) @ jnp.asarray(b)
+    idx = mask.indices  # [nnz, 2]
+    vals = dense[idx[:, 0], idx[:, 1]]
+    return jsparse.BCOO((vals, mask.indices), shape=mask.shape)
+
+
+def _unary(fn):
+    def op(x):
+        if is_sparse(x):
+            return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape)
+        return fn(jnp.asarray(x))
+    return op
+
+
+relu = _unary(jax.nn.relu)
+tanh = _unary(jnp.tanh)

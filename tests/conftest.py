@@ -18,3 +18,7 @@ def _seed_all():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+    # fleet.init / set_hybrid_communicate_group is process-global by design
+    # (reference semantics); tests must not leak it into each other
+    from paddle_tpu.distributed import set_hybrid_communicate_group
+    set_hybrid_communicate_group(None)

@@ -1,0 +1,200 @@
+"""Aux-domain tests: distribution, sparse, quantization, ASP
+(reference analogs: test/distribution/, test/legacy_test/test_sparse_*.py,
+test/quantization/, test/asp/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distribution import (Bernoulli, Categorical, Normal, Uniform,
+                                     kl_divergence)
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (PTQ, QAT, AbsmaxObserver, QuantConfig,
+                                     dequantize, fake_quant, quantize_weights)
+from paddle_tpu import sparse
+
+
+# -- distribution ------------------------------------------------------------
+def test_normal_sampling_and_logprob():
+    d = Normal(1.0, 2.0)
+    s = d.sample((20000,), key=jax.random.PRNGKey(0))
+    assert abs(float(jnp.mean(s)) - 1.0) < 0.1
+    assert abs(float(jnp.std(s)) - 2.0) < 0.1
+    lp = d.log_prob(jnp.asarray(1.0))
+    assert abs(float(lp) - (-np.log(2.0) - 0.5 * np.log(2 * np.pi))) < 1e-5
+    assert abs(float(d.cdf(jnp.asarray(1.0))) - 0.5) < 1e-6
+
+
+def test_kl_normal_closed_form_matches_monte_carlo():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    kl = float(kl_divergence(p, q))
+    x = p.sample((200000,), key=jax.random.PRNGKey(1))
+    mc = float(jnp.mean(p.log_prob(x) - q.log_prob(x)))
+    assert abs(kl - mc) < 0.02
+
+
+def test_categorical_and_bernoulli():
+    c = Categorical(logits=jnp.log(jnp.asarray([0.2, 0.3, 0.5])))
+    s = c.sample((50000,), key=jax.random.PRNGKey(2))
+    freq = np.bincount(np.asarray(s), minlength=3) / 50000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    assert abs(float(c.entropy())
+               - float(-(0.2 * np.log(0.2) + 0.3 * np.log(0.3)
+                         + 0.5 * np.log(0.5)))) < 1e-5
+    b = Bernoulli(0.3)
+    np.testing.assert_allclose(float(b.variance), 0.21, rtol=1e-6)
+    k = kl_divergence(Categorical(logits=c.logits),
+                      Categorical(logits=jnp.zeros(3)))
+    assert float(k) > 0
+
+
+def test_uniform_kl_support():
+    assert float(kl_divergence(Uniform(0.2, 0.8), Uniform(0.0, 1.0))) > 0
+    assert np.isinf(float(kl_divergence(Uniform(0.0, 2.0),
+                                        Uniform(0.0, 1.0))))
+
+
+def test_distribution_grad_flows():
+    def loss(mu):
+        return -Normal(mu, 1.0).log_prob(jnp.asarray(2.0))
+    g = jax.grad(loss)(jnp.asarray(0.0))
+    assert float(g) == -2.0  # d/dmu of (x-mu)^2/2 at mu=0, x=2
+
+
+# -- sparse ------------------------------------------------------------------
+def test_sparse_coo_roundtrip_and_matmul():
+    dense = np.zeros((4, 6), np.float32)
+    dense[0, 1] = 2.0
+    dense[3, 5] = -1.0
+    s = sparse.sparse_coo_tensor([[0, 3], [1, 5]], [2.0, -1.0], (4, 6))
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(s)), dense)
+    assert sparse.nnz(s) == 2
+    w = jnp.ones((6, 3))
+    np.testing.assert_allclose(np.asarray(sparse.matmul(s, w)),
+                               dense @ np.ones((6, 3)), rtol=1e-6)
+
+
+def test_sparse_from_dense_and_unary():
+    x = jnp.asarray([[0.0, -2.0], [3.0, 0.0]])
+    s = sparse.to_sparse_coo(x)
+    r = sparse.relu(s)
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(r)),
+                                  [[0.0, 0.0], [3.0, 0.0]])
+
+
+def test_sparse_csr_and_masked_matmul():
+    s = sparse.sparse_csr_tensor([0, 1, 2], [1, 0], [5.0, 7.0], (2, 2))
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(s)),
+                                  [[0.0, 5.0], [7.0, 0.0]])
+    a = jnp.ones((2, 3)); b = jnp.ones((3, 2))
+    out = sparse.masked_matmul(a, b, s)
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(out)),
+                                  [[0.0, 3.0], [3.0, 0.0]])
+
+
+# -- quantization ------------------------------------------------------------
+def test_fake_quant_ste_gradient():
+    x = jnp.asarray([0.5, 2.0])  # second element outside scale
+    scale = jnp.asarray(1.0)
+    y = fake_quant(x, scale)
+    assert abs(float(y[0]) - 0.5) < 0.01
+    g = jax.grad(lambda x: jnp.sum(fake_quant(x, scale)))(x)
+    np.testing.assert_array_equal(np.asarray(g), [1.0, 0.0])  # STE
+
+
+def test_quantize_dequantize_roundtrip():
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 16).astype(np.float32))
+    q, scale = quantize_weights(w)
+    assert q.dtype == jnp.int8
+    err = np.abs(np.asarray(dequantize(q, scale)) - np.asarray(w)).max()
+    assert err < float(scale) / 127 + 1e-6
+
+
+def test_qat_wraps_and_trains():
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    qat = QAT(QuantConfig())
+    qmodel = qat.quantize(model)
+    out = qmodel(jnp.ones((4, 8)))
+    assert out.shape == (4, 2)
+    deploy = qat.convert(model)
+    assert deploy and all(v[0].dtype == jnp.int8 for v in deploy.values())
+
+
+def test_ptq_observers_collect_scales():
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = PTQ(QuantConfig())
+    pmodel = ptq.quantize(model)
+    for _ in range(3):
+        pmodel(jnp.asarray(np.random.RandomState(1).randn(4, 8)
+                           .astype(np.float32)))
+    scales = ptq.scales()
+    assert len(scales) == 2 and all(v > 0 for v in scales.values())
+
+
+def test_exponential_support():
+    from paddle_tpu.distribution import Exponential
+    d = Exponential(2.0)
+    assert np.isinf(-float(d.log_prob(jnp.asarray(-1.0))))
+    assert np.isfinite(float(d.log_prob(jnp.asarray(1.0))))
+
+
+# -- ASP ---------------------------------------------------------------------
+def test_asp_mask_2_4():
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    mask = asp.create_mask(w)
+    assert asp.check_mask_2_4(mask)
+    assert asp.calculate_density(np.asarray(mask)) == 0.5
+    # kept entries are the top-2 |w| per group of 4
+    g = np.abs(np.asarray(w)).reshape(-1, 4)
+    kept = np.asarray(mask).reshape(-1, 4).astype(bool)
+    for row_w, row_k in zip(g, kept):
+        assert set(np.argsort(-row_w)[:2]) == set(np.where(row_k)[0])
+
+
+def test_asp_prune_and_decorated_optimizer_keeps_sparsity():
+    model = nn.Linear(16, 8)
+    masks = asp.prune_model(model)
+    assert masks
+    assert asp.calculate_density(np.asarray(model.weight)) == 0.5
+    opt = asp.decorate(paddle.optimizer.SGD(0.1))
+    params = {name: p.value for name, p in model.named_parameters()}
+    state = opt.init_state(params)
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    new_params, _ = opt.apply(params, grads, state, 0.1)
+    w = np.asarray(new_params["weight"])
+    assert asp.calculate_density(w) <= 0.5 + 1e-6
+
+
+def test_asp_eager_step_keeps_sparsity():
+    """Eager optimizer surface (param.grad + step) must re-apply masks."""
+    model = nn.Linear(16, 8)
+    asp.prune_model(model)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        0.1, parameters=model.parameters()))
+    for p in model.parameters():
+        p.grad = jnp.ones_like(p.value)
+    opt.step()
+    assert asp.calculate_density(np.asarray(model.weight)) <= 0.5 + 1e-6
+
+
+def test_asp_two_models_independent_masks():
+    a, b = nn.Linear(16, 8), nn.Linear(8, 4)
+    masks_a = asp.prune_model(a)
+    masks_b = asp.prune_model(b)
+    # eager path: each model keeps ITS mask
+    opt_a = asp.decorate(paddle.optimizer.SGD(0.1,
+                                              parameters=a.parameters()))
+    for p in a.parameters():
+        p.grad = jnp.ones_like(p.value)
+    opt_a.step()  # must not crash on shape mismatch nor use b's mask
+    assert asp.calculate_density(np.asarray(a.weight)) <= 0.5 + 1e-6
+    # functional path: explicit masks
+    opt_fa = asp.decorate(paddle.optimizer.SGD(0.1), masks=masks_a)
+    pa = {n: p.value for n, p in a.named_parameters()}
+    sa = opt_fa.init_state(pa)
+    ga = {k: jnp.ones_like(v) for k, v in pa.items()}
+    na, _ = opt_fa.apply(pa, ga, sa, 0.1)
+    assert asp.calculate_density(np.asarray(na["weight"])) <= 0.5 + 1e-6

@@ -76,6 +76,13 @@ def test_predictor_wraps_live_callable():
     np.testing.assert_allclose(out, np.full((3,), 3.0))
 
 
+def test_predictor_multi_input_callable():
+    pred = Predictor(Config(), fn=lambda x, y: x + 2 * y)
+    assert pred.get_input_names() == ["input_0", "input_1"]
+    (out,) = pred.run([np.ones(3, np.float32), np.ones(3, np.float32)])
+    np.testing.assert_allclose(out, np.full((3,), 3.0))
+
+
 def test_predictor_repeated_runs(artifact):
     path, x, ref = artifact
     pred = create_predictor(Config(path))

@@ -66,6 +66,9 @@ def test_to_static_method_decorator():
     np.testing.assert_allclose(np.asarray(m.f(jnp.ones(4))), 3.0)
     m2 = M(5.0)
     np.testing.assert_allclose(np.asarray(m2.f(jnp.ones(4))), 5.0)
+    # scalar attribute mutation must be visible (retrace, not stale trace)
+    m.k = 7.0
+    np.testing.assert_allclose(np.asarray(m.f(jnp.ones(4))), 7.0)
 
 
 def test_jacobian_tuple_inputs_all_args():
