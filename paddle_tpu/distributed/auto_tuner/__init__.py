@@ -1,0 +1,8 @@
+"""Parallelism auto-tuner (reference: python/paddle/distributed/auto_tuner/
+— tuner.py:21 AutoTuner: generate dp/mp/pp/sharding/micro-batch candidates,
+prune by divisibility + memory model, trial-run, pick the best)."""
+
+from .tuner import AutoTuner, Candidate, estimate_memory_gb, generate_candidates, prune_candidates
+
+__all__ = ["AutoTuner", "Candidate", "generate_candidates",
+           "prune_candidates", "estimate_memory_gb"]

@@ -94,7 +94,9 @@ class LogNormal(Distribution):
         return jnp.exp(self.base.sample(shape, key))
 
     def log_prob(self, value):
-        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+        safe = jnp.where(value > 0, value, 1.0)  # support is (0, inf)
+        lp = self.base.log_prob(jnp.log(safe)) - jnp.log(safe)
+        return jnp.where(value > 0, lp, -jnp.inf)
 
     @property
     def mean(self):

@@ -20,10 +20,11 @@ from ...nn.layer.layers import Layer
 __all__ = ["calculate_density", "create_mask", "check_mask_2_4",
            "prune_model", "decorate", "get_masks"]
 
-# masks are keyed by id(Parameter) (identity survives renames and multiple
-# models with colliding tree names); the tree-name index is per-model-object
-_MASKS_BY_PARAM: Dict[int, jax.Array] = {}
-_MASKS_BY_NAME: Dict[str, jax.Array] = {}  # last prune_model's tree names
+# the eager path stores each mask ON its Parameter (attribute `_asp_mask`):
+# no global registry to leak, no id-reuse hazard; the tree-name index below
+# only feeds the functional apply() path of the MOST RECENT prune_model
+# (pass prune_model's return value to decorate() for multi-model setups)
+_MASKS_BY_NAME: Dict[str, jax.Array] = {}
 
 
 def calculate_density(x) -> float:
@@ -69,7 +70,7 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         mask = create_mask(p.value, n, m)
         p.value = p.value * mask
         if with_mask:
-            _MASKS_BY_PARAM[id(p)] = mask
+            p._asp_mask = mask
             out[name] = mask
     _MASKS_BY_NAME.clear()
     _MASKS_BY_NAME.update(out)
@@ -110,11 +111,10 @@ def decorate(optimizer, masks: Optional[Dict[str, jax.Array]] = None):
 
         def step(self):
             out = self._inner.step()
-            # eager surface: re-mask by Parameter identity (tree names and
-            # Parameter.name spellings differ — identity always matches)
+            # eager surface: the mask rides on the Parameter itself
             params = getattr(self._inner, "_parameter_list", None) or []
             for p in params:
-                m = _MASKS_BY_PARAM.get(id(p))
+                m = getattr(p, "_asp_mask", None)
                 if m is not None:
                     p.value = p.value * m
             return out

@@ -139,7 +139,11 @@ class Predictor:
             if num_inputs is None:
                 import inspect
                 try:
-                    num_inputs = len(inspect.signature(fn).parameters)
+                    num_inputs = sum(
+                        1 for p in inspect.signature(fn).parameters.values()
+                        if p.default is inspect.Parameter.empty
+                        and p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD))
                 except (TypeError, ValueError):
                     num_inputs = 1
             self._n_in = max(num_inputs, 1)
