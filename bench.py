@@ -23,7 +23,7 @@ def main():
     if on_tpu:
         cfg = G.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=16,
                           num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16)
-        batch, seq, iters = 8, 1024, 20
+        batch, seq, iters = 16, 1024, 20
     else:  # CPU smoke fallback
         cfg = G.GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
                           num_heads=4, max_seq_len=128, dtype=jnp.float32)

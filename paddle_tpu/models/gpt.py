@@ -298,7 +298,11 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
         qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
                + p["qkv_b"].astype(cfg.dtype))
         qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
-        attn = _attention(qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+        # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
+        # composition elsewhere — same math as the hybrid engine's
+        attn = F.scaled_dot_product_attention(
+            qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
+            is_causal=True)
         out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
         x = x + out + p["proj_b"].astype(cfg.dtype)
         h = _ln(x, p["ln2_g"], p["ln2_b"])
@@ -317,11 +321,13 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
 
 
-def dense_loss(params, tokens, labels, cfg: GPTConfig):
-    logits = dense_forward(params, tokens, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True):
+    logits = dense_forward(params, tokens, cfg, remat=remat).astype(jnp.float32)
+    # logsumexp form: avoids materializing a second [B, S, V] fp32 buffer
+    # (log_softmax) — the big-vocab CE is HBM-bound
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,

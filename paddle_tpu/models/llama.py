@@ -113,6 +113,15 @@ def _rope(x, cos, sin):
                            axis=-1).astype(x.dtype)
 
 
+def _flash_gqa(q, k, v, num_heads: int, num_kv_heads: int):
+    """Expand KV groups and ride the registry attention (Pallas flash
+    kernel on TPU) — shared by the eager layer and dense_forward."""
+    g = num_heads // num_kv_heads
+    return F.scaled_dot_product_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+        is_causal=True)
+
+
 def _gqa_attention(q, k, v):
     """Causal GQA attention. q: [B, S, hq, D], k/v: [B, S, hkv, D]."""
     B, S, hq, D = q.shape
@@ -148,12 +157,7 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         v = self.v_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-        # expand kv groups and ride the registry attention (Pallas flash
-        # kernel on TPU) instead of materializing S x S logits
-        g = cfg.num_heads // cfg.num_kv_heads
-        out = F.scaled_dot_product_attention(
-            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
-            is_causal=True)
+        out = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
         return self.o_proj(out.reshape(B, S, -1))
 
 
@@ -309,7 +313,8 @@ def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
         v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
                                               cfg.head_dim)
         q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-        x = x + _gqa_attention(q, k, v).reshape(B, S, H) @ p["o_w"].astype(cd)
+        attn = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+        x = x + attn.reshape(B, S, H) @ p["o_w"].astype(cd)
         h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
         m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
                         ).astype(cd) * (h @ p["up_w"].astype(cd))
@@ -325,11 +330,12 @@ def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
 
 
-def dense_loss(params, tokens, labels, cfg: LlamaConfig):
-    logits = dense_forward(params, tokens, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
+    logits = dense_forward(params, tokens, cfg, remat=remat).astype(jnp.float32)
+    # logsumexp form — see gpt.dense_loss
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
