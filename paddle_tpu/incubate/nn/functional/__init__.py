@@ -18,7 +18,23 @@ from ....nn.functional.norm import rms_norm as _rms_norm_op
 __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
     "swiglu", "fused_linear", "fused_bias_act",
+    "masked_multihead_attention", "block_multihead_attention",
 ]
+
+
+def masked_multihead_attention(x, cache_k, cache_v, seq_len, **kw):
+    """Decode-step attention over a KV cache (reference:
+    incubate.nn.functional.masked_multihead_attention). See
+    models.generation for the full decode engine."""
+    from ....models.generation import masked_multihead_attention as _mmha
+    return _mmha(x, cache_k, cache_v, seq_len)
+
+
+def block_multihead_attention(q, cache, **kw):
+    """Paged-KV decode attention (reference:
+    incubate.nn.functional.block_multihead_attention)."""
+    from ....models.generation import block_multihead_attention as _bmha
+    return _bmha(q, cache)
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,

@@ -179,12 +179,13 @@ class Predictor:
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         """Either positional `inputs` or previously-filled input handles."""
         if inputs is not None:
-            if len(inputs) > len(self._inputs):
+            if len(inputs) != len(self._inputs):
                 raise ValueError(
                     f"got {len(inputs)} inputs but the program has "
                     f"{len(self._inputs)} input slots "
-                    f"({list(self._inputs)}); pass num_inputs= to Predictor "
-                    f"for callables with defaulted params you want to feed")
+                    f"({list(self._inputs)}); fill handles individually for "
+                    f"partial feeding, or pass num_inputs= to Predictor for "
+                    f"callables with defaulted params you want to feed")
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
         args = []

@@ -2,9 +2,12 @@
 hybrid-parallel and semi-auto-parallel test suites, plus paddle.vision for
 the conv families)."""
 
-from . import gpt, hybrid_engine, llama  # noqa: F401
+from . import generation, gpt, hybrid_engine, llama  # noqa: F401
+from .generation import (KVCache, PagedKVCache, gpt_generate,  # noqa: F401
+                         llama_generate)
 from .gpt import GPT, GPTConfig  # noqa: F401
 from .llama import Llama, LlamaConfig  # noqa: F401
 
-__all__ = ["gpt", "llama", "hybrid_engine", "GPT", "GPTConfig", "Llama",
-           "LlamaConfig"]
+__all__ = ["gpt", "llama", "hybrid_engine", "generation", "GPT", "GPTConfig",
+           "Llama", "LlamaConfig", "KVCache", "PagedKVCache", "gpt_generate",
+           "llama_generate"]

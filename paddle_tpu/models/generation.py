@@ -1,0 +1,380 @@
+"""Autoregressive decoding with KV caches (reference: the fused decode tier
+— fused_multi_transformer paddle/phi/kernels/fusion/gpu/
+fused_multi_transformer_kernel.cu, masked_multihead_attention, paged
+block_multihead_attention fusion/gpu/block_multi_head_attention_kernel.cu;
+Python surface python/paddle/incubate/nn/functional/fused_transformer.py:976).
+
+TPU design: the whole decode loop is ONE compiled program — prefill fills a
+static-shape KV cache with dynamic_update_slice, then `lax.scan` over decode
+steps runs single-token attention against the cache. No dynamic shapes, so
+XLA keeps everything on the MXU; sampling uses threefry keys. The paged
+variant keeps KV in a block pool indexed by per-sequence block tables
+(vLLM-style), with the gather expressed so XLA fuses it into the attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import gpt as G
+from . import llama as L
+
+__all__ = ["KVCache", "gpt_generate", "llama_generate",
+           "masked_multihead_attention", "PagedKVCache",
+           "block_multihead_attention", "sample_token"]
+
+
+# ---------------------------------------------------------------------------
+# dense (contiguous) KV cache
+# ---------------------------------------------------------------------------
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Per-model stacked cache: k/v are [L, B, max_len, h_kv, D]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def zeros(cls, num_layers, batch, max_len, num_kv_heads, head_dim,
+              dtype=jnp.bfloat16):
+        shape = (num_layers, batch, max_len, num_kv_heads, head_dim)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def masked_multihead_attention(q, cache_k, cache_v, seq_len):
+    """Single-step decode attention (reference:
+    incubate.nn.functional.masked_multihead_attention — one query token
+    against the cache, positions >= seq_len masked).
+
+    q: [B, 1, hq, D]; cache_k/v: [B, T, hkv, D]; seq_len: [B] or scalar —
+    number of valid cache positions per sequence. GQA via head grouping.
+    """
+    B, _, hq, D = q.shape
+    T, hkv = cache_k.shape[1], cache_k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(B, hkv, g, D)  # squeeze the singleton seq dim
+    # fp32 ACCUMULATION, bf16 operands: decode is HBM-bound — an astype
+    # copy of the whole cache per step would double its traffic
+    logits = jnp.einsum("bhgd,bthd->bhgt", qg, cache_k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(D))
+    pos = jnp.arange(T)
+    valid = pos[None, :] < jnp.reshape(jnp.asarray(seq_len), (-1, 1))
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgt,bthd->bhgd", probs, cache_v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, 1, hq, D).astype(q.dtype)
+
+
+def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0):
+    """logits: [B, V] → token ids [B]. temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# GPT decode
+# ---------------------------------------------------------------------------
+def _gpt_block_step(p, x, ck, cv, pos, cfg: G.GPTConfig):
+    """One block, one token. x: [B, 1, H]; ck/cv: [B, T, h, D]."""
+    B = x.shape[0]
+    h = G._ln(x, p["ln1_g"], p["ln1_b"])
+    qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+           + p["qkv_b"].astype(cfg.dtype))
+    qkv = qkv.reshape(B, 1, cfg.num_heads, 3, cfg.head_dim)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    attn = masked_multihead_attention(q, ck, cv, pos + 1)
+    out = attn.reshape(B, 1, cfg.hidden_size) @ p["proj_w"].astype(cfg.dtype)
+    x = x + out + p["proj_b"].astype(cfg.dtype)
+    h = G._ln(x, p["ln2_g"], p["ln2_b"])
+    m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+         + p["fc1_b"].astype(cfg.dtype))
+    m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
+    x = x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+    return x, ck, cv
+
+
+def _gpt_prefill(params, prompt, cache: KVCache, cfg: G.GPTConfig):
+    """Batched prefill: ONE full-sequence causal forward (flash attention)
+    writes K/V for all prompt positions — the MXU-efficient path; only
+    decode needs the token-by-token scan."""
+    from ..nn import functional as F
+    B, S = prompt.shape
+    x = jnp.take(params["wte"], prompt, axis=0) + params["wpe"][None, :S]
+    x = x.astype(cfg.dtype)
+
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        h = G._ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+               + p["qkv_b"].astype(cfg.dtype))
+        qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = attn.reshape(B, S, cfg.hidden_size) @ p["proj_w"].astype(
+            cfg.dtype)
+        x = x + out + p["proj_b"].astype(cfg.dtype)
+        h = G._ln(x, p["ln2_g"], p["ln2_b"])
+        m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+             + p["fc1_b"].astype(cfg.dtype))
+        m = jax.nn.gelu(m.astype(jnp.float32),
+                        approximate=True).astype(cfg.dtype)
+        x = x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(
+            cfg.dtype)
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = G._ln(x[:, -1:], params["lnf_g"], params["lnf_b"])
+    logits = (x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32))
+    return logits[:, 0], KVCache(ks, vs)
+
+
+def _gpt_token_logits(params, token, cache: KVCache, pos, cfg: G.GPTConfig):
+    """token: [B] → (logits [B, V], new cache)."""
+    x = jnp.take(params["wte"], token[:, None], axis=0) \
+        + lax.dynamic_slice_in_dim(params["wpe"], pos, 1)[None]
+    x = x.astype(cfg.dtype)
+
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        x, ck, cv = _gpt_block_step(p, x, ck, cv, pos, cfg)
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = G._ln(x, params["lnf_g"], params["lnf_b"])
+    logits = (x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32))
+    return logits[:, 0], KVCache(ks, vs)
+
+
+def gpt_generate(params, cfg: G.GPTConfig, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 key=None):
+    """prompt: [B, S_prompt] int tokens → [B, S_prompt + max_new_tokens].
+
+    One jitted program: a batched full-sequence prefill fills the cache,
+    then a scan over decode steps. (The reference reaches the same shape
+    with fused_multi_transformer's cache kernels.)
+    """
+    total = prompt.shape[1] + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds the position table "
+            f"(max_seq_len={cfg.max_seq_len})")
+    return _generate(params, cfg, prompt, max_new_tokens, temperature, top_k,
+                     top_p, key, _gpt_prefill, _gpt_token_logits,
+                     lambda b, t: KVCache.zeros(
+                         cfg.num_layers, b, t, cfg.num_heads, cfg.head_dim,
+                         cfg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Llama decode
+# ---------------------------------------------------------------------------
+def _llama_block_step(p, x, ck, cv, pos, cos, sin, cfg: L.LlamaConfig):
+    B = x.shape[0]
+    cd = cfg.dtype
+    h = L._rms(x, p["ln1_g"], cfg.rms_eps)
+    hi = h.astype(cd)
+    q = (hi @ p["q_w"].astype(cd)).reshape(B, 1, cfg.num_heads, cfg.head_dim)
+    k = (hi @ p["k_w"].astype(cd)).reshape(B, 1, cfg.num_kv_heads,
+                                           cfg.head_dim)
+    v = (hi @ p["v_w"].astype(cd)).reshape(B, 1, cfg.num_kv_heads,
+                                           cfg.head_dim)
+    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1)
+    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1)
+    q, k = L._rope(q, cos_p, sin_p), L._rope(k, cos_p, sin_p)
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    attn = masked_multihead_attention(q, ck, cv, pos + 1)
+    x = x + attn.reshape(B, 1, cfg.hidden_size) @ p["o_w"].astype(cd)
+    h = L._rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
+    m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
+                    ).astype(cd) * (h @ p["up_w"].astype(cd))
+    return x + m @ p["down_w"].astype(cd), ck, cv
+
+
+def _llama_prefill_fn(cfg: L.LlamaConfig, max_len: int):
+    cos, sin = L.rope_tables(cfg, max_len)
+
+    def prefill(params, prompt, cache: KVCache, _cfg=None):
+        B, S = prompt.shape
+        cd = cfg.dtype
+        x = jnp.take(params["wte"], prompt, axis=0).astype(cd)
+
+        def body(carry, layer):
+            x = carry
+            p, ck, cv = layer
+            h = L._rms(x, p["ln1_g"], cfg.rms_eps)
+            hi = h.astype(cd)
+            q = (hi @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
+                                                   cfg.head_dim)
+            k = (hi @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                                   cfg.head_dim)
+            v = (hi @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                                   cfg.head_dim)
+            q = L._rope(q, cos[:S], sin[:S])
+            k = L._rope(k, cos[:S], sin[:S])
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, 0, 0))
+            attn = L._flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+            x = x + attn.reshape(B, S, cfg.hidden_size) @ p["o_w"].astype(cd)
+            h = L._rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
+            m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
+                            ).astype(cd) * (h @ p["up_w"].astype(cd))
+            return x + m @ p["down_w"].astype(cd), (ck, cv)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        x = L._rms(x[:, -1:], params["lnf_g"], cfg.rms_eps)
+        logits = x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
+        return logits[:, 0], KVCache(ks, vs)
+
+    return prefill
+
+
+def _llama_token_logits_fn(cfg: L.LlamaConfig, max_len: int):
+    cos, sin = L.rope_tables(cfg, max_len)
+
+    def token_logits(params, token, cache: KVCache, pos, _cfg=None):
+        x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
+
+        def body(carry, layer):
+            x = carry
+            p, ck, cv = layer
+            x, ck, cv = _llama_block_step(p, x, ck, cv, pos, cos, sin, cfg)
+            return x, (ck, cv)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        x = L._rms(x, params["lnf_g"], cfg.rms_eps)
+        logits = x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
+        return logits[:, 0], KVCache(ks, vs)
+
+    return token_logits
+
+
+def llama_generate(params, cfg: L.LlamaConfig, prompt, max_new_tokens: int,
+                   temperature: float = 0.0, top_k: int = 0,
+                   top_p: float = 1.0, key=None):
+    max_len = prompt.shape[1] + max_new_tokens
+    return _generate(params, cfg, prompt, max_new_tokens, temperature, top_k,
+                     top_p, key, _llama_prefill_fn(cfg, max_len),
+                     _llama_token_logits_fn(cfg, max_len),
+                     lambda b, t: KVCache.zeros(
+                         cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim,
+                         cfg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# shared generate driver
+# ---------------------------------------------------------------------------
+def _generate(params, cfg, prompt, max_new_tokens, temperature, top_k, top_p,
+              key, prefill: Callable, token_logits: Callable,
+              make_cache: Callable):
+    prompt = jnp.asarray(prompt)
+    B, S = prompt.shape
+    total = S + max_new_tokens
+    cache = make_cache(B, total)
+    key = jax.random.PRNGKey(0) if key is None else key
+
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    def decode_body(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample_token(logits, sub, temperature, top_k, top_p)
+        logits, cache = token_logits(params, tok, cache, S + i, cfg)
+        return (cache, logits, key), tok
+
+    (_, _, _), toks = lax.scan(decode_body, (cache, logits, key),
+                               jnp.arange(max_new_tokens))
+    return jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# paged (block) KV cache — vLLM-style
+# ---------------------------------------------------------------------------
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block pool + per-sequence block tables (reference:
+    block_multi_head_attention_kernel.cu paged KV).
+
+    k_pool/v_pool: [num_blocks, block_size, h_kv, D]
+    block_tables:  [B, max_blocks_per_seq] int32 indices into the pool
+    seq_lens:      [B] valid token counts
+    """
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    block_tables: jax.Array
+    seq_lens: jax.Array
+    block_size: int = dataclasses.field(metadata=dict(static=True))
+
+    @classmethod
+    def create(cls, num_blocks, block_size, num_kv_heads, head_dim, batch,
+               max_blocks_per_seq, dtype=jnp.bfloat16):
+        return cls(
+            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim),
+                      dtype),
+            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim),
+                      dtype),
+            jnp.zeros((batch, max_blocks_per_seq), jnp.int32),
+            jnp.zeros((batch,), jnp.int32),
+            block_size)
+
+    def write(self, b: int, k, v):
+        """Append one token's k/v ([h, D]) for sequence b (host-side cache
+        management; the attention itself is jitted)."""
+        pos = int(self.seq_lens[b])
+        blk_idx = pos // self.block_size
+        off = pos % self.block_size
+        blk = int(self.block_tables[b, blk_idx])
+        self.k_pool = self.k_pool.at[blk, off].set(k.astype(
+            self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[blk, off].set(v.astype(
+            self.v_pool.dtype))
+        self.seq_lens = self.seq_lens.at[b].add(1)
+        return self
+
+
+def block_multihead_attention(q, cache: PagedKVCache):
+    """Decode attention over a paged cache. q: [B, 1, hq, D] →
+    [B, 1, hq, D]. Gathers each sequence's blocks via its block table —
+    XLA fuses the gather into the attention contraction."""
+    B, _, hq, D = q.shape
+    bs = cache.block_size
+    nb = cache.block_tables.shape[1]
+    hkv = cache.k_pool.shape[2]
+    # gather: [B, max_blocks, block, h, D] → [B, T, h, D]
+    k = cache.k_pool[cache.block_tables].reshape(B, nb * bs, hkv, D)
+    v = cache.v_pool[cache.block_tables].reshape(B, nb * bs, hkv, D)
+    return masked_multihead_attention(q, k, v, cache.seq_lens)
