@@ -1,0 +1,187 @@
+"""Minimal RPC (reference: paddle.distributed.rpc —
+paddle/fluid/distributed/rpc/rpc_agent.{h,cc} brpc agent;
+python/paddle/distributed/rpc/rpc.py init_rpc/rpc_sync/rpc_async/shutdown).
+
+TPU design: the transport is the framework's own TCPStore (native C++
+server, csrc/native_runtime.cpp): each worker runs an agent thread that
+BLOCKS on its inbox key sequence (`rpc/<name>/<idx>`) — the store's
+blocking get is the message queue, so no extra server is needed. Payloads
+are pickled (same trust model as the reference). Suited to control-plane
+traffic (orchestration, eval triggers), not bulk tensors — those ride XLA
+collectives.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self._consumed = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._pending: Dict[str, Future] = {}
+        self._lock = threading.Lock()
+
+        store.set(f"rpc_worker/{rank}", name)
+        self._thread.start()
+
+    # -- serving -------------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            key = f"rpc/{self.name}/{self._consumed}"
+            try:
+                raw = self.store.get(key, timeout=0.5)
+            except TimeoutError:
+                continue
+            except Exception:
+                return  # store closed
+            self._consumed += 1
+            self.store.delete_key(key)
+            try:
+                req = pickle.loads(raw)
+            except Exception:
+                continue
+            if req.get("op") == "stop":
+                return
+            self._handle(req)
+
+    def _handle(self, req):
+        try:
+            fn = pickle.loads(req["fn"])
+            result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+            payload = pickle.dumps({"ok": True, "value": result})
+        except Exception as e:
+            payload = pickle.dumps({"ok": False, "error": repr(e)})
+        self.store.set(f"rpcret/{req['id']}", payload)
+
+    # -- calling -------------------------------------------------------------
+    def call(self, to: str, fn: Callable, args, kwargs,
+             timeout: float) -> Future:
+        req_id = uuid.uuid4().hex
+        payload = pickle.dumps({"id": req_id, "fn": pickle.dumps(fn),
+                                "args": args, "kwargs": kwargs})
+        idx = self.store.add(f"rpc_seq/{to}", 1) - 1
+        self.store.set(f"rpc/{to}/{idx}", payload)
+        fut: Future = Future()
+
+        def wait():
+            try:
+                raw = self.store.get(f"rpcret/{req_id}", timeout=timeout)
+                self.store.delete_key(f"rpcret/{req_id}")
+                resp = pickle.loads(raw)
+                if resp["ok"]:
+                    fut.set_result(resp["value"])
+                else:
+                    fut.set_exception(RuntimeError(resp["error"]))
+            except Exception as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=wait, daemon=True).start()
+        return fut
+
+    def stop(self):
+        self._stop.set()
+        try:
+            idx = self.store.add(f"rpc_seq/{self.name}", 1) - 1
+            self.store.set(f"rpc/{self.name}/{idx}",
+                           pickle.dumps({"op": "stop"}))
+        except Exception:
+            pass
+        self._thread.join(2)
+
+
+_AGENT: Optional[_Agent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None,
+             store: Optional[TCPStore] = None):
+    """Start this worker's RPC agent (reference: rpc.py init_rpc — brpc
+    server + gloo-store name registry)."""
+    global _AGENT
+    assert _AGENT is None, "init_rpc already called"
+    import os
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    if store is None:
+        ep = master_endpoint or os.environ.get("PADDLE_MASTER") \
+            or "127.0.0.1:0"
+        host, port = ep.rsplit(":", 1)
+        store = TCPStore(host, int(port), world_size=world_size,
+                         is_master=(rank == 0))
+    _AGENT = _Agent(name, rank, world_size, store)
+    return WorkerInfo(name, rank)
+
+
+def _agent() -> _Agent:
+    assert _AGENT is not None, "call init_rpc first"
+    return _AGENT
+
+
+def rpc_sync(to: str, fn: Callable, args=(), kwargs=None,
+             timeout: float = 30.0):
+    return _agent().call(to, fn, args, kwargs or {}, timeout).result(timeout)
+
+
+def rpc_async(to: str, fn: Callable, args=(), kwargs=None,
+              timeout: float = 30.0) -> Future:
+    return _agent().call(to, fn, args, kwargs or {}, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    a = _agent()
+    if name is None or name == a.name:
+        return WorkerInfo(a.name, a.rank)
+    for i in range(a.world_size):
+        try:
+            n = a.store.get(f"rpc_worker/{i}", timeout=0.2).decode()
+        except TimeoutError:
+            continue
+        if n == name:
+            return WorkerInfo(n, i)
+    raise ValueError(f"unknown rpc worker {name!r}")
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    a = _agent()
+    out = []
+    for i in range(a.world_size):
+        try:
+            n = a.store.get(f"rpc_worker/{i}", timeout=0.2).decode()
+            out.append(WorkerInfo(n, i))
+        except TimeoutError:
+            pass
+    return out
+
+
+def shutdown():
+    global _AGENT
+    if _AGENT is not None:
+        _AGENT.stop()
+        _AGENT = None

@@ -1,0 +1,130 @@
+"""Comm/step watchdog (reference: paddle/phi/core/distributed/
+comm_task_manager.cc:67 CommTaskManager — background thread walks
+outstanding comm tasks and reports init/start/finish timeouts;
+nccl_comm_task.cc per-task state).
+
+TPU design: collectives live inside compiled programs, so there are no
+per-collective host handles to poll. What CAN hang the host is a step
+(dispatch + device execution + cross-host rendezvous), so the watchdog
+tracks host-visible spans: `with watchdog.watch("train_step", timeout=60):`
+registers a deadline; a daemon thread fires `on_timeout` (default: dump a
+report with thread stacks — the analog of the reference's comm-task trace
+dump) for any span that overruns. Zero overhead on the happy path beyond
+one dict insert/remove.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+__all__ = ["CommWatchdog", "get_watchdog"]
+
+
+class _Span:
+    __slots__ = ("tag", "start", "deadline", "thread_id", "fired")
+
+    def __init__(self, tag, start, deadline, thread_id):
+        self.tag = tag
+        self.start = start
+        self.deadline = deadline
+        self.thread_id = thread_id
+        self.fired = False
+
+
+def _default_on_timeout(span: "_Span", report: str):
+    sys.stderr.write(report)
+    sys.stderr.flush()
+
+
+class CommWatchdog:
+    def __init__(self, poll_interval: float = 1.0,
+                 on_timeout: Optional[Callable] = None):
+        self.poll_interval = poll_interval
+        self.on_timeout = on_timeout or _default_on_timeout
+        self._spans: Dict[int, _Span] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.timeout_count = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.poll_interval + 1)
+            self._thread = None
+
+    # -- spans ---------------------------------------------------------------
+    @contextlib.contextmanager
+    def watch(self, tag: str, timeout: float):
+        """Track one host-side operation; fires on_timeout if it overruns."""
+        now = time.monotonic()
+        span = _Span(tag, now, now + timeout, threading.get_ident())
+        with self._lock:
+            self._seq += 1
+            sid = self._seq
+            self._spans[sid] = span
+        try:
+            yield span
+        finally:
+            with self._lock:
+                self._spans.pop(sid, None)
+
+    def pending(self):
+        with self._lock:
+            return [(s.tag, time.monotonic() - s.start)
+                    for s in self._spans.values()]
+
+    # -- monitor -------------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            now = time.monotonic()
+            overdue = []
+            with self._lock:
+                for s in self._spans.values():
+                    if now > s.deadline and not s.fired:
+                        s.fired = True
+                        overdue.append(s)
+            for s in overdue:
+                self.timeout_count += 1
+                self.on_timeout(s, self._report(s, now))
+
+    def _report(self, span: "_Span", now: float) -> str:
+        lines = [
+            "=" * 70,
+            f"[paddle_tpu watchdog] '{span.tag}' exceeded its deadline: "
+            f"running {now - span.start:.1f}s "
+            f"(budget {span.deadline - span.start:.1f}s)",
+            f"other pending spans: {self.pending()}",
+            "thread stacks (the reference dumps comm-task traces here):",
+        ]
+        frames = sys._current_frames()
+        f = frames.get(span.thread_id)
+        if f is not None:
+            lines.append("".join(traceback.format_stack(f)))
+        lines.append("=" * 70 + "\n")
+        return "\n".join(lines)
+
+
+_GLOBAL: Optional[CommWatchdog] = None
+
+
+def get_watchdog() -> CommWatchdog:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = CommWatchdog()
+        _GLOBAL.start()
+    return _GLOBAL

@@ -96,18 +96,20 @@ def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0,
 # ---------------------------------------------------------------------------
 # GPT decode
 # ---------------------------------------------------------------------------
-def _gpt_block_step(p, x, ck, cv, pos, cfg: G.GPTConfig):
-    """One block, one token. x: [B, 1, H]; ck/cv: [B, T, h, D]."""
-    B = x.shape[0]
+def _gpt_block(p, x, ck, cv, pos, attn_fn, cfg: G.GPTConfig):
+    """Shared block math for prefill (x: [B, S, H], pos=0, causal flash
+    attention) and decode (x: [B, 1, H], pos=t, cache attention) — ONE copy
+    so the two paths cannot drift."""
+    B, S, _ = x.shape
     h = G._ln(x, p["ln1_g"], p["ln1_b"])
     qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
            + p["qkv_b"].astype(cfg.dtype))
-    qkv = qkv.reshape(B, 1, cfg.num_heads, 3, cfg.head_dim)
+    qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-    attn = masked_multihead_attention(q, ck, cv, pos + 1)
-    out = attn.reshape(B, 1, cfg.hidden_size) @ p["proj_w"].astype(cfg.dtype)
+    attn = attn_fn(q, k, v, ck, cv)
+    out = attn.reshape(B, S, cfg.hidden_size) @ p["proj_w"].astype(cfg.dtype)
     x = x + out + p["proj_b"].astype(cfg.dtype)
     h = G._ln(x, p["ln2_g"], p["ln2_b"])
     m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
@@ -117,36 +119,11 @@ def _gpt_block_step(p, x, ck, cv, pos, cfg: G.GPTConfig):
     return x, ck, cv
 
 
-def _gpt_prefill(params, prompt, cache: KVCache, cfg: G.GPTConfig):
-    """Batched prefill: ONE full-sequence causal forward (flash attention)
-    writes K/V for all prompt positions — the MXU-efficient path; only
-    decode needs the token-by-token scan."""
-    from ..nn import functional as F
-    B, S = prompt.shape
-    x = jnp.take(params["wte"], prompt, axis=0) + params["wpe"][None, :S]
-    x = x.astype(cfg.dtype)
-
+def _gpt_stack(params, x, cache: KVCache, pos, attn_fn, cfg: G.GPTConfig):
     def body(carry, layer):
         x = carry
         p, ck, cv = layer
-        h = G._ln(x, p["ln1_g"], p["ln1_b"])
-        qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
-               + p["qkv_b"].astype(cfg.dtype))
-        qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
-        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        out = attn.reshape(B, S, cfg.hidden_size) @ p["proj_w"].astype(
-            cfg.dtype)
-        x = x + out + p["proj_b"].astype(cfg.dtype)
-        h = G._ln(x, p["ln2_g"], p["ln2_b"])
-        m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
-             + p["fc1_b"].astype(cfg.dtype))
-        m = jax.nn.gelu(m.astype(jnp.float32),
-                        approximate=True).astype(cfg.dtype)
-        x = x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(
-            cfg.dtype)
+        x, ck, cv = _gpt_block(p, x, ck, cv, pos, attn_fn, cfg)
         return x, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
@@ -155,22 +132,34 @@ def _gpt_prefill(params, prompt, cache: KVCache, cfg: G.GPTConfig):
     return logits[:, 0], KVCache(ks, vs)
 
 
+def _prefill_attn(q, k, v, ck, cv):
+    """Batched prefill attention: full-sequence causal flash over the
+    LOCAL k/v (the cache was just written from them)."""
+    from ..nn import functional as F
+    del ck, cv
+    return F.scaled_dot_product_attention(q, k, v, is_causal=True)
+
+
+def _gpt_prefill(params, prompt, cache: KVCache, cfg: G.GPTConfig):
+    """ONE full-sequence forward writes K/V for all prompt positions — the
+    MXU-efficient path; only decode needs the token-by-token scan."""
+    B, S = prompt.shape
+    x = (jnp.take(params["wte"], prompt, axis=0)
+         + params["wpe"][None, :S]).astype(cfg.dtype)
+    return _gpt_stack(params, x, cache, 0, _prefill_attn, cfg)
+
+
 def _gpt_token_logits(params, token, cache: KVCache, pos, cfg: G.GPTConfig):
     """token: [B] → (logits [B, V], new cache)."""
-    x = jnp.take(params["wte"], token[:, None], axis=0) \
-        + lax.dynamic_slice_in_dim(params["wpe"], pos, 1)[None]
-    x = x.astype(cfg.dtype)
+    x = (jnp.take(params["wte"], token[:, None], axis=0)
+         + lax.dynamic_slice_in_dim(params["wpe"], pos, 1)[None]
+         ).astype(cfg.dtype)
 
-    def body(carry, layer):
-        x = carry
-        p, ck, cv = layer
-        x, ck, cv = _gpt_block_step(p, x, ck, cv, pos, cfg)
-        return x, (ck, cv)
+    def decode_attn(q, k, v, ck, cv):
+        del k, v
+        return masked_multihead_attention(q, ck, cv, pos + 1)
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
-    x = G._ln(x, params["lnf_g"], params["lnf_b"])
-    logits = (x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32))
-    return logits[:, 0], KVCache(ks, vs)
+    return _gpt_stack(params, x, cache, pos, decode_attn, cfg)
 
 
 def gpt_generate(params, cfg: G.GPTConfig, prompt, max_new_tokens: int,
@@ -198,86 +187,74 @@ def gpt_generate(params, cfg: G.GPTConfig, prompt, max_new_tokens: int,
 # ---------------------------------------------------------------------------
 # Llama decode
 # ---------------------------------------------------------------------------
-def _llama_block_step(p, x, ck, cv, pos, cos, sin, cfg: L.LlamaConfig):
+def _llama_block(p, x, ck, cv, pos, seq, cos, sin, attn_fn,
+                 cfg: L.LlamaConfig):
+    """Shared Llama block for prefill (seq=S, pos=0) and decode (seq=1,
+    pos=t) — one copy of the math, RoPE sliced at the write position."""
     B = x.shape[0]
     cd = cfg.dtype
     h = L._rms(x, p["ln1_g"], cfg.rms_eps)
     hi = h.astype(cd)
-    q = (hi @ p["q_w"].astype(cd)).reshape(B, 1, cfg.num_heads, cfg.head_dim)
-    k = (hi @ p["k_w"].astype(cd)).reshape(B, 1, cfg.num_kv_heads,
+    q = (hi @ p["q_w"].astype(cd)).reshape(B, seq, cfg.num_heads,
                                            cfg.head_dim)
-    v = (hi @ p["v_w"].astype(cd)).reshape(B, 1, cfg.num_kv_heads,
+    k = (hi @ p["k_w"].astype(cd)).reshape(B, seq, cfg.num_kv_heads,
                                            cfg.head_dim)
-    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1)
-    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1)
+    v = (hi @ p["v_w"].astype(cd)).reshape(B, seq, cfg.num_kv_heads,
+                                           cfg.head_dim)
+    cos_p = lax.dynamic_slice_in_dim(cos, pos, seq)
+    sin_p = lax.dynamic_slice_in_dim(sin, pos, seq)
     q, k = L._rope(q, cos_p, sin_p), L._rope(k, cos_p, sin_p)
     ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-    attn = masked_multihead_attention(q, ck, cv, pos + 1)
-    x = x + attn.reshape(B, 1, cfg.hidden_size) @ p["o_w"].astype(cd)
+    attn = attn_fn(q, k, v, ck, cv)
+    x = x + attn.reshape(B, seq, cfg.hidden_size) @ p["o_w"].astype(cd)
     h = L._rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
     m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
                     ).astype(cd) * (h @ p["up_w"].astype(cd))
     return x + m @ p["down_w"].astype(cd), ck, cv
 
 
-def _llama_prefill_fn(cfg: L.LlamaConfig, max_len: int):
-    cos, sin = L.rope_tables(cfg, max_len)
+def _llama_stack(params, x, cache: KVCache, pos, seq, cos, sin, attn_fn,
+                 cfg: L.LlamaConfig):
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        x, ck, cv = _llama_block(p, x, ck, cv, pos, seq, cos, sin, attn_fn,
+                                 cfg)
+        return x, (ck, cv)
 
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = L._rms(x[:, -1:], params["lnf_g"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
+    return logits[:, 0], KVCache(ks, vs)
+
+
+def _llama_gqa_prefill_attn(cfg):
+    def attn(q, k, v, ck, cv):
+        del ck, cv
+        return L._flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+    return attn
+
+
+def _llama_prefill_fn(cfg: L.LlamaConfig, cos, sin):
     def prefill(params, prompt, cache: KVCache, _cfg=None):
-        B, S = prompt.shape
-        cd = cfg.dtype
-        x = jnp.take(params["wte"], prompt, axis=0).astype(cd)
-
-        def body(carry, layer):
-            x = carry
-            p, ck, cv = layer
-            h = L._rms(x, p["ln1_g"], cfg.rms_eps)
-            hi = h.astype(cd)
-            q = (hi @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
-                                                   cfg.head_dim)
-            k = (hi @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                                   cfg.head_dim)
-            v = (hi @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                                   cfg.head_dim)
-            q = L._rope(q, cos[:S], sin[:S])
-            k = L._rope(k, cos[:S], sin[:S])
-            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, 0, 0, 0))
-            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, 0, 0, 0))
-            attn = L._flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
-            x = x + attn.reshape(B, S, cfg.hidden_size) @ p["o_w"].astype(cd)
-            h = L._rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
-            m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
-                            ).astype(cd) * (h @ p["up_w"].astype(cd))
-            return x + m @ p["down_w"].astype(cd), (ck, cv)
-
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
-        x = L._rms(x[:, -1:], params["lnf_g"], cfg.rms_eps)
-        logits = x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
-        return logits[:, 0], KVCache(ks, vs)
-
+        S = prompt.shape[1]
+        x = jnp.take(params["wte"], prompt, axis=0).astype(cfg.dtype)
+        return _llama_stack(params, x, cache, 0, S, cos, sin,
+                            _llama_gqa_prefill_attn(cfg), cfg)
     return prefill
 
 
-def _llama_token_logits_fn(cfg: L.LlamaConfig, max_len: int):
-    cos, sin = L.rope_tables(cfg, max_len)
-
+def _llama_token_logits_fn(cfg: L.LlamaConfig, cos, sin):
     def token_logits(params, token, cache: KVCache, pos, _cfg=None):
         x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
 
-        def body(carry, layer):
-            x = carry
-            p, ck, cv = layer
-            x, ck, cv = _llama_block_step(p, x, ck, cv, pos, cos, sin, cfg)
-            return x, (ck, cv)
+        def decode_attn(q, k, v, ck, cv):
+            del k, v
+            return masked_multihead_attention(q, ck, cv, pos + 1)
 
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
-        x = L._rms(x, params["lnf_g"], cfg.rms_eps)
-        logits = x.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
-        return logits[:, 0], KVCache(ks, vs)
-
+        return _llama_stack(params, x, cache, pos, 1, cos, sin, decode_attn,
+                            cfg)
     return token_logits
 
 
@@ -285,9 +262,10 @@ def llama_generate(params, cfg: L.LlamaConfig, prompt, max_new_tokens: int,
                    temperature: float = 0.0, top_k: int = 0,
                    top_p: float = 1.0, key=None):
     max_len = prompt.shape[1] + max_new_tokens
+    cos, sin = L.rope_tables(cfg, max_len)  # built once, shared by both fns
     return _generate(params, cfg, prompt, max_new_tokens, temperature, top_k,
-                     top_p, key, _llama_prefill_fn(cfg, max_len),
-                     _llama_token_logits_fn(cfg, max_len),
+                     top_p, key, _llama_prefill_fn(cfg, cos, sin),
+                     _llama_token_logits_fn(cfg, cos, sin),
                      lambda b, t: KVCache.zeros(
                          cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim,
                          cfg.dtype))
@@ -314,8 +292,13 @@ def _generate(params, cfg, prompt, max_new_tokens, temperature, top_k, top_p,
         logits, cache = token_logits(params, tok, cache, S + i, cfg)
         return (cache, logits, key), tok
 
-    (_, _, _), toks = lax.scan(decode_body, (cache, logits, key),
-                               jnp.arange(max_new_tokens))
+    # scan max_new_tokens - 1 steps; the LAST token is sampled from the
+    # final carried logits without another (wasted) forward pass
+    (_, logits, key), toks = lax.scan(decode_body, (cache, logits, key),
+                                      jnp.arange(max_new_tokens - 1))
+    key, sub = jax.random.split(key)
+    last = sample_token(logits, sub, temperature, top_k, top_p)
+    toks = jnp.concatenate([toks, last[None]], axis=0)
     return jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
 
 
@@ -355,6 +338,13 @@ class PagedKVCache:
         """Append one token's k/v ([h, D]) for sequence b (host-side cache
         management; the attention itself is jitted)."""
         pos = int(self.seq_lens[b])
+        capacity = self.block_tables.shape[1] * self.block_size
+        if pos >= capacity:
+            # JAX index clamping would silently overwrite the last slot
+            raise ValueError(
+                f"sequence {b} is full: {pos} tokens >= capacity "
+                f"{capacity} (max_blocks_per_seq * block_size); allocate "
+                f"more blocks in its block table")
         blk_idx = pos // self.block_size
         off = pos % self.block_size
         blk = int(self.block_tables[b, blk_idx])

@@ -1,0 +1,175 @@
+"""paddle.static equivalent (reference: python/paddle/static/ Program +
+python/paddle/base/executor.py:1227 Executor/_StandaloneExecutor:844 over
+the C++ PirInterpreter).
+
+TPU design: a "static program" IS a traced computation — jax.jit's jaxpr/
+StableHLO plays the role of ProgramDesc/PIR, and XLA is the interpreter
+(SURVEY §7 item 10: program capture = tracing, PIR/CINN come for free).
+So `Program` wraps a Python callable + named inputs; `Executor.run`
+feeds by name and fetches by index, compiling once per shape — the
+reference's feed/fetch surface without a separate op-by-op IR walker.
+Model building happens with the same nn.Layer/functional APIs (the
+reference's dygraph-to-static convergence made those identical anyway);
+`program_guard` + `data` keep the classic source shape working.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.api import InputSpec
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "name_scope",
+           "py_func"]
+
+_tls = threading.local()
+
+
+class Program:
+    """A named-input traced program (reference: base/framework.py Program).
+
+    Two construction styles:
+      * classic: with program_guard(prog): x = static.data(...); build
+        a callable via prog.set_output(fn_of_inputs) or capture outputs
+        with `prog.fetch(...)`.
+      * direct: Program.from_callable(fn, input_specs).
+    """
+
+    def __init__(self):
+        self._inputs: List[InputSpec] = []
+        self._fn: Optional[Callable] = None
+        self._outputs: Optional[List] = None
+        self._jitted = None
+
+    # -- classic surface -----------------------------------------------------
+    def _add_input(self, spec: InputSpec):
+        self._inputs.append(spec)
+        return spec
+
+    def set_output(self, fn: Callable):
+        """fn(*inputs_in_declaration_order) -> output(s)."""
+        self._fn = fn
+        self._jitted = None
+        return self
+
+    @classmethod
+    def from_callable(cls, fn: Callable,
+                      input_specs: Sequence[InputSpec]) -> "Program":
+        p = cls()
+        p._inputs = list(input_specs)
+        p._fn = fn
+        return p
+
+    # -- execution -----------------------------------------------------------
+    def input_names(self) -> List[str]:
+        return [s.name or f"x{i}" for i, s in enumerate(self._inputs)]
+
+    def _compiled(self):
+        if self._jitted is None:
+            assert self._fn is not None, (
+                "Program has no computation: use set_output()/from_callable "
+                "(classic op-by-op building is tracing here — see module "
+                "docstring)")
+            self._jitted = jax.jit(self._fn)
+        return self._jitted
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p._inputs = list(self._inputs)
+        p._fn = self._fn
+        return p
+
+    def __repr__(self):
+        return (f"Program(inputs={self.input_names()}, "
+                f"traced={self._fn is not None})")
+
+
+def default_main_program() -> Program:
+    if not hasattr(_tls, "main"):
+        _tls.main = Program()
+    return _tls.main
+
+
+def default_startup_program() -> Program:
+    # parameter init is eager (Layer construction); kept for API parity
+    if not hasattr(_tls, "startup"):
+        _tls.startup = Program()
+    return _tls.startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    prev = getattr(_tls, "main", None)
+    _tls.main = main_program
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _tls.main
+        else:
+            _tls.main = prev
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> InputSpec:
+    """Declare a named program input (reference: static.data). Returns the
+    InputSpec; the built callable receives inputs in declaration order."""
+    del lod_level
+    spec = InputSpec(shape, dtype, name)
+    default_main_program()._add_input(spec)
+    return spec
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    yield  # naming is jaxpr-internal; kept for source compatibility
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Host callback op (reference: static.py_func). Under jit this rides
+    jax.pure_callback; gradients need a PyLayer instead."""
+    del backward_func, skip_vars_in_backward_input
+    if out is None:
+        raise ValueError("py_func needs `out` (a ShapeDtypeStruct or "
+                         "example array describing the result)")
+    shape_dtype = jax.ShapeDtypeStruct(jnp.shape(out), jnp.result_type(out))
+    return jax.pure_callback(func, shape_dtype, x)
+
+
+class Executor:
+    """Feed/fetch runner (reference: base/executor.py Executor.run)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        names = program.input_names()
+        missing = [n for n in names if n not in feed]
+        if missing:
+            raise ValueError(f"feed missing inputs {missing}; program "
+                             f"declares {names}")
+        args = [jnp.asarray(feed[n]) for n in names]
+        out = program._compiled()(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        if fetch_list is not None:
+            k = len(fetch_list)
+            if k > len(outs):
+                raise ValueError(f"fetch_list wants {k} outputs, program "
+                                 f"produced {len(outs)}")
+            outs = outs[:k]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return list(outs)

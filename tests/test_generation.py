@@ -111,6 +111,27 @@ def test_paged_attention_matches_contiguous():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_paged_write_capacity_check():
+    cache = gen.PagedKVCache.create(num_blocks=4, block_size=2,
+                                    num_kv_heads=1, head_dim=2, batch=1,
+                                    max_blocks_per_seq=2, dtype=jnp.float32)
+    cache.block_tables = jnp.asarray([[0, 1]], jnp.int32)
+    k = jnp.ones((1, 2))
+    for _ in range(4):
+        cache = cache.write(0, k, k)
+    with pytest.raises(ValueError, match="full"):
+        cache.write(0, k, k)
+
+
+def test_generate_single_token():
+    params = G.init_hybrid_params(GCFG, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(6).randint(0, 64, (2, 4)))
+    out = gen.gpt_generate(params, GCFG, prompt, max_new_tokens=1,
+                           temperature=0.0)
+    ref = ref_greedy(G.dense_forward, params, GCFG, prompt, 1)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
 def test_sampling_top_k_and_temperature():
     logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
     # greedy

@@ -1,0 +1,136 @@
+"""Tests for static (Program/Executor), watchdog, and rpc (reference
+analogs: test/legacy_test/test_executor_*.py, comm_task_manager tests,
+test/legacy_test/test_rpc*.py)."""
+
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed.watchdog import CommWatchdog
+
+
+# -- static ------------------------------------------------------------------
+def test_program_guard_data_executor():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        y = static.data("y", [8, 2], "float32")
+    prog.set_output(lambda x, y: x @ y)
+    exe = static.Executor()
+    a = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    b = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    (out,) = exe.run(prog, feed={"x": a, "y": b}, fetch_list=["out"])
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_executor_missing_feed_raises():
+    prog = static.Program.from_callable(
+        lambda x: x + 1, [static.InputSpec([2], "float32", "x")])
+    with pytest.raises(ValueError):
+        static.Executor().run(prog, feed={})
+
+
+def test_program_with_layer():
+    from paddle_tpu import nn
+    from paddle_tpu.nn import functional_call, functional_train_graph
+    layer = nn.Linear(8, 2)
+    params, _, buffers = functional_train_graph(layer)
+    prog = static.Program.from_callable(
+        lambda x: functional_call(layer, params, buffers, x)[0],
+        [static.InputSpec([4, 8], "float32", "x")])
+    x = np.ones((4, 8), np.float32)
+    (out,) = static.Executor().run(prog, feed={"x": x}, fetch_list=[0])
+    np.testing.assert_allclose(out, np.asarray(layer(jnp.asarray(x))),
+                               rtol=1e-5)
+
+
+def test_py_func_host_callback():
+    import jax
+    def host(x):
+        return np.asarray(x) * 3
+
+    prog = static.Program.from_callable(
+        lambda x: static.py_func(host, x, out=jnp.zeros((2,), jnp.float32)),
+        [static.InputSpec([2], "float32", "x")])
+    (out,) = static.Executor().run(prog, feed={"x": np.ones(2, np.float32)})
+    np.testing.assert_allclose(out, [3.0, 3.0])
+
+
+# -- watchdog ----------------------------------------------------------------
+def test_watchdog_fires_on_overrun_and_not_on_fast():
+    fired = []
+    wd = CommWatchdog(poll_interval=0.05,
+                      on_timeout=lambda s, r: fired.append((s.tag, r)))
+    wd.start()
+    with wd.watch("fast_op", timeout=5):
+        pass
+    time.sleep(0.15)
+    assert not fired
+    with wd.watch("slow_op", timeout=0.1):
+        time.sleep(0.4)
+    assert fired and fired[0][0] == "slow_op"
+    assert "slow_op" in fired[0][1] and "thread stacks" in fired[0][1]
+    assert wd.timeout_count == 1  # fires once, not every poll
+    wd.stop()
+
+
+def test_watchdog_pending_listing():
+    wd = CommWatchdog(poll_interval=10)
+    with wd.watch("op_a", timeout=100):
+        pending = wd.pending()
+        assert len(pending) == 1 and pending[0][0] == "op_a"
+    assert wd.pending() == []
+
+
+# -- rpc ---------------------------------------------------------------------
+@pytest.fixture
+def rpc_pair():
+    from paddle_tpu import _native
+    if _native.load() is None:
+        pytest.skip("native store unavailable")
+    from paddle_tpu.distributed import rpc as rpc_mod
+    from paddle_tpu.distributed.store import TCPStore
+    store0 = TCPStore("127.0.0.1", 0, world_size=2, is_master=True)
+    # two agents in one process (the reference tests spawn processes; the
+    # agent loop only touches the store so in-process is equivalent)
+    a0 = rpc_mod._Agent("alice", 0, 2, store0)
+    store1 = TCPStore("127.0.0.1", store0.port, world_size=2)
+    a1 = rpc_mod._Agent("bob", 1, 2, store1)
+    yield a0, a1
+    a0.stop()
+    a1.stop()
+    store1.close()
+    store0.close()
+
+
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    raise ValueError("kaboom")
+
+
+def test_rpc_sync_roundtrip(rpc_pair):
+    a0, a1 = rpc_pair
+    fut = a0.call("bob", _double, (21,), {}, timeout=10)
+    assert fut.result(10) == 42
+    fut = a1.call("alice", _double, ("ab",), {}, timeout=10)
+    assert fut.result(10) == "abab"
+
+
+def test_rpc_exception_propagates(rpc_pair):
+    a0, _ = rpc_pair
+    fut = a0.call("bob", _boom, (), {}, timeout=10)
+    with pytest.raises(RuntimeError, match="kaboom"):
+        fut.result(10)
+
+
+def test_rpc_many_async(rpc_pair):
+    a0, _ = rpc_pair
+    futs = [a0.call("bob", _double, (i,), {}, timeout=10) for i in range(8)]
+    assert [f.result(10) for f in futs] == [i * 2 for i in range(8)]
