@@ -178,3 +178,7 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
         jax.config.update("jax_num_cpu_devices", n)
     except RuntimeError:
         pass
+
+from . import streams  # noqa: F401
+from .streams import (Event, Stream, current_stream,  # noqa: F401
+                      stream_guard, synchronize)

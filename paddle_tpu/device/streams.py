@@ -1,0 +1,118 @@
+"""Streams/events API shims (reference: python/paddle/device/cuda/streams
+Stream/Event + synchronize; C++ per-device streams in
+paddle/phi/core/device_context.h).
+
+TPU design: XLA owns scheduling — a compiled program's internal
+parallelism, collective overlap and transfer pipelining replace
+hand-managed streams (there is exactly one logical stream per core).
+These classes keep stream-shaped reference code running: recording an
+Event snapshots a token you can synchronize on (block_until_ready of the
+arrays dispatched so far), Stream context managers are no-ops, and
+`synchronize()` drains the device.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import jax
+
+__all__ = ["Stream", "Event", "current_stream", "stream_guard",
+           "synchronize"]
+
+
+def synchronize(device=None) -> None:
+    """Block until all dispatched work on the device finished (reference:
+    paddle.device.synchronize)."""
+    del device
+    # dispatch a trivial computation and wait: everything enqueued before
+    # it on the single logical stream is then complete
+    jax.block_until_ready(jax.jit(lambda: 0)())
+
+
+class Event:
+    def __init__(self, enable_timing: bool = True, blocking: bool = False,
+                 interprocess: bool = False):
+        del blocking, interprocess
+        self.enable_timing = enable_timing
+        self._tokens: List[Any] = []
+        self._time: Optional[float] = None
+
+    def record(self, stream: Optional["Stream"] = None, tokens=None):
+        """Snapshot the work dispatched so far. Optionally pass the arrays
+        whose completion this event represents."""
+        del stream
+        self._tokens = list(tokens) if tokens is not None else []
+        self._time = time.perf_counter()
+
+    def synchronize(self):
+        if self._tokens:
+            jax.block_until_ready(self._tokens)
+        else:
+            synchronize()
+
+    def query(self) -> bool:
+        try:
+            for t in self._tokens:
+                if hasattr(t, "is_ready") and not t.is_ready():
+                    return False
+            return True
+        except Exception:
+            return True
+
+    def elapsed_time(self, end: "Event") -> float:
+        """Milliseconds between two recorded events (host clock — device
+        timestamps belong to the profiler)."""
+        assert self._time is not None and end._time is not None
+        return (end._time - self._time) * 1e3
+
+
+class Stream:
+    """No-op stream handle (one logical stream per TPU core)."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event: Event):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+    def record_event(self, event: Optional[Event] = None) -> Event:
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self) -> bool:
+        return True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_CURRENT = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    del device
+    return _CURRENT
+
+
+class stream_guard:
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False

@@ -34,30 +34,36 @@ class WorkerInfo:
 
 class _Agent:
     def __init__(self, name: str, rank: int, world_size: int,
-                 store: TCPStore):
+                 store: TCPStore, owns_store: bool = False):
         self.name = name
         self.rank = rank
         self.world_size = world_size
         self.store = store
+        self.owns_store = owns_store
         self._consumed = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
-        self._pending: Dict[str, Future] = {}
-        self._lock = threading.Lock()
 
         store.set(f"rpc_worker/{rank}", name)
         self._thread.start()
 
     # -- serving -------------------------------------------------------------
     def _serve(self):
+        import sys
         while not self._stop.is_set():
             key = f"rpc/{self.name}/{self._consumed}"
             try:
                 raw = self.store.get(key, timeout=0.5)
             except TimeoutError:
                 continue
-            except Exception:
-                return  # store closed
+            except Exception as e:
+                if self._stop.is_set():
+                    return  # store closed during shutdown: expected
+                # transient store error must not silently kill serving
+                sys.stderr.write(f"[rpc:{self.name}] store error in serve "
+                                 f"loop: {e!r}; retrying\n")
+                self._stop.wait(0.5)
+                continue
             self._consumed += 1
             self.store.delete_key(key)
             try:
@@ -129,13 +135,14 @@ def init_rpc(name: str, rank: Optional[int] = None,
         else rank
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
         if world_size is None else world_size
+    owns = store is None
     if store is None:
         ep = master_endpoint or os.environ.get("PADDLE_MASTER") \
             or "127.0.0.1:0"
         host, port = ep.rsplit(":", 1)
         store = TCPStore(host, int(port), world_size=world_size,
                          is_master=(rank == 0))
-    _AGENT = _Agent(name, rank, world_size, store)
+    _AGENT = _Agent(name, rank, world_size, store, owns_store=owns)
     return WorkerInfo(name, rank)
 
 
@@ -184,4 +191,6 @@ def shutdown():
     global _AGENT
     if _AGENT is not None:
         _AGENT.stop()
+        if _AGENT.owns_store:  # init_rpc created it → init_rpc cleans it up
+            _AGENT.store.close()
         _AGENT = None

@@ -278,6 +278,8 @@ def _generate(params, cfg, prompt, max_new_tokens, temperature, top_k, top_p,
               key, prefill: Callable, token_logits: Callable,
               make_cache: Callable):
     prompt = jnp.asarray(prompt)
+    if max_new_tokens <= 0:
+        return prompt
     B, S = prompt.shape
     total = S + max_new_tokens
     cache = make_cache(B, total)

@@ -1,0 +1,191 @@
+"""L-BFGS (reference: python/paddle/optimizer/lbfgs.py — LBFGS with
+history_size two-loop recursion and strong-Wolfe line search; kernels run
+as host-driven full-batch steps in the reference too).
+
+TPU design: L-BFGS is inherently sequential (curvature history + line
+search), so the driver loop is host Python calling a jitted
+value_and_grad — the per-iteration compute (the expensive part) stays on
+device. Functional surface: `minimize(loss_fn, params)`; eager surface:
+`step(closure)` like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LBFGS", "minimize_lbfgs"]
+
+
+def _flatten(tree):
+    # ravel_pytree handles mixed dtypes and empty trees; keep the search
+    # arithmetic in fp32 regardless of parameter dtype
+    from jax.flatten_util import ravel_pytree
+    flat, unflatten = ravel_pytree(tree)
+    if flat.dtype != jnp.float32:
+        inner = unflatten
+        cast_to = flat.dtype
+        unflatten = lambda v: inner(v.astype(cast_to))
+        flat = flat.astype(jnp.float32)
+    return flat, unflatten
+
+
+def _strong_wolfe(f_g, x, d, f0, g0, lr, c1=1e-4, c2=0.9, max_ls=20):
+    """Backtracking/zoom line search satisfying the strong Wolfe conditions
+    (the reference's _strong_wolfe). f_g(x) -> (f, flat_grad)."""
+    dg0 = float(g0 @ d)
+    t = lr
+    t_prev, f_prev = 0.0, f0
+    g_prev = g0
+    for _ in range(max_ls):
+        f_t, g_t = f_g(x + t * d)
+        f_t = float(f_t)
+        dg_t = float(g_t @ d)
+        if f_t > f0 + c1 * t * dg0 or (t_prev > 0 and f_t >= f_prev):
+            return _zoom(f_g, x, d, f0, dg0, t_prev, t, f_prev, g_prev,
+                         c1, c2)
+        if abs(dg_t) <= -c2 * dg0:
+            return t, f_t, g_t
+        if dg_t >= 0:
+            return _zoom(f_g, x, d, f0, dg0, t, t_prev, f_t, g_t, c1, c2)
+        t_prev, f_prev, g_prev = t, f_t, g_t
+        t *= 2.0
+    f_t, g_t = f_g(x + t * d)
+    return t, float(f_t), g_t
+
+
+def _zoom(f_g, x, d, f0, dg0, lo, hi, f_lo, g_lo, c1, c2, max_zoom=20):
+    # (f_lo, g_lo) always correspond to the current `lo` point, so the
+    # fallthrough needs no extra value_and_grad evaluation
+    for _ in range(max_zoom):
+        t = 0.5 * (lo + hi)
+        f_t, g_t = f_g(x + t * d)
+        f_t = float(f_t)
+        dg_t = float(g_t @ d)
+        if f_t > f0 + c1 * t * dg0 or f_t >= f_lo:
+            hi = t
+        else:
+            if abs(dg_t) <= -c2 * dg0:
+                return t, f_t, g_t
+            if dg_t * (hi - lo) >= 0:
+                hi = lo
+            lo, f_lo, g_lo = t, f_t, g_t
+        if abs(hi - lo) < 1e-9:
+            break
+    return lo, f_lo, g_lo
+
+
+def minimize_lbfgs(loss_fn: Callable, params, max_iter: int = 50,
+                   history_size: int = 10, learning_rate: float = 1.0,
+                   tolerance_grad: float = 1e-7,
+                   tolerance_change: float = 1e-9,
+                   line_search_fn: Optional[str] = "strong_wolfe"):
+    """Minimize loss_fn(params) -> scalar. Returns (params, final_loss)."""
+    x, unflatten = _flatten(params)
+    vg = jax.jit(jax.value_and_grad(lambda v: loss_fn(unflatten(v))))
+
+    def f_g(v):
+        f, g = vg(v)
+        return f, g
+
+    f, g = f_g(x)
+    f = float(f)
+    s_hist: List = []
+    y_hist: List = []
+    rho_hist: List = []
+
+    for it in range(max_iter):
+        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+            break
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                             reversed(rho_hist)):
+            a = rho * float(s @ q)
+            alphas.append(a)
+            q = q - a * y
+        if y_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            gamma = float(s @ y) / max(float(y @ y), 1e-12)
+        else:
+            gamma = 1.0
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                  reversed(alphas)):
+            b = rho * float(y @ r)
+            r = r + (a - b) * s
+        d = -r
+
+        lr0 = learning_rate if it > 0 else min(
+            learning_rate, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-12))
+        if line_search_fn == "strong_wolfe":
+            t, f_new, g_new = _strong_wolfe(f_g, x, d, f, g, lr0)
+        else:
+            t = lr0
+            f_new, g_new = f_g(x + t * d)
+            f_new = float(f_new)
+
+        x_new = x + t * d
+        s = x_new - x
+        y = g_new - g
+        sy = float(s @ y)
+        if sy > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            rho_hist.append(1.0 / sy)
+            if len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+                rho_hist.pop(0)
+        if abs(f_new - f) < tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            break
+        x, f, g = x_new, f_new, g_new
+
+    return unflatten(x), f
+
+
+class LBFGS:
+    """Reference-shaped class surface: `opt.step(closure)` runs max_iter
+    L-BFGS iterations where closure() -> loss given the current parameter
+    values (parameters passed at construction)."""
+
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 max_eval=None, tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9, history_size: int = 100,
+                 line_search_fn: Optional[str] = "strong_wolfe",
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        del max_eval, weight_decay, grad_clip, name
+        from ..nn.layer.layers import Parameter
+        self._params = [p for p in (parameters or [])
+                        if isinstance(p, Parameter)]
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+
+    def step(self, closure: Callable):
+        """closure must compute the loss FROM the parameter values it is
+        given: closure(values_list) -> scalar loss."""
+        assert self._params, "LBFGS constructed without `parameters`"
+        values = [p.value for p in self._params]
+
+        def loss_fn(vals):
+            return closure(vals)
+
+        new_vals, loss = minimize_lbfgs(
+            loss_fn, values, max_iter=self.max_iter,
+            history_size=self.history_size,
+            learning_rate=self.learning_rate,
+            tolerance_grad=self.tolerance_grad,
+            tolerance_change=self.tolerance_change,
+            line_search_fn=self.line_search_fn)
+        for p, v in zip(self._params, new_vals):
+            p.value = v
+        return loss

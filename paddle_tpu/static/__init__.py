@@ -45,7 +45,7 @@ class Program:
     def __init__(self):
         self._inputs: List[InputSpec] = []
         self._fn: Optional[Callable] = None
-        self._outputs: Optional[List] = None
+        self._output_names: Optional[List[str]] = None
         self._jitted = None
 
     # -- classic surface -----------------------------------------------------
@@ -53,9 +53,11 @@ class Program:
         self._inputs.append(spec)
         return spec
 
-    def set_output(self, fn: Callable):
-        """fn(*inputs_in_declaration_order) -> output(s)."""
+    def set_output(self, fn: Callable, output_names: Optional[List[str]] = None):
+        """fn(*inputs_in_declaration_order) -> output(s). output_names lets
+        Executor.run fetch by name."""
         self._fn = fn
+        self._output_names = list(output_names) if output_names else None
         self._jitted = None
         return self
 
@@ -163,13 +165,34 @@ class Executor:
                              f"declares {names}")
         args = [jnp.asarray(feed[n]) for n in names]
         out = program._compiled()(*args)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
         if fetch_list is not None:
-            k = len(fetch_list)
-            if k > len(outs):
-                raise ValueError(f"fetch_list wants {k} outputs, program "
-                                 f"produced {len(outs)}")
-            outs = outs[:k]
+            picked = []
+            out_names = program._output_names
+            for item in fetch_list:
+                if isinstance(item, int):
+                    if item >= len(outs):
+                        raise ValueError(
+                            f"fetch index {item} out of range "
+                            f"({len(outs)} outputs)")
+                    picked.append(outs[item])
+                elif isinstance(item, str) and out_names is not None:
+                    if item not in out_names:
+                        raise ValueError(
+                            f"unknown fetch name {item!r}; program outputs "
+                            f"are named {out_names}")
+                    picked.append(outs[out_names.index(item)])
+                else:
+                    # no names declared: only full-prefix fetch is
+                    # unambiguous; anything else must be an index
+                    if len(fetch_list) != len(outs):
+                        raise ValueError(
+                            "fetch by name requires set_output(..., "
+                            "output_names=[...]); otherwise fetch_list must "
+                            "cover all outputs or use integer indices")
+                    picked = outs
+                    break
+            outs = picked
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return list(outs)

@@ -1,4 +1,9 @@
+from .lenet import LeNet
+from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .resnet import *  # noqa: F401,F403
 from .resnet import __all__ as _resnet_all
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 
-__all__ = list(_resnet_all)
+__all__ = (list(_resnet_all)
+           + ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+              "MobileNetV2", "mobilenet_v2", "LeNet"])

@@ -148,3 +148,9 @@ def test_sampling_top_k_and_temperature():
     for s in range(5):
         t = gen.sample_token(logits, jax.random.PRNGKey(s), 1.0, top_p=0.3)
         assert int(t[0]) == 3
+
+def test_generate_zero_tokens_returns_prompt():
+    params = G.init_hybrid_params(GCFG, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(7).randint(0, 64, (2, 4)))
+    out = gen.gpt_generate(params, GCFG, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))

@@ -227,3 +227,38 @@ def test_sublayer_traversal():
     names = [n for n, _ in net.named_parameters()]
     assert "0.weight" in names and "1.0.weight" in names
     assert len(net.parameters()) == 4
+
+
+def test_vision_model_zoo_forward():
+    """VGG/MobileNetV2/LeNet forward shapes (reference:
+    test/legacy_test/test_vision_models.py pattern)."""
+    import jax.numpy as jnp
+    from paddle_tpu.vision import models as M
+    x = jnp.ones((1, 3, 32, 32))
+    vgg = M.vgg11(num_classes=10, with_pool=False)
+    # 32x32 → 5 pools → 1x1 feature map; classifier needs 7x7, so head off
+    feats = vgg.features(x)
+    assert feats.shape[1] == 512
+    mnet = M.mobilenet_v2(num_classes=7)
+    out = mnet(jnp.ones((2, 3, 64, 64)))
+    assert out.shape == (2, 7)
+    lenet = M.LeNet(num_classes=10)
+    out = lenet(jnp.ones((2, 1, 28, 28)))
+    assert out.shape == (2, 10)
+
+
+def test_device_streams_shim():
+    import paddle_tpu as paddle
+    from paddle_tpu.device import Event, Stream, current_stream, synchronize
+    import jax.numpy as jnp
+    s = current_stream()
+    e0, e1 = Event(), Event()
+    e0.record(s)
+    y = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    e1.record(s, tokens=[y])
+    e1.synchronize()
+    assert e1.query()
+    assert e0.elapsed_time(e1) >= 0
+    synchronize()
+    with Stream() as st:
+        st.record_event()

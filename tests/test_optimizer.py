@@ -122,3 +122,41 @@ def test_optimizer_state_dict():
     opt2 = paddle.optimizer.Adam(0.01, parameters=net.parameters())
     opt2.set_state_dict(sd)
     assert opt2._step_count == 1
+
+
+def test_lbfgs_rosenbrock():
+    """L-BFGS converges on the Rosenbrock function where SGD crawls."""
+    import jax.numpy as jnp
+    from paddle_tpu.optimizer import minimize_lbfgs
+
+    def rosen(p):
+        x, y = p["x"], p["y"]
+        return (1 - x) ** 2 + 100.0 * (y - x ** 2) ** 2
+
+    params = {"x": jnp.asarray(-1.2), "y": jnp.asarray(1.0)}
+    out, loss = minimize_lbfgs(rosen, params, max_iter=100)
+    assert loss < 1e-6, loss
+    assert abs(float(out["x"]) - 1.0) < 1e-3
+    assert abs(float(out["y"]) - 1.0) < 1e-3
+
+
+def test_lbfgs_class_surface():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer import LBFGS
+
+    layer = nn.Linear(4, 1, bias_attr=False)
+    X = jnp.asarray(np.random.RandomState(0).randn(32, 4).astype(np.float32))
+    w_true = jnp.asarray([[1.0], [-2.0], [0.5], [3.0]])
+    y = X @ w_true
+    opt = LBFGS(parameters=layer.parameters(), max_iter=50)
+
+    def closure(values):
+        (w,) = values
+        return jnp.mean((X @ w - y) ** 2)
+
+    loss = opt.step(closure)
+    assert loss < 1e-8
+    np.testing.assert_allclose(np.asarray(layer.weight), np.asarray(w_true),
+                               atol=1e-3)

@@ -134,3 +134,17 @@ def test_rpc_many_async(rpc_pair):
     a0, _ = rpc_pair
     futs = [a0.call("bob", _double, (i,), {}, timeout=10) for i in range(8)]
     assert [f.result(10) for f in futs] == [i * 2 for i in range(8)]
+
+def test_executor_fetch_by_name_and_index():
+    prog = static.Program.from_callable(
+        lambda x: (x + 1, x * 2),
+        [static.InputSpec([2], "float32", "x")])
+    prog.set_output(lambda x: (x + 1, x * 2), output_names=["plus", "times"])
+    exe = static.Executor()
+    x = np.asarray([1.0, 2.0], np.float32)
+    (times,) = exe.run(prog, feed={"x": x}, fetch_list=["times"])
+    np.testing.assert_allclose(times, [2.0, 4.0])
+    (plus,) = exe.run(prog, feed={"x": x}, fetch_list=[0])
+    np.testing.assert_allclose(plus, [2.0, 3.0])
+    with pytest.raises(ValueError):
+        exe.run(prog, feed={"x": x}, fetch_list=["nope"])
