@@ -181,4 +181,6 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
 
 from . import streams  # noqa: F401
 from .streams import (Event, Stream, current_stream,  # noqa: F401
-                      stream_guard, synchronize)
+                      stream_guard)
+# NOTE: NOT importing streams.synchronize — the place-aware synchronize()
+# defined above is the public one (streams delegates to it).

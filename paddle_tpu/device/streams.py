@@ -24,11 +24,10 @@ __all__ = ["Stream", "Event", "current_stream", "stream_guard",
 
 def synchronize(device=None) -> None:
     """Block until all dispatched work on the device finished (reference:
-    paddle.device.synchronize)."""
-    del device
-    # dispatch a trivial computation and wait: everything enqueued before
-    # it on the single logical stream is then complete
-    jax.block_until_ready(jax.jit(lambda: 0)())
+    paddle.device.synchronize). Delegates to the place-aware device-level
+    synchronize."""
+    from . import synchronize as _device_synchronize
+    _device_synchronize(device)
 
 
 class Event:

@@ -1,3 +1,4 @@
 from . import sequence_parallel_utils  # noqa: F401
+from .hybrid_parallel_inference import HybridParallelInferenceHelper
 
-__all__ = ["sequence_parallel_utils"]
+__all__ = ["sequence_parallel_utils", "HybridParallelInferenceHelper"]

@@ -1,0 +1,73 @@
+"""Hybrid-parallel inference helper (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_inference.py —
+HybridParallelInferenceHelper orchestrating TP/PP inference over the
+hybrid groups, with generation-style while-loop support).
+
+TPU design: inference over a hybrid mesh is the SAME one-program shape as
+training minus the backward — the helper builds a jitted sharded forward
+(and optionally a KV-cache generate) from the model family's stacked
+params, reusing hybrid_param_specs. No per-stage program splitting: XLA
+partitions the single program over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["HybridParallelInferenceHelper"]
+
+
+class HybridParallelInferenceHelper:
+    def __init__(self, mesh: Mesh, model_family, cfg, dp_axis: str = "dp",
+                 mp_axis: str = "mp", pp_axis: str = "pp"):
+        """model_family: a module exposing hybrid_param_specs(cfg) and
+        hybrid_loss-style fns (paddle_tpu.models.gpt / .llama)."""
+        self.mesh = mesh
+        self.family = model_family
+        self.cfg = cfg
+        self.axes = (dp_axis, pp_axis, mp_axis)
+        self._specs = model_family.hybrid_param_specs(cfg)
+        self._fwd = None
+
+    def shard_params(self, params):
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(self.mesh, s)),
+            params, self._specs)
+
+    def build_forward(self) -> Callable:
+        """Jitted sharded forward: tokens [B, S] -> logits, with the batch
+        sharded over dp and params over pp/mp (GSPMD inserts collectives)."""
+        if self._fwd is None:
+            cfg = self.cfg
+            family = self.family
+            dp = self.axes[0]
+            mesh = self.mesh
+
+            @jax.jit
+            def fwd(params, tokens):
+                tokens = jax.lax.with_sharding_constraint(
+                    tokens, NamedSharding(mesh, P(dp)))
+                return family.dense_forward(params, tokens, cfg, remat=False)
+
+            self._fwd = fwd
+        return self._fwd
+
+    def __call__(self, params, tokens):
+        return self.build_forward()(params, tokens)
+
+    def generate(self, params, prompt, max_new_tokens: int, **sample_kw):
+        """KV-cache generation on the mesh (reference: the helper's
+        while-loop generation mode)."""
+        from ....models import generation as gen
+        from ....models import gpt as G, llama as L
+        if isinstance(self.cfg, G.GPTConfig):
+            return gen.gpt_generate(params, self.cfg, prompt,
+                                    max_new_tokens, **sample_kw)
+        if isinstance(self.cfg, L.LlamaConfig):
+            return gen.llama_generate(params, self.cfg, prompt,
+                                      max_new_tokens, **sample_kw)
+        raise TypeError(f"unsupported config {type(self.cfg)}")

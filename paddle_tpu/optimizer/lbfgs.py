@@ -159,7 +159,13 @@ class LBFGS:
                  line_search_fn: Optional[str] = "strong_wolfe",
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None):
-        del max_eval, weight_decay, grad_clip, name
+        del max_eval, name
+        if weight_decay is not None or grad_clip is not None:
+            # silently dropping regularization would change converged
+            # weights vs the reference with no indication why
+            raise NotImplementedError(
+                "LBFGS here does not support weight_decay/grad_clip; fold "
+                "the penalty into the loss function instead")
         from ..nn.layer.layers import Parameter
         self._params = [p for p in (parameters or [])
                         if isinstance(p, Parameter)]

@@ -62,11 +62,12 @@ class Program:
         return self
 
     @classmethod
-    def from_callable(cls, fn: Callable,
-                      input_specs: Sequence[InputSpec]) -> "Program":
+    def from_callable(cls, fn: Callable, input_specs: Sequence[InputSpec],
+                      output_names: Optional[List[str]] = None) -> "Program":
         p = cls()
         p._inputs = list(input_specs)
         p._fn = fn
+        p._output_names = list(output_names) if output_names else None
         return p
 
     # -- execution -----------------------------------------------------------
@@ -176,22 +177,25 @@ class Executor:
                             f"fetch index {item} out of range "
                             f"({len(outs)} outputs)")
                     picked.append(outs[item])
-                elif isinstance(item, str) and out_names is not None:
-                    if item not in out_names:
+                elif isinstance(item, str):
+                    if out_names is not None:
+                        if item not in out_names:
+                            raise ValueError(
+                                f"unknown fetch name {item!r}; program "
+                                f"outputs are named {out_names}")
+                        picked.append(outs[out_names.index(item)])
+                    elif len(outs) == 1:
+                        picked.append(outs[0])  # unambiguous
+                    else:
                         raise ValueError(
-                            f"unknown fetch name {item!r}; program outputs "
-                            f"are named {out_names}")
-                    picked.append(outs[out_names.index(item)])
+                            f"cannot fetch {item!r} by name: the program "
+                            f"has {len(outs)} unnamed outputs — declare "
+                            f"output_names via set_output/from_callable or "
+                            f"fetch by integer index")
                 else:
-                    # no names declared: only full-prefix fetch is
-                    # unambiguous; anything else must be an index
-                    if len(fetch_list) != len(outs):
-                        raise ValueError(
-                            "fetch by name requires set_output(..., "
-                            "output_names=[...]); otherwise fetch_list must "
-                            "cover all outputs or use integer indices")
-                    picked = outs
-                    break
+                    raise TypeError(
+                        f"fetch_list entries must be int or str, got "
+                        f"{type(item)}")
             outs = picked
         if return_numpy:
             return [np.asarray(o) for o in outs]

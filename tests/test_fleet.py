@@ -111,3 +111,27 @@ def test_collective_perf_runs(op):
     res = fleet.collective_perf(op, round=2, size_and_time={1: 0.0})
     assert set(res) == {1}
     assert res[1] > 0
+
+def test_hybrid_parallel_inference_helper():
+    """Sharded forward + generate over a dp x pp x mp mesh (reference:
+    fleet/utils/hybrid_parallel_inference.py)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.utils import (
+        HybridParallelInferenceHelper)
+    from paddle_tpu.models import gpt as G
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                      num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    helper = HybridParallelInferenceHelper(mesh, G, cfg)
+    params = helper.shard_params(G.init_hybrid_params(cfg,
+                                                      jax.random.PRNGKey(0)))
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 8)))
+    logits = helper(params, tokens)
+    assert logits.shape == (4, 8, 64)
+    # matches the unsharded dense forward
+    ref = G.dense_forward(G.init_hybrid_params(cfg, jax.random.PRNGKey(0)),
+                          tokens, cfg, remat=False)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-4)
+    out = helper.generate(params, tokens, max_new_tokens=3)
+    assert out.shape == (4, 11)

@@ -138,8 +138,8 @@ def test_rpc_many_async(rpc_pair):
 def test_executor_fetch_by_name_and_index():
     prog = static.Program.from_callable(
         lambda x: (x + 1, x * 2),
-        [static.InputSpec([2], "float32", "x")])
-    prog.set_output(lambda x: (x + 1, x * 2), output_names=["plus", "times"])
+        [static.InputSpec([2], "float32", "x")],
+        output_names=["plus", "times"])
     exe = static.Executor()
     x = np.asarray([1.0, 2.0], np.float32)
     (times,) = exe.run(prog, feed={"x": x}, fetch_list=["times"])
@@ -148,3 +148,21 @@ def test_executor_fetch_by_name_and_index():
     np.testing.assert_allclose(plus, [2.0, 3.0])
     with pytest.raises(ValueError):
         exe.run(prog, feed={"x": x}, fetch_list=["nope"])
+
+
+def test_executor_fetch_name_without_names_rejected():
+    prog = static.Program.from_callable(
+        lambda x: (x + 1, x * 2), [static.InputSpec([2], "float32", "x")])
+    x = np.ones(2, np.float32)
+    with pytest.raises(ValueError, match="unnamed"):
+        static.Executor().run(prog, feed={"x": x},
+                              fetch_list=["times", "plus"])
+
+
+def test_device_synchronize_place_aware():
+    import paddle_tpu as paddle
+    from paddle_tpu.device import synchronize, CPUPlace
+    synchronize()            # default place
+    synchronize(CPUPlace())  # explicit place still accepted
+    from paddle_tpu.device import streams
+    streams.synchronize(CPUPlace())  # delegates to the place-aware one
