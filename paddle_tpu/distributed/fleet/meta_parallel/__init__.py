@@ -1,12 +1,16 @@
 from . import pp_utils  # noqa: F401
 from . import sharding  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention
+from .pp_utils.spmd_pipeline import (pipeline_last_stage_value, spmd_pipeline,
+                                     spmd_pipeline_interleaved)
 from .segment_parallel import (SegmentParallel, sep_reduce_gradients,
                                split_sequence)
 from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
                        GroupShardedStage2, GroupShardedStage3)
 
-__all__ = ["pp_utils", "sharding", "DygraphShardingOptimizer",
+__all__ = ["pp_utils", "sharding", "spmd_pipeline",
+           "spmd_pipeline_interleaved", "pipeline_last_stage_value",
+           "DygraphShardingOptimizer",
            "GroupShardedOptimizerStage2", "GroupShardedStage2",
            "GroupShardedStage3", "ring_attention", "ulysses_attention",
            "SegmentParallel", "split_sequence", "sep_reduce_gradients"]

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["spmd_pipeline", "pipeline_last_stage_value"]
+__all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
+           "pipeline_last_stage_value"]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -96,6 +97,86 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
     state0 = jnp.zeros_like(x_microbatches[0])
     (_, outputs), _ = lax.scan(step, (state0, out0), jnp.arange(T))
     # replicate last-stage outputs to every rank (loss is computed SPMD)
+    return _replicate_from_last(outputs, axis)
+
+
+def spmd_pipeline_interleaved(stage_fn: Callable, stage_params_chunks,
+                              x_microbatches, axis: str = "pp",
+                              checkpoint_stages: bool = True):
+    """Interleaved (virtual-stage / VPP) pipeline (reference:
+    PipelineParallelWithInterleave, pipeline_parallel.py:1138; static pass
+    pipeline_scheduler_pass/pipeline_vpp.py).
+
+    Circular schedule: every rank holds V chunks of L/(P·V) layers
+    (stage_params_chunks stacked [V, ...] per rank); a microbatch traverses
+    ranks 0..P-1 for chunk 0, wraps back to rank 0 for chunk 1, etc.
+    Token (v, m) runs on rank r at tick t = v·M + m + r; the rank-(P-1)
+    output wraps to a rank-0 slot buffer until its chunk-(v+1) tick. The
+    pipeline bubble shrinks from (P-1) full-stage steps to (P-1) CHUNK
+    steps — the factor-V reduction that motivates VPP.
+
+    Requires M >= P (same constraint as the reference's interleave mode).
+    Returns the last chunk's outputs [M, mb, ...], valid on every rank.
+    """
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    M = x_microbatches.shape[0]
+    V = jax.tree.leaves(stage_params_chunks)[0].shape[0]
+    assert M >= P, (f"interleaved schedule needs microbatches >= pp degree "
+                    f"({M} < {P})")
+    T = V * M + P - 1
+
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    def step(carry, t):
+        state, wrap_buf, outputs = carry
+        # activations move one rank down; the last rank's value wraps to 0
+        prev = lax.ppermute(state, axis, [(i, i + 1) for i in range(P - 1)])
+        wrapped = lax.ppermute(state, axis, [(P - 1, 0)])
+
+        # rank 0 consumes token (v0, m0) with v0*M + m0 == t
+        m0 = t % M
+        v0 = t // M
+        stored = lax.dynamic_index_in_dim(wrap_buf, m0, axis=0,
+                                          keepdims=False)
+        # M == P edge: the wrap arrives in the very tick it is consumed
+        m_w = (t - P) % M
+        use_direct = (m_w == m0) & (v0 > 0)
+        from_wrap = jnp.where(use_direct, wrapped, stored)
+        inj = jnp.take(x_microbatches, m0, axis=0)
+        rank0_in = jnp.where(v0 == 0, inj, from_wrap)
+        inp = jnp.where(idx == 0, rank0_in, prev)
+
+        # this rank's active chunk at tick t
+        v_r = jnp.clip((t - idx) // M, 0, V - 1)
+        params_v = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, v_r, axis=0,
+                                               keepdims=False),
+            stage_params_chunks)
+        out = fn(params_v, inp)
+
+        # store the wrapped activation for its later chunk tick (rank 0)
+        cur_w = lax.dynamic_index_in_dim(wrap_buf, m_w, axis=0,
+                                         keepdims=False)
+        new_w = jnp.where(idx == 0, wrapped, cur_w)
+        wrap_buf = lax.dynamic_update_index_in_dim(wrap_buf, new_w, m_w,
+                                                   axis=0)
+
+        # last rank finishing chunk V-1 emits microbatch m_out
+        m_out = t - (P - 1) - (V - 1) * M
+        moc = jnp.clip(m_out, 0, M - 1)
+        write = (m_out >= 0) & (m_out < M) & (idx == P - 1)
+        cur_o = lax.dynamic_index_in_dim(outputs, moc, axis=0,
+                                         keepdims=False)
+        val = jnp.where(write, out, cur_o)
+        outputs = lax.dynamic_update_index_in_dim(outputs, val, moc, axis=0)
+        return (out, wrap_buf, outputs), None
+
+    state0 = jnp.zeros_like(x_microbatches[0])
+    wrap0 = jnp.zeros_like(x_microbatches)
+    out0 = jnp.zeros_like(x_microbatches)
+    (_, _, outputs), _ = lax.scan(step, (state0, wrap0, out0),
+                                  jnp.arange(T))
     return _replicate_from_last(outputs, axis)
 
 

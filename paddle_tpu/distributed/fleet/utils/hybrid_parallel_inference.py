@@ -12,10 +12,9 @@ partitions the single program over the mesh.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["HybridParallelInferenceHelper"]
@@ -61,13 +60,19 @@ class HybridParallelInferenceHelper:
 
     def generate(self, params, prompt, max_new_tokens: int, **sample_kw):
         """KV-cache generation on the mesh (reference: the helper's
-        while-loop generation mode)."""
+        while-loop generation mode). Dispatches on the injected model
+        family: the family module may expose `generate` directly, else the
+        known families map to the decode engine."""
+        family_gen = getattr(self.family, "generate", None)
+        if family_gen is not None:
+            return family_gen(params, self.cfg, prompt, max_new_tokens,
+                              **sample_kw)
         from ....models import generation as gen
         from ....models import gpt as G, llama as L
-        if isinstance(self.cfg, G.GPTConfig):
-            return gen.gpt_generate(params, self.cfg, prompt,
-                                    max_new_tokens, **sample_kw)
-        if isinstance(self.cfg, L.LlamaConfig):
-            return gen.llama_generate(params, self.cfg, prompt,
-                                      max_new_tokens, **sample_kw)
-        raise TypeError(f"unsupported config {type(self.cfg)}")
+        dispatch = {G: gen.gpt_generate, L: gen.llama_generate}
+        fn = dispatch.get(self.family)
+        if fn is None:
+            raise TypeError(
+                f"model family {self.family!r} has no `generate` and is not "
+                f"one of the built-in families")
+        return fn(params, self.cfg, prompt, max_new_tokens, **sample_kw)

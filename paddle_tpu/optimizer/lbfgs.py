@@ -160,7 +160,8 @@ class LBFGS:
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None):
         del max_eval, name
-        if weight_decay is not None or grad_clip is not None:
+        # falsy values (0.0 / None) are semantically "no decay/clip"
+        if weight_decay or grad_clip is not None:
             # silently dropping regularization would change converged
             # weights vs the reference with no indication why
             raise NotImplementedError(

@@ -87,6 +87,8 @@ class Program:
         p = Program()
         p._inputs = list(self._inputs)
         p._fn = self._fn
+        p._output_names = (list(self._output_names)
+                           if self._output_names else None)
         return p
 
     def __repr__(self):
@@ -184,7 +186,7 @@ class Executor:
                                 f"unknown fetch name {item!r}; program "
                                 f"outputs are named {out_names}")
                         picked.append(outs[out_names.index(item)])
-                    elif len(outs) == 1:
+                    elif len(outs) == 1 and len(fetch_list) == 1:
                         picked.append(outs[0])  # unambiguous
                     else:
                         raise ValueError(

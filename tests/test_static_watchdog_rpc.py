@@ -166,3 +166,19 @@ def test_device_synchronize_place_aware():
     synchronize(CPUPlace())  # explicit place still accepted
     from paddle_tpu.device import streams
     streams.synchronize(CPUPlace())  # delegates to the place-aware one
+
+
+def test_program_clone_keeps_output_names_and_dup_fetch_rejected():
+    prog = static.Program.from_callable(
+        lambda x: (x + 1, x * 2), [static.InputSpec([2], "float32", "x")],
+        output_names=["plus", "times"])
+    clone = prog.clone(for_test=True)
+    x = np.ones(2, np.float32)
+    (t,) = static.Executor().run(clone, feed={"x": x}, fetch_list=["times"])
+    np.testing.assert_allclose(t, [2.0, 2.0])
+    # single unnamed output: multiple name fetches are rejected, not duped
+    p1 = static.Program.from_callable(
+        lambda x: x + 1, [static.InputSpec([2], "float32", "x")])
+    with pytest.raises(ValueError):
+        static.Executor().run(p1, feed={"x": x},
+                              fetch_list=["loss", "accuracy"])
