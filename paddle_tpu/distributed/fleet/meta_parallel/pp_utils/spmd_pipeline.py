@@ -27,7 +27,46 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
-           "pipeline_last_stage_value"]
+           "pipeline_last_stage_value", "vpp_block_permutation",
+           "vpp_chunk_blocks", "vpp_wrap_shard_params"]
+
+
+def vpp_block_permutation(num_layers: int, pp: int, vpp: int):
+    """Stacked-block reorder for the interleaved schedule: position
+    r·(V·cl) + v·cl + j holds global layer (v·pp + r)·cl + j, so each pp
+    shard is [V, cl] chunk-major (reference: interleave chunk assignment,
+    pp_layers.py PipelineLayerChunk). Model-agnostic — any family with a
+    [L, ...]-stacked block pytree uses this."""
+    assert num_layers % (pp * vpp) == 0, (num_layers, pp, vpp)
+    cl = num_layers // (pp * vpp)
+    order = []
+    for r in range(pp):
+        for v in range(vpp):
+            for j in range(cl):
+                order.append((v * pp + r) * cl + j)
+    return order
+
+
+def vpp_chunk_blocks(blocks, vpp: int):
+    """Reshape each local [V·cl, ...] block leaf to [V, cl, ...] for
+    spmd_pipeline_interleaved."""
+    return jax.tree.map(
+        lambda b: b.reshape(vpp, b.shape[0] // vpp, *b.shape[1:]), blocks)
+
+
+def vpp_wrap_shard_params(shard_params, num_layers: int, pp: int, vpp: int,
+                          blocks_key: str = "blocks"):
+    """Wrap a shard_params fn so the stacked blocks are permuted into the
+    interleaved chunk-major layout before placement."""
+    order = jnp.asarray(vpp_block_permutation(num_layers, pp, vpp))
+
+    def wrapped(params):
+        params = dict(params)
+        params[blocks_key] = jax.tree.map(lambda b: b[order],
+                                          params[blocks_key])
+        return shard_params(params)
+
+    return wrapped
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -130,9 +169,13 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params_chunks,
 
     def step(carry, t):
         state, wrap_buf, outputs = carry
-        # activations move one rank down; the last rank's value wraps to 0
-        prev = lax.ppermute(state, axis, [(i, i + 1) for i in range(P - 1)])
-        wrapped = lax.ppermute(state, axis, [(P - 1, 0)])
+        # ONE circular permute: ranks > 0 read their predecessor ("prev"),
+        # rank 0 reads rank P-1's value (the wrap) — halves the collective
+        # count vs separate shift + wrap permutes on this hot loop
+        rotated = lax.ppermute(state, axis,
+                               [(i, (i + 1) % P) for i in range(P)])
+        prev = rotated
+        wrapped = rotated  # meaningful on rank 0 only
 
         # rank 0 consumes token (v0, m0) with v0*M + m0 == t
         m0 = t % M

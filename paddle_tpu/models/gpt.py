@@ -30,7 +30,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
-from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import spmd_pipeline
+from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
+    spmd_pipeline, spmd_pipeline_interleaved, vpp_block_permutation,
+    vpp_chunk_blocks, vpp_wrap_shard_params)
 
 __all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
            "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
@@ -332,10 +334,12 @@ def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True):
 
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp"):
+                   mp_axis="mp", virtual_pp: int = 1):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
-    tokens/labels: this dp shard's batch [b_local, S].
+    tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
+    the interleaved schedule (blocks must be stacked in
+    vpp_block_permutation order — build_hybrid_train_step does this).
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -351,7 +355,12 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         out, _ = lax.scan(body, h, block_params)
         return out
 
-    out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+    if virtual_pp > 1:
+        out = spmd_pipeline_interleaved(
+            stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
+            axis=pp_axis)
+    else:
+        out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
     out = out.reshape(b_local, S, cfg.hidden_size)
     out = _ln(out, params["lnf_g"], params["lnf_b"])
     from ..distributed.fleet.layers.mpu import mp_ops
@@ -365,19 +374,31 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
 
 def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
-                            pp_axis="pp", mp_axis="mp", extra_grad_axes=()):
+                            pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
+                            virtual_pp: int = 1):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
+
+    virtual_pp > 1 selects the interleaved schedule; shard_params then
+    reorders the stacked blocks into the chunk-major layout (checkpoints
+    saved from these sharded params are in that layout — reload through
+    the same shard_params).
     """
     from .hybrid_engine import build_train_step
 
     def loss_fn(p, tokens, labels):
         return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
-                              dp_axis, pp_axis, mp_axis)
+                              dp_axis, pp_axis, mp_axis,
+                              virtual_pp=virtual_pp)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
-    return build_train_step(loss_fn, hybrid_param_specs(cfg), mesh, optimizer,
-                            dp_axis=dp_axis, extra_grad_axes=extra_grad_axes,
-                            example_params=example)
+    step, shard_params, init_state = build_train_step(
+        loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
+        extra_grad_axes=extra_grad_axes, example_params=example)
+
+    if virtual_pp > 1:
+        shard_params = vpp_wrap_shard_params(
+            shard_params, cfg.num_layers, mesh.shape[pp_axis], virtual_pp)
+    return step, shard_params, init_state

@@ -32,7 +32,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
-from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import spmd_pipeline
+from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
+    spmd_pipeline, spmd_pipeline_interleaved, vpp_chunk_blocks,
+    vpp_wrap_shard_params)
 from .gpt import _vocab_parallel_ce, _vocab_parallel_embed
 
 __all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama2_7b", "llama2_13b",
@@ -340,7 +342,7 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp"):
+                   mp_axis="mp", virtual_pp: int = 1):
     """Per-device loss of the full hybrid Llama (inside shard_map)."""
     b_local, S = tokens.shape
     M = num_microbatches
@@ -356,7 +358,12 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
         out, _ = lax.scan(body, h, block_params)
         return out
 
-    out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+    if virtual_pp > 1:
+        out = spmd_pipeline_interleaved(
+            stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
+            axis=pp_axis)
+    else:
+        out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
     out = out.reshape(b_local, S, cfg.hidden_size)
     out = _rms(out, params["lnf_g"], cfg.rms_eps)
     from ..distributed.fleet.layers.mpu import mp_ops
@@ -369,15 +376,22 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
 
 def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
-                            pp_axis="pp", mp_axis="mp", extra_grad_axes=()):
+                            pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
+                            virtual_pp: int = 1):
     from .hybrid_engine import build_train_step
 
     def loss_fn(p, tokens, labels):
         return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
-                              dp_axis, pp_axis, mp_axis)
+                              dp_axis, pp_axis, mp_axis,
+                              virtual_pp=virtual_pp)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
-    return build_train_step(loss_fn, hybrid_param_specs(cfg), mesh, optimizer,
-                            dp_axis=dp_axis, extra_grad_axes=extra_grad_axes,
-                            example_params=example)
+    step, shard_params, init_state = build_train_step(
+        loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
+        extra_grad_axes=extra_grad_axes, example_params=example)
+
+    if virtual_pp > 1:
+        shard_params = vpp_wrap_shard_params(
+            shard_params, cfg.num_layers, mesh.shape[pp_axis], virtual_pp)
+    return step, shard_params, init_state

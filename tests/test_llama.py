@@ -115,3 +115,34 @@ def test_hybrid_train_step_loss_decreases(setup):
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert all(np.isfinite(l) for l in losses)
+
+def test_hybrid_vpp_train_step(setup):
+    """Llama interleaved pipeline: parity + convergence."""
+    mesh, params, tokens, labels = setup
+    from paddle_tpu.utils import shard_map
+
+    def local(params, tokens, labels):
+        return L.hybrid_loss_fn(params, tokens, labels, CFG,
+                                num_microbatches=4, virtual_pp=2)
+
+    from paddle_tpu.models.gpt import vpp_block_permutation
+    order = jnp.asarray(vpp_block_permutation(CFG.num_layers, 2, 2))
+    params_vpp = dict(params)
+    params_vpp["blocks"] = jax.tree.map(lambda b: b[order], params["blocks"])
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(L.hybrid_param_specs(CFG), P("dp"), P("dp")),
+                   out_specs=P())
+    l_vpp = float(jax.jit(fn)(params_vpp, tokens, labels))
+    l_ref = float(L.dense_loss(params, tokens, labels, CFG))
+    assert abs(l_vpp - l_ref) < 1e-4, (l_vpp, l_ref)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step, shard_params, init_state = L.build_hybrid_train_step(
+        CFG, mesh, opt, num_microbatches=4, virtual_pp=2)
+    p = shard_params(params)
+    s = init_state(p)
+    losses = []
+    for _ in range(6):
+        p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-2))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
