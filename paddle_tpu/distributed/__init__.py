@@ -8,7 +8,7 @@ Layer map (SURVEY §2.4/§2.5 -> here):
   fleet hybrid stack        -> fleet/
 """
 
-from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
+from .auto_parallel import (DistModel, Partial, Placement, ProcessMesh, Replicate, Shard,
                             ShardingStage1, ShardingStage2, ShardingStage3,
                             dtensor_from_local, dtensor_to_local,
                             get_placements, reshard, shard_layer,
@@ -46,7 +46,7 @@ __all__ = [
     # auto parallel
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
-    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor", "DistModel",
     "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3",
     # dp
     "DataParallel", "shard_batch",

@@ -2,6 +2,7 @@ from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
                   dtensor_from_local, dtensor_to_local, get_placements,
                   reshard, shard_layer, shard_optimizer, shard_tensor,
                   unshard_dtensor)
+from .dist_model import DistModel, to_static
 from .placement_type import Partial, Placement, Replicate, Shard
 from .process_mesh import ProcessMesh
 
@@ -10,4 +11,5 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "dtensor_from_local",
     "dtensor_to_local", "unshard_dtensor", "shard_optimizer", "get_placements",
     "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "DistModel", "to_static",
 ]

@@ -1,0 +1,172 @@
+"""dist.to_static / DistModel (reference: auto_parallel/api.py:1966
+DistModel over the static Engine auto_parallel/static/engine.py:96 —
+trace, complete dist attrs via SPMD rules, partition, insert reshard,
+then run through the standalone executor; SURVEY §3.4).
+
+TPU design: the whole Engine pipeline collapses into jax.jit + GSPMD —
+tracing IS program capture, sharding propagation IS completion, XLA's
+partitioner IS partition+reshard. DistModel therefore: reads each
+Parameter's placement hints (set by shard_tensor/shard_layer/TP layers),
+places params accordingly, and compiles ONE sharded train/eval step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.layer.layers import Layer, functional_call, functional_train_graph
+from .api import _sharding_for
+from .process_mesh import to_jax_mesh
+
+__all__ = ["DistModel", "to_static"]
+
+
+class DistModel:
+    """Callable train/eval step over a sharded model (reference surface:
+    dist_model(inputs, labels) -> loss in train mode, outputs in eval)."""
+
+    def __init__(self, layer: Layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, mesh=None):
+        del strategy
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._loader = loader
+        self._mode = "train" if optimizer is not None else "predict"
+
+        if mesh is None:
+            from ..topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            assert hcg is not None, ("no mesh: call fleet.init / pass mesh= "
+                                     "or shard parameters first")
+            mesh = hcg.mesh
+        self.mesh = to_jax_mesh(mesh) if not hasattr(mesh, "devices") else mesh
+
+        # place params per their DTensor placement hints; trainable/frozen
+        # stay separate so stop_gradient params never see the optimizer
+        trainable, frozen, buffers = functional_train_graph(layer)
+        self._buffers = buffers
+
+        def placed(name, p, v):
+            # a value shard_tensor already placed keeps its sharding —
+            # re-deriving positionally against self.mesh would mis-map
+            # placements set against a different mesh (e.g. TP layers)
+            if isinstance(v, jax.Array) and isinstance(
+                    getattr(v, "sharding", None), NamedSharding):
+                return v
+            hint_mesh = self.mesh
+            if p is not None and p.process_mesh is not None:
+                hint_mesh = to_jax_mesh(p.process_mesh)
+            if p is not None and p.placements is not None:
+                if isinstance(p.placements, P):
+                    return jax.device_put(
+                        v, NamedSharding(hint_mesh, p.placements))
+                return jax.device_put(v, _sharding_for(
+                    v.ndim, hint_mesh, p.placements))
+            return jax.device_put(v, NamedSharding(self.mesh, P()))
+
+        by_name = dict(layer.named_parameters())
+        self._params = {k: placed(k, by_name.get(k), v)
+                        for k, v in trainable.items()}
+        self._frozen = {k: placed(k, by_name.get(k), v)
+                        for k, v in frozen.items()}
+        self._state = None
+        self._train_step = None
+        self._eval_step = None
+
+    # -- mode ----------------------------------------------------------------
+    def train(self):
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    # -- steps ---------------------------------------------------------------
+    def _build_train(self):
+        if self._train_step is None:
+            layer, loss_fn, opt = self.network, self._loss, self._optimizer
+
+            @jax.jit
+            def step(params, frozen, buffers, state, x, y):
+                def compute(p):
+                    out, new_buffers = functional_call(
+                        layer, {**p, **frozen}, buffers, x)
+                    return loss_fn(out, y), new_buffers
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    compute, has_aux=True)(params)
+                params, state = opt.apply(params, grads, state)
+                return params, state, new_buffers, loss
+
+            self._train_step = step
+            self._state = jax.jit(opt.init_state)(self._params)
+        return self._train_step
+
+    def _build_eval(self):
+        if self._eval_step is None:
+            layer = self.network
+
+            @jax.jit
+            def fwd(params, frozen, buffers, x):
+                out, _ = functional_call(layer, {**params, **frozen},
+                                         buffers, x)
+                return out
+
+            self._eval_step = fwd
+        return self._eval_step
+
+    def __call__(self, inputs, labels=None):
+        inputs = jnp.asarray(inputs)
+        if self._mode == "train":
+            assert labels is not None, "train mode needs labels"
+            step = self._build_train()
+            # buffer updates (BatchNorm stats) thread through the step
+            self._params, self._state, self._buffers, loss = step(
+                self._params, self._frozen, self._buffers, self._state,
+                inputs, jnp.asarray(labels))
+            return loss
+        out = self._build_eval()(self._params, self._frozen, self._buffers,
+                                 inputs)
+        if self._mode == "eval" and labels is not None and self._loss:
+            return self._loss(out, jnp.asarray(labels))
+        return out
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self, mode="all"):
+        del mode
+        return {**self._params, **self._frozen, **self._buffers}
+
+    def set_state_dict(self, sd):
+        for store in (self._params, self._frozen):
+            for k in store:
+                if k in sd:
+                    store[k] = jax.device_put(jnp.asarray(sd[k]),
+                                              store[k].sharding)
+        for k in self._buffers:
+            if k in sd:
+                self._buffers[k] = jnp.asarray(sd[k])
+
+    def dist_main_program(self, mode=None):
+        """Reference introspection surface: the 'program' is the jitted
+        step; return its lowered text when built."""
+        del mode
+        step = (self._train_step if self._mode == "train"
+                else self._eval_step)
+        return step
+
+
+def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
+              strategy=None, mesh=None) -> DistModel:
+    """Convert a (possibly shard_tensor-annotated) layer + loss + optimizer
+    into a compiled distributed model (reference: dist.to_static,
+    auto_parallel/api.py:1966)."""
+    return DistModel(layer, loader, loss, optimizer, strategy, mesh)

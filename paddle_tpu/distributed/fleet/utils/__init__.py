@@ -1,4 +1,6 @@
 from . import sequence_parallel_utils  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS
 from .hybrid_parallel_inference import HybridParallelInferenceHelper
 
-__all__ = ["sequence_parallel_utils", "HybridParallelInferenceHelper"]
+__all__ = ["sequence_parallel_utils", "HybridParallelInferenceHelper",
+           "FS", "LocalFS", "HDFSClient"]

@@ -1,0 +1,182 @@
+"""Filesystem abstraction (reference: python/paddle/distributed/fleet/
+utils/fs.py — FS base, LocalFS, HDFSClient used by checkpoint and PS
+data paths).
+
+TPU shape: checkpoints normally target GCS/local disk through plain file
+IO (the distributed checkpoint module); this FS layer keeps reference
+code paths working. HDFSClient requires an external `hadoop` binary — on
+TPU hosts that's typically absent, so it raises a clear error unless one
+is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, src, dst):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        if not overwrite and self.is_exist(dst):
+            # reference LocalFS.mv raises rather than clobbering ckpts
+            raise FileExistsError(f"mv: destination exists: {dst}")
+        return self.rename(src, dst)
+
+
+class LocalFS(FS):
+    """(reference: fs.py LocalFS)."""
+
+    def ls_dir(self, path) -> List[str]:
+        if not self.is_exist(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.replace(src, dst)
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            d = os.path.dirname(fs_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+
+class HDFSClient(FS):
+    """Thin `hadoop fs` CLI wrapper (reference: fs.py HDFSClient)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out: int = 300000, sleep_inter: int = 1000):
+        del time_out, sleep_inter
+        self.hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME")
+        self.configs = configs or {}
+
+    def _bin(self) -> str:
+        if self.hadoop_home:
+            return os.path.join(self.hadoop_home, "bin", "hadoop")
+        return "hadoop"
+
+    def _run(self, *args) -> subprocess.CompletedProcess:
+        cmd = [self._bin(), "fs"]
+        for k, v in self.configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  check=False)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop CLI (set hadoop_home or "
+                "HADOOP_HOME); on TPU hosts prefer LocalFS/GCS paths"
+            ) from e
+
+    def ls_dir(self, path) -> List[str]:
+        r = self._run("-ls", path)
+        out = []
+        for line in r.stdout.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                out.append(parts[-1])
+        return out
+
+    def is_exist(self, path) -> bool:
+        return self._run("-test", "-e", path).returncode == 0
+
+    def is_file(self, path) -> bool:
+        return self._run("-test", "-f", path).returncode == 0
+
+    def is_dir(self, path) -> bool:
+        return self._run("-test", "-d", path).returncode == 0
+
+    def _run_checked(self, *args):
+        """Mutating ops must not fail silently — a checkpoint 'saved' to an
+        unreachable namenode is data loss."""
+        r = self._run(*args)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed (rc={r.returncode}): "
+                f"{r.stderr.strip()[:500]}")
+        return r
+
+    def mkdirs(self, path):
+        self._run_checked("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run_checked("-rm", "-r", path)
+
+    def rename(self, src, dst):
+        self._run_checked("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run_checked("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run_checked("-get", fs_path, local_path)
+
+    def need_upload_download(self) -> bool:
+        return True

@@ -119,3 +119,24 @@ def test_early_stopping():
     model.fit(ds, eval_data=ds, batch_size=32, epochs=5, verbose=0, callbacks=[es])
     # lr=0 means no improvement; should stop well before 5 epochs
     assert es.stop_training
+
+
+def test_local_fs():
+    import tempfile, os
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = tempfile.mkdtemp()
+    sub = os.path.join(d, "a/b")
+    fs.mkdirs(sub)
+    assert fs.is_dir(sub) and fs.is_exist(sub)
+    f = os.path.join(sub, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    assert fs.ls_dir(sub) == ["x.txt"]
+    fs.upload(f, os.path.join(d, "copy.txt"))
+    assert fs.is_file(os.path.join(d, "copy.txt"))
+    fs.rename(os.path.join(d, "copy.txt"), os.path.join(d, "moved.txt"))
+    assert fs.is_file(os.path.join(d, "moved.txt"))
+    fs.delete(sub)
+    assert not fs.is_exist(sub)
+    assert not fs.need_upload_download()
