@@ -39,9 +39,11 @@ __all__ = ["flash_attention", "supported"]
 _NEG_INF = -1e30
 
 
-def _pick_block(s: int, target: int = 512) -> int:
-    """Largest power-of-two-ish divisor of s up to `target` (v5e sweet spot:
-    512×512 blocks keep the MXU busy while q/k/v/acc fit VMEM)."""
+def _pick_block(s: int, target: int = 1024) -> int:
+    """Largest power-of-two-ish divisor of s up to `target`. Measured on
+    v5e (GPT-268M, seq 1024): 1024 > 512 > 256 (47.4k vs 43.3k vs 34.2k
+    tok/s end-to-end) — bigger q/k tiles amortize the softmax rescale; the
+    fp32 scores tile at 1024x1024 (4 MB) still fits VMEM comfortably."""
     b = min(target, s)
     while s % b:
         b //= 2
