@@ -121,6 +121,13 @@ class Fleet:
         from .meta_optimizers import HybridParallelOptimizer
         if strategy is not None:
             self._strategy = strategy
+        s = self._strategy
+        if s is not None and getattr(s, "gradient_merge", False):
+            k = s.gradient_merge_configs["k_steps"]
+            if k > 1:
+                from ...optimizer import GradientMergeOptimizer
+                optimizer = GradientMergeOptimizer(
+                    optimizer, k_steps=k, avg=s.gradient_merge_configs["avg"])
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
     def distributed_scaler(self, scaler):

@@ -22,25 +22,46 @@ __all__ = ["build_train_step", "state_specs_for"]
 
 
 def state_specs_for(optimizer, specs, example_params=None):
-    """Sharding specs for the optimizer state pytree: every slot inherits its
-    parameter's spec (this is what makes ZeRO composition free — sharding the
-    slot tree IS sharding the optimizer).
+    """Sharding specs for the optimizer state pytree: every array that
+    mirrors a parameter (slots, accumulators — found by matching the
+    parameter's key path inside the state leaf's path) inherits that
+    parameter's spec; everything else (step counters, scalars) replicates.
+    This is what makes ZeRO composition free — sharding the state tree IS
+    sharding the optimizer — and it works for ANY wrapper structure
+    (gradient merge, multi_precision master slots, nested inners).
 
-    Slot structure can be dtype-dependent (e.g. AdamW multi_precision adds a
-    'master' slot for non-fp32 params), so when example_params is given the
-    structure is derived exactly via eval_shape; the fp32 probe is only the
-    no-params fallback."""
+    Without example_params a synthetic fp32 example is derived from the
+    spec tree — exact for any wrapper STRUCTURE, but dtype-conditional
+    slots (multi_precision master weights) need the real example."""
     is_spec = lambda x: isinstance(x, P)
-    if example_params is not None:
-        state_shape = jax.eval_shape(optimizer.init_state, example_params)
-        slots = jax.tree.map(lambda s, sd: {n: s for n in sd},
-                             specs, state_shape["slots"], is_leaf=is_spec)
-    else:
-        slot_names = list(optimizer._init_slot(
-            jnp.zeros((2,), jnp.float32)).keys())
-        slots = jax.tree.map(lambda s: {n: s for n in slot_names}, specs,
-                             is_leaf=is_spec)
-    return {"step": P(), "slots": slots}
+    if example_params is None:
+        example_params = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct((1,) * max(len(s), 1),
+                                           jnp.float32),
+            specs, is_leaf=is_spec)
+
+    def path_keys(path):
+        return tuple(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path)
+
+    spec_paths = {}
+    for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=is_spec)[0]:
+        spec_paths[path_keys(path)] = spec
+    lens = sorted({len(k) for k in spec_paths}, reverse=True)
+
+    state_shape = jax.eval_shape(optimizer.init_state, example_params)
+
+    def spec_for(path, leaf):
+        keys = path_keys(path)
+        for plen in lens:  # longest param-path embedded in the state path
+            for i in range(len(keys) - plen + 1):
+                cand = spec_paths.get(keys[i:i + plen])
+                if cand is not None and len(cand) <= leaf.ndim:
+                    return cand
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, state_shape)
 
 
 def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,

@@ -1,0 +1,118 @@
+"""Gradient merge / accumulation (reference: DistributedStrategy
+gradient_merge_configs {k_steps, avg} + the static gradient-merge pass
+python/paddle/distributed/passes/auto_parallel_gradient_merge.py and
+dygraph gradient accumulation in meta_parallel).
+
+TPU design: a functional optimizer wrapper — grads accumulate in the
+optimizer state pytree for k_steps, then one inner update fires (mean or
+sum). Pure lax.cond control flow, so the whole k-step cycle lives inside
+one jitted train step and composes with the hybrid engine and ZeRO
+sharding (the accumulator inherits each parameter's sharding).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    """Wraps any functional optimizer (init_state/apply) with k-step
+    gradient accumulation."""
+
+    def __init__(self, inner, k_steps: int, avg: bool = True):
+        assert k_steps >= 1
+        self._inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._eager_count = 0
+        self._eager_acc = None
+
+    # the hybrid optimizer swaps _grad_clip; it must land on the optimizer
+    # that actually applies it (the inner), not shadow it on this wrapper
+    @property
+    def _grad_clip(self):
+        return self._inner._grad_clip
+
+    @_grad_clip.setter
+    def _grad_clip(self, value):
+        self._inner._grad_clip = value
+
+    def init_state(self, params):
+        return {
+            "inner": self._inner.init_state(params),
+            "acc": jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def apply(self, params, grads, state, lr=None):
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), state["acc"], grads)
+        count = state["count"] + 1
+        k = self.k_steps
+
+        def do_update(_):
+            scale = 1.0 / k if self.avg else 1.0
+            merged = jax.tree.map(lambda a: a * scale, acc)
+            new_params, new_inner = self._inner.apply(
+                params, merged, state["inner"], lr)
+            zeroed = jax.tree.map(jnp.zeros_like, acc)
+            return new_params, new_inner, zeroed, jnp.zeros((), jnp.int32)
+
+        def no_update(_):
+            return params, state["inner"], acc, count
+
+        new_params, new_inner, new_acc, new_count = lax.cond(
+            count >= k, do_update, no_update, operand=None)
+        return new_params, {"inner": new_inner, "acc": new_acc,
+                            "count": new_count}
+
+    # -- eager surface -------------------------------------------------------
+    def step(self):
+        """Eager accumulation over Parameter.grad slots: the inner step
+        fires only every k-th call (matching apply())."""
+        params = getattr(self._inner, "_parameter_list", None)
+        assert params, ("GradientMergeOptimizer.step() needs the inner "
+                        "optimizer constructed with `parameters`")
+        if self._eager_acc is None:
+            self._eager_acc = [None] * len(params)
+        for i, p in enumerate(params):
+            if p.grad is None:
+                continue
+            g = jnp.asarray(p.grad, jnp.float32)
+            self._eager_acc[i] = g if self._eager_acc[i] is None \
+                else self._eager_acc[i] + g
+        self._eager_count += 1
+        if self._eager_count < self.k_steps:
+            for p in params:
+                p.grad = None  # consumed into the accumulator
+            return None
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p, a in zip(params, self._eager_acc):
+            p.grad = None if a is None else a * scale
+        out = self._inner.step()
+        self._eager_count = 0
+        self._eager_acc = None
+        return out
+
+    def state_dict(self):
+        inner_sd = (self._inner.state_dict()
+                    if hasattr(self._inner, "state_dict") else {})
+        return {"inner": inner_sd,
+                "gm_count": self._eager_count,
+                "gm_acc": self._eager_acc}
+
+    def set_state_dict(self, sd):
+        if "inner" in sd and hasattr(self._inner, "set_state_dict"):
+            self._inner.set_state_dict(sd["inner"])
+        self._eager_count = sd.get("gm_count", 0)
+        self._eager_acc = sd.get("gm_acc")
+
+    def __getattr__(self, item):
+        if item == "_inner":
+            raise AttributeError(item)
+        return getattr(self._inner, item)

@@ -135,3 +135,18 @@ def test_hybrid_parallel_inference_helper():
                                atol=1e-4)
     out = helper.generate(params, tokens, max_new_tokens=3)
     assert out.shape == (4, 11)
+
+
+def test_distributed_optimizer_gradient_merge():
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 3, "avg": True}
+    fleet.init(is_collective=True, strategy=s)
+    from paddle_tpu.optimizer import GradientMergeOptimizer
+    dopt = fleet.distributed_optimizer(paddle.optimizer.SGD(0.1))
+    assert isinstance(dopt.inner_opt, GradientMergeOptimizer)
+    p = {"w": jnp.zeros(())}
+    st = dopt.init_state(p)
+    for i in range(3):
+        p, st = dopt.apply(p, {"w": jnp.asarray(3.0)}, st, 0.1)
+    np.testing.assert_allclose(float(p["w"]), -0.3, rtol=1e-6)
