@@ -19,6 +19,7 @@ __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
     "swiglu", "fused_linear", "fused_bias_act",
     "masked_multihead_attention", "block_multihead_attention",
+    "fused_attention", "fused_feedforward",
 ]
 
 
@@ -199,3 +200,100 @@ def fused_bias_act(x, bias=None, act_method="gelu", dequant_scales=None,
 from .fused_moe import fused_moe  # noqa: F401,E402
 
 __all__.append("fused_moe")
+
+
+def fused_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                    pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                    ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                    linear_bias=None, cache_kv=None, attn_mask=None,
+                    dropout_rate=0.5, attn_dropout_rate=0.5,
+                    ln_epsilon=1e-5, training=True, num_heads=None,
+                    name=None):
+    """Fused MHA block: (pre-)LN + QKV + attention + out-proj + residual +
+    (post-)LN (reference: incubate.nn.functional.fused_attention backed by
+    fusion/gpu/fused_attention_kernel.cu). qkv_weight: [3, heads, head_dim,
+    H] (reference layout) or [H, 3H]; attention rides the registry op
+    (Pallas flash kernel on TPU), the rest fuses under XLA.
+
+    Returns the block output [B, S, H].
+    """
+    import jax
+    import jax.numpy as jnp
+    from ....nn import functional as F
+    del name
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_attention cache_kv (incremental decode) is served by "
+            "models.generation masked_multihead_attention / KVCache")
+    B, S, H = x.shape
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, (H,), pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    if qkv_weight.ndim == 4:
+        three, heads, head_dim, _ = qkv_weight.shape
+        assert three == 3
+        w = qkv_weight.reshape(3 * heads * head_dim, H).T  # [H, 3HD]
+    else:
+        w = qkv_weight
+        assert num_heads, "num_heads required for 2-D qkv_weight"
+        heads = num_heads
+        head_dim = H // heads
+    qkv = h @ w
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape(-1)
+    qkv = qkv.reshape(B, S, 3, heads, head_dim)
+    out = F.scaled_dot_product_attention(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate, training=training)
+    out = out.reshape(B, S, heads * head_dim) @ linear_weight
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate > 0.0 and training:
+        from ....random import next_key
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(next_key(), keep, out.shape)
+        out = jnp.where(mask, out / keep, 0.0)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (H,), ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """Fused FFN block: (pre-)LN + linear + act + dropout + linear +
+    residual + (post-)LN (reference: fused_feedforward_kernel.cu)."""
+    import jax
+    import jax.numpy as jnp
+    from ....nn import functional as F
+    del name
+    H = x.shape[-1]
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, (H,), ln1_scale, ln1_bias, ln1_epsilon)
+    h = h @ linear1_weight
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = getattr(F, activation)(h)
+    from ....random import next_key
+    if dropout1_rate > 0.0 and training:
+        keep = 1.0 - dropout1_rate
+        m = jax.random.bernoulli(next_key(), keep, h.shape)
+        h = jnp.where(m, h / keep, 0.0)
+    h = h @ linear2_weight
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    if dropout2_rate > 0.0 and training:
+        keep = 1.0 - dropout2_rate
+        m = jax.random.bernoulli(next_key(), keep, h.shape)
+        h = jnp.where(m, h / keep, 0.0)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (H,), ln2_scale, ln2_bias, ln2_epsilon)
+    return out

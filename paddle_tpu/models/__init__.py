@@ -2,12 +2,14 @@
 hybrid-parallel and semi-auto-parallel test suites, plus paddle.vision for
 the conv families)."""
 
-from . import generation, gpt, hybrid_engine, llama  # noqa: F401
+from . import bert, generation, gpt, hybrid_engine, llama  # noqa: F401
+from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
 from .generation import (KVCache, PagedKVCache, gpt_generate,  # noqa: F401
                          llama_generate)
 from .gpt import GPT, GPTConfig  # noqa: F401
 from .llama import Llama, LlamaConfig  # noqa: F401
 
-__all__ = ["gpt", "llama", "hybrid_engine", "generation", "GPT", "GPTConfig",
+__all__ = ["bert", "gpt", "llama", "hybrid_engine", "generation", "GPT", "GPTConfig",
+           "BertConfig", "BertModel", "BertForPretraining",
            "Llama", "LlamaConfig", "KVCache", "PagedKVCache", "gpt_generate",
            "llama_generate"]

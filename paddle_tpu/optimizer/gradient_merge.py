@@ -104,13 +104,16 @@ class GradientMergeOptimizer:
                     if hasattr(self._inner, "state_dict") else {})
         return {"inner": inner_sd,
                 "gm_count": self._eager_count,
-                "gm_acc": self._eager_acc}
+                # copy: later step() calls mutate the live accumulator list
+                "gm_acc": (None if self._eager_acc is None
+                           else list(self._eager_acc))}
 
     def set_state_dict(self, sd):
         if "inner" in sd and hasattr(self._inner, "set_state_dict"):
             self._inner.set_state_dict(sd["inner"])
         self._eager_count = sd.get("gm_count", 0)
-        self._eager_acc = sd.get("gm_acc")
+        acc = sd.get("gm_acc")
+        self._eager_acc = None if acc is None else list(acc)
 
     def __getattr__(self, item):
         if item == "_inner":

@@ -1,0 +1,124 @@
+"""BERT family + fused_attention/fused_feedforward tests (reference
+analogs: test_fused_attention_op.py, test_fused_feedforward_op.py, and
+the BERT pretraining baseline config)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.incubate.nn.functional import (fused_attention,
+                                               fused_feedforward)
+from paddle_tpu.models import bert as B
+
+
+CFG = B.BertConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                   intermediate_size=64, max_position_embeddings=32,
+                   hidden_dropout=0.0)
+
+
+def test_bert_forward_shapes():
+    model = B.BertModel(CFG)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+    seq, pooled = model(ids)
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+
+
+def test_bert_padding_mask_changes_output():
+    model = B.BertModel(CFG)
+    model.eval()
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (1, 8)))
+    full, _ = model(ids, attention_mask=jnp.ones((1, 8)))
+    half_mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]])
+    masked, _ = model(ids, attention_mask=half_mask)
+    # visible positions change when later tokens are masked out
+    assert not np.allclose(np.asarray(full[:, 0]), np.asarray(masked[:, 0]))
+
+
+def test_bert_pretraining_loss_decreases():
+    model = B.BertForPretraining(CFG)
+    model.train()
+    from paddle_tpu.nn import functional_call, functional_train_graph
+    params, _, buffers = functional_train_graph(model)
+    opt = paddle.optimizer.AdamW(1e-3)
+    state = jax.jit(opt.init_state)(params)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 128, (4, 16)))
+    mlm_labels = jnp.asarray(np.where(rng.rand(4, 16) < 0.15,
+                                      np.asarray(ids), -100))
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (4,)))
+
+    @jax.jit
+    def step(p, s):
+        def loss_fn(p):
+            (mlm, nsp), _ = functional_call(model, p, buffers, ids)
+            return B.bert_pretrain_loss(mlm, nsp, mlm_labels, nsp_labels)
+        l, g = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.apply(p, g, s, 1e-3)
+        return p, s, l
+
+    losses = []
+    for _ in range(10):
+        params, state, l = step(params, state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_fused_attention_matches_composition():
+    rng = np.random.RandomState(2)
+    B_, S, H, heads = 2, 8, 16, 4
+    hd = H // heads
+    x = jnp.asarray(rng.randn(B_, S, H).astype(np.float32))
+    qkv_w = jnp.asarray(rng.randn(3, heads, hd, H).astype(np.float32) * 0.2)
+    qkv_b = jnp.asarray(rng.randn(3, heads, hd).astype(np.float32) * 0.1)
+    lin_w = jnp.asarray(rng.randn(H, H).astype(np.float32) * 0.2)
+    lin_b = jnp.asarray(rng.randn(H).astype(np.float32) * 0.1)
+    ln_s = jnp.ones(H); ln_b = jnp.zeros(H)
+
+    out = fused_attention(x, qkv_w, lin_w, pre_layer_norm=False,
+                          ln_scale=ln_s, ln_bias=ln_b, qkv_bias=qkv_b,
+                          linear_bias=lin_b, training=False)
+
+    # reference composition
+    w2 = qkv_w.reshape(3 * H, H).T
+    qkv = (x @ w2 + qkv_b.reshape(-1)).reshape(B_, S, 3, heads, hd)
+    attn = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                          qkv[:, :, 2], training=False)
+    ref = x + attn.reshape(B_, S, H) @ lin_w + lin_b
+    ref = F.layer_norm(ref, (H,), ln_s, ln_b, 1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_attention_pre_ln():
+    rng = np.random.RandomState(3)
+    B_, S, H, heads = 1, 4, 8, 2
+    x = jnp.asarray(rng.randn(B_, S, H).astype(np.float32))
+    qkv_w = jnp.asarray(rng.randn(3, heads, H // heads, H)
+                        .astype(np.float32) * 0.2)
+    lin_w = jnp.asarray(rng.randn(H, H).astype(np.float32) * 0.2)
+    out = fused_attention(x, qkv_w, lin_w, pre_layer_norm=True,
+                          pre_ln_scale=jnp.ones(H),
+                          pre_ln_bias=jnp.zeros(H), training=False)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_fused_feedforward_matches_composition():
+    rng = np.random.RandomState(4)
+    B_, S, H, FF = 2, 6, 12, 24
+    x = jnp.asarray(rng.randn(B_, S, H).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(H, FF).astype(np.float32) * 0.3)
+    b1 = jnp.asarray(rng.randn(FF).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(FF, H).astype(np.float32) * 0.3)
+    b2 = jnp.asarray(rng.randn(H).astype(np.float32) * 0.1)
+    ln_s, ln_b = jnp.ones(H), jnp.zeros(H)
+    out = fused_feedforward(x, w1, w2, b1, b2, ln2_scale=ln_s, ln2_bias=ln_b,
+                            dropout1_rate=0.0, dropout2_rate=0.0,
+                            activation="gelu", training=False)
+    ref = x + (F.gelu(x @ w1 + b1) @ w2 + b2)
+    ref = F.layer_norm(ref, (H,), ln_s, ln_b, 1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
