@@ -249,11 +249,7 @@ def fused_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
     out = out.reshape(B, S, heads * head_dim) @ linear_weight
     if linear_bias is not None:
         out = out + linear_bias
-    if dropout_rate > 0.0 and training:
-        from ....random import next_key
-        keep = 1.0 - dropout_rate
-        mask = jax.random.bernoulli(next_key(), keep, out.shape)
-        out = jnp.where(mask, out / keep, 0.0)
+    out = F.dropout(out, dropout_rate, training=training)
     out = residual + out
     if not pre_layer_norm:
         out = F.layer_norm(out, (H,), ln_scale, ln_bias, ln_epsilon)
@@ -281,18 +277,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if linear1_bias is not None:
         h = h + linear1_bias
     h = getattr(F, activation)(h)
-    from ....random import next_key
-    if dropout1_rate > 0.0 and training:
-        keep = 1.0 - dropout1_rate
-        m = jax.random.bernoulli(next_key(), keep, h.shape)
-        h = jnp.where(m, h / keep, 0.0)
+    h = F.dropout(h, dropout1_rate, training=training)
     h = h @ linear2_weight
     if linear2_bias is not None:
         h = h + linear2_bias
-    if dropout2_rate > 0.0 and training:
-        keep = 1.0 - dropout2_rate
-        m = jax.random.bernoulli(next_key(), keep, h.shape)
-        h = jnp.where(m, h / keep, 0.0)
+    h = F.dropout(h, dropout2_rate, training=training)
     out = residual + h
     if not pre_layer_norm:
         out = F.layer_norm(out, (H,), ln2_scale, ln2_bias, ln2_epsilon)

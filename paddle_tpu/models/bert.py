@@ -10,9 +10,7 @@ separately. Pretraining heads: masked-LM + next-sentence.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
 from .. import nn
