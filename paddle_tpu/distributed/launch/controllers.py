@@ -134,6 +134,9 @@ class CollectiveController:
             "PADDLE_CURRENT_ENDPOINT": endpoints[global_rank],
             "PADDLE_MASTER": ctx.args.master or "",
             "PADDLE_JOB_ID": ctx.args.job_id,
+            # elastic: scripts check this to auto-resume from checkpoints
+            # (reference: PADDLE_RESTART semantics in elastic manager)
+            "PADDLE_RESTART_COUNT": str(self.restarts),
             # workers may opt into heartbeats via launch.elastic
             "PADDLE_ELASTIC_STORE_ENDPOINT":
                 f"{self.master.store.host}:{self.master.store.port}",

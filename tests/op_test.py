@@ -1,0 +1,70 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py — the golden-value
+framework behind thousands of test_*_op.py files: forward vs a numpy
+reference, gradients vs numeric finite differences).
+
+TPU shape: forward checks run the op under jit; gradient checks compare
+jax.grad against central finite differences in fp64-free form (fp32 with
+scaled tolerances)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def check_forward(op: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                  rtol=1e-5, atol=1e-6, **kwargs):
+    """op(*jnp_inputs, **kwargs) vs np_ref(*np_inputs, **kwargs)."""
+    got = jax.jit(lambda *a: op(*a, **kwargs))(*map(jnp.asarray, inputs))
+    want = np_ref(*inputs, **kwargs)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=rtol, atol=atol)
+
+
+def check_grad(op: Callable, inputs: Sequence[np.ndarray], argnums=0,
+               eps=1e-3, rtol=2e-2, atol=1e-3, reduce_fn=None, **kwargs):
+    """jax.grad vs central finite differences on a scalarized output
+    (reference: OpTest.check_grad's numeric jacobian)."""
+    if reduce_fn is None:
+        reduce_fn = lambda y: jnp.sum(y * jnp.cos(
+            jnp.arange(y.size, dtype=jnp.float32).reshape(y.shape)))
+
+    def scalar(*args):
+        return reduce_fn(op(*args, **kwargs))
+
+    analytic = np.asarray(
+        jax.grad(scalar, argnums=argnums)(*map(jnp.asarray, inputs)))
+
+    x = np.array(inputs[argnums], dtype=np.float32)
+    numeric = np.zeros_like(x)
+    flat = x.ravel()
+    nflat = numeric.ravel()
+    f = jax.jit(scalar)
+
+    def eval_at(v):
+        args = list(inputs)
+        args[argnums] = v.reshape(x.shape)
+        return float(f(*map(jnp.asarray, args)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = eval_at(flat)
+        flat[i] = orig - eps
+        fm = eval_at(flat)
+        flat[i] = orig
+        nflat[i] = (fp - fm) / (2 * eps)
+
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+def run_op_test(op: Callable, np_ref: Callable,
+                inputs: Sequence[np.ndarray],
+                grad_argnums: Sequence[int] = (0,),
+                fwd_tol: Dict = None, grad_tol: Dict = None, **kwargs):
+    """Full OpTest: forward golden check + gradient check per input."""
+    check_forward(op, np_ref, inputs, **(fwd_tol or {}), **kwargs)
+    for a in grad_argnums:
+        check_grad(op, inputs, argnums=a, **(grad_tol or {}), **kwargs)

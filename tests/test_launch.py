@@ -149,3 +149,27 @@ def test_elastic_manager_heartbeats():
     em.set_desired_np(3)
     assert em.desired_np() == 3 and em.need_rescale()
     store.close()
+
+
+def test_restart_count_env_increments(tmp_path):
+    """Workers see PADDLE_RESTART_COUNT so they can auto-resume from a
+    checkpoint after an elastic restart."""
+    script = tmp_path / "counting.py"
+    marker = tmp_path / "attempted"
+    out = tmp_path / "counts.txt"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        with open({str(out)!r}, "a") as f:
+            f.write(os.environ["PADDLE_RESTART_COUNT"] + "\\n")
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").write("1")
+            sys.exit(101)
+        sys.exit(0)
+    """))
+    rc = launch(["--nproc_per_node", "1", "--elastic_level", "1",
+                 "--max_restarts", "2", "--log_dir", str(tmp_path / "log"),
+                 str(script)])
+    assert rc == 0
+    counts = out.read_text().split()
+    assert counts == ["0", "1"]

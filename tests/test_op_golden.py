@@ -1,0 +1,118 @@
+"""Golden-value op tests via the OpTest harness (reference pattern:
+test/legacy_test/test_*_op.py — forward vs numpy, grad vs finite diff)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from scipy import special as sps
+
+from paddle_tpu.nn import functional as F
+from op_test import check_forward, check_grad, run_op_test
+
+
+def _randn(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale
+            ).astype(np.float32)
+
+
+def test_matmul_op():
+    run_op_test(jnp.matmul, np.matmul,
+                [_randn(4, 6, seed=1), _randn(6, 3, seed=2)],
+                grad_argnums=(0, 1))
+
+
+def test_softmax_op():
+    def np_softmax(x, axis=-1):
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+    run_op_test(jax.nn.softmax, np_softmax, [_randn(3, 7, seed=3)])
+
+
+def test_gelu_op():
+    def np_gelu(x):
+        return 0.5 * x * (1 + sps.erf(x / np.sqrt(2)))
+    run_op_test(lambda x: F.gelu(x, approximate=False), np_gelu,
+                [_randn(5, 4, seed=4)])
+
+
+def test_layer_norm_op():
+    H = 8
+    g = _randn(H, seed=5, scale=0.1) + 1.0
+    b = _randn(H, seed=6, scale=0.1)
+
+    def np_ln(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * g + b
+
+    run_op_test(lambda x, g, b: F.layer_norm(x, (H,), g, b, 1e-5), np_ln,
+                [_randn(3, H, seed=7), g, b], grad_argnums=(0, 1, 2),
+                grad_tol={"rtol": 5e-2, "atol": 5e-3})
+
+
+def test_rms_norm_op():
+    H = 8
+    g = _randn(H, seed=8, scale=0.1) + 1.0
+
+    def np_rms(x, g):
+        return x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * g
+
+    run_op_test(lambda x, g: F.rms_norm(x, g, None, 1e-6), np_rms,
+                [_randn(3, H, seed=9), g], grad_argnums=(0, 1),
+                grad_tol={"rtol": 5e-2, "atol": 5e-3})
+
+
+def test_cross_entropy_op():
+    V = 6
+    logits = _randn(4, V, seed=10)
+    labels = np.random.RandomState(11).randint(0, V, (4,))
+
+    def np_ce(x, y):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return -np.log(p[np.arange(len(y)), y]).mean()
+
+    check_forward(lambda x: F.cross_entropy(x, jnp.asarray(labels)),
+                  lambda x: np_ce(x, labels), [logits])
+    check_grad(lambda x: F.cross_entropy(x, jnp.asarray(labels)),
+               [logits], reduce_fn=lambda y: y)
+
+
+def test_sdpa_op_golden():
+    """scaled_dot_product_attention vs a pure-numpy attention."""
+    B, S, H, D = 1, 5, 2, 4
+    q, k, v = (_randn(B, S, H, D, seed=s) for s in (12, 13, 14))
+
+    def np_sdpa(q, k, v):
+        qq = q.transpose(0, 2, 1, 3)
+        kk = k.transpose(0, 2, 1, 3)
+        vv = v.transpose(0, 2, 1, 3)
+        logits = qq @ kk.transpose(0, 1, 3, 2) / np.sqrt(D)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return (p @ vv).transpose(0, 2, 1, 3)
+
+    check_forward(lambda q, k, v: F.scaled_dot_product_attention(
+        q, k, v, training=False), np_sdpa, [q, k, v], rtol=1e-4, atol=1e-5)
+    check_grad(lambda q, k, v: F.scaled_dot_product_attention(
+        q, k, v, training=False), [q, k, v], argnums=0)
+
+
+def test_embedding_op_grad():
+    V, H = 10, 4
+    table = _randn(V, H, seed=15)
+    idx = np.asarray([1, 3, 3, 7])
+    check_forward(lambda t: jnp.take(t, jnp.asarray(idx), axis=0),
+                  lambda t: t[idx], [table])
+    check_grad(lambda t: jnp.take(t, jnp.asarray(idx), axis=0), [table])
+
+
+def test_swiglu_op():
+    from paddle_tpu.incubate.nn.functional import swiglu
+
+    def np_swiglu(x, y):
+        return x / (1 + np.exp(-x)) * y
+
+    run_op_test(swiglu, np_swiglu, [_randn(3, 6, seed=16),
+                                    _randn(3, 6, seed=17)],
+                grad_argnums=(0, 1))
