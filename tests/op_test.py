@@ -16,23 +16,28 @@ import jax.numpy as jnp
 
 
 def check_forward(op: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
-                  rtol=1e-5, atol=1e-6, **kwargs):
-    """op(*jnp_inputs, **kwargs) vs np_ref(*np_inputs, **kwargs)."""
-    got = jax.jit(lambda *a: op(*a, **kwargs))(*map(jnp.asarray, inputs))
-    want = np_ref(*inputs, **kwargs)
+                  rtol=1e-5, atol=1e-6):
+    """op(*jnp_inputs) vs np_ref(*np_inputs). Bind op-specific keyword
+    arguments into the callables (lambdas) — forwarding one kwargs dict to
+    both op and reference would force their signatures to match."""
+    got = jax.jit(lambda *a: op(*a))(*map(jnp.asarray, inputs))
+    want = np_ref(*inputs)
     np.testing.assert_allclose(np.asarray(got), want, rtol=rtol, atol=atol)
 
 
 def check_grad(op: Callable, inputs: Sequence[np.ndarray], argnums=0,
-               eps=1e-3, rtol=2e-2, atol=1e-3, reduce_fn=None, **kwargs):
+               eps=1e-3, rtol=2e-2, atol=1e-3, reduce_fn=None):
     """jax.grad vs central finite differences on a scalarized output
-    (reference: OpTest.check_grad's numeric jacobian)."""
+    (reference: OpTest.check_grad's numeric jacobian). fp32 inputs only —
+    the FD perturbation and tolerances are calibrated for fp32."""
+    assert np.asarray(inputs[argnums]).dtype == np.float32, (
+        "check_grad expects float32 inputs (FD eps/tolerances assume it)")
     if reduce_fn is None:
         reduce_fn = lambda y: jnp.sum(y * jnp.cos(
             jnp.arange(y.size, dtype=jnp.float32).reshape(y.shape)))
 
     def scalar(*args):
-        return reduce_fn(op(*args, **kwargs))
+        return reduce_fn(op(*args))
 
     analytic = np.asarray(
         jax.grad(scalar, argnums=argnums)(*map(jnp.asarray, inputs)))
@@ -63,8 +68,8 @@ def check_grad(op: Callable, inputs: Sequence[np.ndarray], argnums=0,
 def run_op_test(op: Callable, np_ref: Callable,
                 inputs: Sequence[np.ndarray],
                 grad_argnums: Sequence[int] = (0,),
-                fwd_tol: Dict = None, grad_tol: Dict = None, **kwargs):
+                fwd_tol: Dict = None, grad_tol: Dict = None):
     """Full OpTest: forward golden check + gradient check per input."""
-    check_forward(op, np_ref, inputs, **(fwd_tol or {}), **kwargs)
+    check_forward(op, np_ref, inputs, **(fwd_tol or {}))
     for a in grad_argnums:
-        check_grad(op, inputs, argnums=a, **(grad_tol or {}), **kwargs)
+        check_grad(op, inputs, argnums=a, **(grad_tol or {}))
