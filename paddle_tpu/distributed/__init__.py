@@ -19,6 +19,7 @@ from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                          scatter, send)
 from . import checkpoint  # noqa: F401
 from .store import MasterStore, TCPStore
+from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from .watchdog import CommWatchdog, get_watchdog
 from .checkpoint import load_state_dict, save_state_dict
@@ -54,5 +55,5 @@ __all__ = [
     "sharding", "group_sharded_parallel", "save_group_sharded_model",
     # checkpoint
     "checkpoint", "save_state_dict", "load_state_dict",
-    "TCPStore", "MasterStore", "rpc", "CommWatchdog", "get_watchdog",
+    "TCPStore", "MasterStore", "rpc", "passes", "CommWatchdog", "get_watchdog",
 ]

@@ -2,7 +2,9 @@ from . import pp_utils  # noqa: F401
 from . import sharding  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention
 from .pp_utils.spmd_pipeline import (pipeline_last_stage_value, spmd_pipeline,
-                                     spmd_pipeline_interleaved)
+                                     spmd_pipeline_interleaved,
+                                     vpp_block_permutation, vpp_chunk_blocks,
+                                     vpp_wrap_shard_params)
 from .segment_parallel import (SegmentParallel, sep_reduce_gradients,
                                split_sequence)
 from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
@@ -10,6 +12,7 @@ from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
 
 __all__ = ["pp_utils", "sharding", "spmd_pipeline",
            "spmd_pipeline_interleaved", "pipeline_last_stage_value",
+           "vpp_block_permutation", "vpp_chunk_blocks", "vpp_wrap_shard_params",
            "DygraphShardingOptimizer",
            "GroupShardedOptimizerStage2", "GroupShardedStage2",
            "GroupShardedStage3", "ring_attention", "ulysses_attention",

@@ -1,0 +1,263 @@
+"""Distributed program passes (reference:
+python/paddle/distributed/passes/ — pass_base.py new_pass/PassContext and
+the auto_parallel_* pass family: amp, recompute, sharding, gradient_merge,
+pipeline_scheduler_pass/{pipeline_1f1b,pipeline_fthenb,pipeline_vpp}).
+
+TPU design: the reference's passes rewrite a static ProgramDesc op-by-op.
+Here the "program" is a TrainSpec — the declarative inputs to
+models.hybrid_engine.build_train_step — and each pass is a REAL transform
+on it (wrap the loss in autocast/remat, wrap the optimizer in gradient
+merge, select the pipeline schedule); XLA then owns the op-level rewrites
+the reference does by hand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["TrainSpec", "PassBase", "PassContext", "new_pass",
+           "apply_passes", "list_passes"]
+
+
+@dataclasses.dataclass
+class TrainSpec:
+    """Declarative training program (the pass IR).
+
+    Either give a static `loss_fn` (already embedding its microbatching /
+    pipeline schedule), or a `loss_fn_factory(spec) -> loss_fn` so the
+    pipeline passes (schedule/virtual_pp/num_microbatches) take effect at
+    build time — the model families' hybrid_loss_fn maps onto a factory
+    directly."""
+
+    loss_fn: Optional[Callable] = None   # (params, tokens, labels) -> scalar
+    optimizer: Any = None
+    param_specs: Any = None              # PartitionSpec tree
+    mesh: Any = None
+    num_microbatches: int = 1
+    schedule: str = "1F1B"               # 1F1B | FThenB | VPP
+    virtual_pp: int = 1
+    loss_fn_factory: Optional[Callable] = None
+    applied: tuple = ()
+
+    def resolved_loss_fn(self) -> Callable:
+        if self.loss_fn_factory is not None:
+            return self.loss_fn_factory(self)
+        # FThenB compiles identically to 1F1B (the scan IS fill-then-
+        # drain), so a static loss_fn stays valid for it
+        if (self.schedule not in ("1F1B", "FThenB") or self.virtual_pp != 1
+                or self.num_microbatches != 1):
+            raise ValueError(
+                "schedule/virtual_pp/num_microbatches are set but loss_fn "
+                "is static — pass loss_fn_factory so pipeline passes can "
+                "take effect (a bare loss_fn cannot be re-scheduled)")
+        assert self.loss_fn is not None, "TrainSpec needs a loss_fn"
+        return self.loss_fn
+
+    def build(self, **kw):
+        """Compile via the hybrid engine (passes must run first)."""
+        from ...models.hybrid_engine import build_train_step
+        return build_train_step(self.resolved_loss_fn(), self.param_specs,
+                                self.mesh, self.optimizer, **kw)
+
+
+class PassContext:
+    def __init__(self):
+        self._applied: List[str] = []
+
+    def record(self, name: str):
+        self._applied.append(name)
+
+    @property
+    def passes(self):
+        return list(self._applied)
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, attrs: Optional[Dict] = None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, spec: TrainSpec) -> bool:
+        return True
+
+    def apply(self, spec: TrainSpec, context: Optional[PassContext] = None
+              ) -> TrainSpec:
+        assert self.check(spec), f"pass {self.name}: precondition failed"
+        out = self._apply_impl(spec)
+        # replace, never mutate: an impl may legitimately return its input
+        out = dataclasses.replace(out, applied=spec.applied + (self.name,))
+        if context is not None:
+            context.record(self.name)
+        return out
+
+    def _apply_impl(self, spec: TrainSpec) -> TrainSpec:
+        raise NotImplementedError
+
+
+def _wrap_loss(spec: TrainSpec, wrapper: Callable) -> TrainSpec:
+    """Apply a loss-transform through whichever form the spec carries."""
+    assert spec.loss_fn is not None or spec.loss_fn_factory is not None, (
+        "TrainSpec needs a loss_fn or loss_fn_factory before loss passes")
+    if spec.loss_fn_factory is not None:
+        inner_factory = spec.loss_fn_factory
+        return dataclasses.replace(
+            spec, loss_fn_factory=lambda s: wrapper(inner_factory(s)))
+    return dataclasses.replace(spec, loss_fn=wrapper(spec.loss_fn))
+
+
+class AMPPass(PassBase):
+    """reference: auto_parallel_amp.py / auto_parallel_fp16.py — cast the
+    compute into bf16/fp16 around the loss."""
+
+    name = "auto_parallel_amp"
+
+    def _apply_impl(self, spec):
+        from ...amp import auto_cast
+        level = self.attrs.get("level", "O1")
+        dtype = self.attrs.get("dtype", "bfloat16")
+
+        def wrap(inner):
+            def amp_loss(params, tokens, labels):
+                with auto_cast(True, level=level, dtype=dtype):
+                    return inner(params, tokens, labels)
+            return amp_loss
+
+        return _wrap_loss(spec, wrap)
+
+
+class RecomputePass(PassBase):
+    """reference: auto_parallel_recompute.py — rematerialize the forward in
+    backward. Whole-loss jax.checkpoint here; per-block remat already lives
+    inside the model families' stage functions."""
+
+    name = "auto_parallel_recompute"
+
+    def _apply_impl(self, spec):
+        import jax
+        policy = self.attrs.get("policy")
+        kw = {"policy": policy} if policy is not None else {}
+        return _wrap_loss(spec, lambda inner: jax.checkpoint(inner, **kw))
+
+
+class GradientMergePass(PassBase):
+    """reference: auto_parallel_gradient_merge.py."""
+
+    name = "auto_parallel_gradient_merge"
+
+    def check(self, spec):
+        return self.attrs.get("k_steps", 1) >= 1
+
+    def _apply_impl(self, spec):
+        from ...optimizer import GradientMergeOptimizer
+        k = self.attrs.get("k_steps", 1)
+        if k <= 1 or isinstance(spec.optimizer, GradientMergeOptimizer):
+            return spec  # idempotent: never double-wrap (k would compound)
+        return dataclasses.replace(
+            spec, optimizer=GradientMergeOptimizer(
+                spec.optimizer, k_steps=k, avg=self.attrs.get("avg", True)))
+
+
+class ShardingPass(PassBase):
+    """reference: auto_parallel_sharding.py — ZeRO stages. Under GSPMD the
+    optimizer-state sharding IS the param-spec tree; this pass re-annotates
+    the specs so state (and for stage>=3, params) shard over the axis."""
+
+    name = "auto_parallel_sharding"
+
+    def _apply_impl(self, spec):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        axis = self.attrs.get("axis", "sharding")
+        stage = self.attrs.get("stage", 1)
+        if stage < 3 or spec.param_specs is None:
+            # stages 1/2: state sharding follows the (unchanged) specs via
+            # state_specs_for; nothing to rewrite in the spec tree
+            return dataclasses.replace(spec)
+
+        import warnings
+
+        def shard_first_free(s):
+            if not isinstance(s, P):
+                return s
+            if axis in tuple(s):  # idempotent: never duplicate a mesh axis
+                return s
+            dims = list(s) + [None] * (0 if s else 1)
+            for i, d in enumerate(dims):
+                if d is None:
+                    dims[i] = axis
+                    return P(*dims)
+            # a spec like P('mp') may still have implicit free trailing
+            # dims, but the spec alone doesn't carry the array rank — be
+            # loud instead of silently leaving the param replicated
+            warnings.warn(
+                f"auto_parallel_sharding: spec {s} has no explicit free "
+                f"dim; param stays unsharded over '{axis}' (write specs "
+                f"with explicit None dims for stage-3)")
+            return s
+
+        new_specs = jax.tree.map(shard_first_free, spec.param_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        return dataclasses.replace(spec, param_specs=new_specs)
+
+
+class Pipeline1F1BPass(PassBase):
+    """reference: pipeline_scheduler_pass/pipeline_1f1b.py."""
+
+    name = "pipeline_scheduler_1F1B"
+
+    def _apply_impl(self, spec):
+        return dataclasses.replace(spec, schedule="1F1B", virtual_pp=1)
+
+
+class PipelineFThenBPass(PassBase):
+    """reference: pipeline_scheduler_pass/pipeline_fthenb.py — on TPU the
+    compiled scan IS fill-then-drain; same engine as 1F1B."""
+
+    name = "pipeline_scheduler_FThenB"
+
+    def _apply_impl(self, spec):
+        return dataclasses.replace(spec, schedule="FThenB", virtual_pp=1)
+
+
+class PipelineVPPPass(PassBase):
+    """reference: pipeline_scheduler_pass/pipeline_vpp.py — interleaved
+    virtual stages (spmd_pipeline_interleaved)."""
+
+    name = "pipeline_scheduler_VPP"
+
+    def check(self, spec):
+        return self.attrs.get("vpp_degree", 2) >= 1
+
+    def _apply_impl(self, spec):
+        return dataclasses.replace(spec, schedule="VPP",
+                                   virtual_pp=self.attrs.get("vpp_degree", 2))
+
+
+_PASSES = {p.name: p for p in
+           (AMPPass, RecomputePass, GradientMergePass, ShardingPass,
+            Pipeline1F1BPass, PipelineFThenBPass, PipelineVPPPass)}
+
+
+def new_pass(name: str, attrs: Optional[Dict] = None) -> PassBase:
+    """(reference: pass_base.py new_pass)."""
+    if name not in _PASSES:
+        raise ValueError(f"unknown pass {name!r}; have {sorted(_PASSES)}")
+    return _PASSES[name](attrs)
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_passes(spec: TrainSpec, passes, context: Optional[PassContext] = None
+                 ) -> TrainSpec:
+    context = context or PassContext()
+    for p in passes:
+        if isinstance(p, str):
+            p = new_pass(p)
+        elif isinstance(p, tuple):  # ("name", {attrs}) shorthand
+            p = new_pass(p[0], p[1] if len(p) > 1 else None)
+        spec = p.apply(spec, context)
+    return spec

@@ -86,16 +86,29 @@ def test_vpp_curve_aligns_with_dense():
 
 
 def test_zero_sharded_curve_aligns():
-    """ZeRO-sharded optimizer states don't change the math: sharding the
-    state tree over a sharding axis gives the same curve."""
-    mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    """ZeRO stage-3 (params+grads+optimizer state sharded) stays on the
+    dense loss curve — real sharded placement via group_sharded."""
+    from paddle_tpu.distributed.sharding import build_sharded_train_step
+    mesh = dist.build_mesh({"sharding": 8})
     params = G.init_hybrid_params(GCFG, jax.random.PRNGKey(2))
     rng = np.random.RandomState(2)
     tokens = jnp.asarray(rng.randint(0, 64, (8, 16)))
     labels = jnp.asarray(rng.randint(0, 64, (8, 16)))
-    ref = dense_curve(G, GCFG, params, tokens, labels, steps=5)
-    # dp doubles as the ZeRO axis here: grads already pmean over dp; the
-    # optimizer state shards simply follow the param specs
-    hyb = hybrid_curve(G, GCFG, params, tokens, labels, steps=5, mesh=mesh,
-                       microbatches=2)
-    np.testing.assert_allclose(hyb, ref, rtol=2e-3, atol=2e-4)
+    ref = dense_curve(G, GCFG, params, tokens, labels, steps=5, lr=1e-2)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+
+    def loss_fn(p, tok, lab):
+        return G.dense_loss(p, tok, lab, GCFG, remat=False)
+
+    _, place, compile_for = build_sharded_train_step(
+        loss_fn, opt, mesh, level="p_g_os", data_axes="sharding")
+    p, state = place(params)
+    step, batch_sharding = compile_for(p)
+    tok_s = jax.device_put(tokens, batch_sharding)
+    lab_s = jax.device_put(labels, batch_sharding)
+    losses = []
+    for _ in range(5):
+        p, state, l = step(p, state, tok_s, lab_s, jnp.float32(1e-2))
+        losses.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-4)

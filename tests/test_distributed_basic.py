@@ -173,3 +173,100 @@ def test_sharded_optimizer_states():
     grads = {"w": jnp.ones_like(params["w"])}
     new_p, new_s = sharded.apply(params, grads, state)
     assert new_p["w"].shape == (16, 8)
+
+
+def test_passes_registry_and_transforms():
+    """(reference: python/paddle/distributed/passes/pass_base.py new_pass +
+    the auto_parallel pass family)."""
+    from paddle_tpu.distributed.passes import (PassContext, TrainSpec,
+                                               apply_passes, list_passes,
+                                               new_pass)
+    from paddle_tpu.optimizer import GradientMergeOptimizer
+    from jax.sharding import PartitionSpec as P
+
+    assert "auto_parallel_amp" in list_passes()
+    spec = TrainSpec(loss_fn=lambda p, t, l: jnp.sum(p["w"]),
+                     optimizer=paddle.optimizer.SGD(0.1),
+                     param_specs={"w": P(None, "mp"), "b": P()})
+    ctx = PassContext()
+    out = apply_passes(spec, [
+        new_pass("auto_parallel_amp", {"dtype": "bfloat16"}),
+        new_pass("auto_parallel_gradient_merge", {"k_steps": 2}),
+        new_pass("pipeline_scheduler_VPP", {"vpp_degree": 2}),
+        new_pass("auto_parallel_sharding", {"stage": 3, "axis": "sharding"}),
+    ], ctx)
+    assert isinstance(out.optimizer, GradientMergeOptimizer)
+    assert out.schedule == "VPP" and out.virtual_pp == 2
+    # stage-3: first free dim of every spec now carries the sharding axis
+    assert out.param_specs["w"] == P("sharding", "mp")
+    assert out.param_specs["b"] == P("sharding")
+    assert len(ctx.passes) == 4
+    # original spec untouched (passes are functional)
+    assert spec.schedule == "1F1B" and spec.param_specs["b"] == P()
+
+    with pytest.raises(ValueError):
+        new_pass("nonexistent_pass")
+
+
+def test_passes_amp_and_recompute_still_compute():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.passes import TrainSpec, apply_passes
+
+    def loss_fn(params, tokens, labels):
+        return jnp.mean((tokens @ params["w"] - labels) ** 2)
+
+    spec = TrainSpec(loss_fn=loss_fn, optimizer=paddle.optimizer.SGD(0.1))
+    out = apply_passes(spec, ["auto_parallel_amp",
+                              "auto_parallel_recompute"])
+    p = {"w": jnp.ones((4, 2))}
+    x = jnp.ones((3, 4))
+    y = jnp.zeros((3, 2))
+    l, g = jax.jit(jax.value_and_grad(
+        lambda p: out.loss_fn(p, x, y)))(p)
+    assert jnp.isfinite(l)
+    assert jnp.isfinite(g["w"]).all()
+
+
+def test_pipeline_pass_requires_factory_and_factory_works():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.passes import TrainSpec, apply_passes
+
+    # static loss_fn + pipeline pass -> loud error at build
+    spec = TrainSpec(loss_fn=lambda p, t, l: jnp.sum(p["w"]),
+                     optimizer=paddle.optimizer.SGD(0.1))
+    out = apply_passes(spec, [("pipeline_scheduler_VPP", {"vpp_degree": 2})])
+    with pytest.raises(ValueError, match="loss_fn_factory"):
+        out.resolved_loss_fn()
+
+    # factory consumes the schedule set by the pass
+    seen = {}
+
+    def factory(s):
+        seen["schedule"] = s.schedule
+        seen["vpp"] = s.virtual_pp
+        return lambda p, t, l: jnp.sum(p["w"])
+
+    spec2 = TrainSpec(loss_fn_factory=factory,
+                      optimizer=paddle.optimizer.SGD(0.1))
+    out2 = apply_passes(spec2, [("pipeline_scheduler_VPP",
+                                 {"vpp_degree": 2}),
+                                "auto_parallel_amp"])
+    fn = out2.resolved_loss_fn()
+    assert seen == {"schedule": "VPP", "vpp": 2}
+    assert callable(fn)
+
+
+def test_sharding_pass_idempotent():
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.passes import TrainSpec, apply_passes
+    spec = TrainSpec(loss_fn=lambda p, t, l: 0.0,
+                     optimizer=paddle.optimizer.SGD(0.1),
+                     param_specs={"w": P(None, "mp"), "b": P()})
+    once = apply_passes(spec, [("auto_parallel_sharding", {"stage": 3})])
+    twice = apply_passes(once, [("auto_parallel_sharding", {"stage": 3})])
+    assert twice.param_specs["w"] == P("sharding", "mp")
+    assert twice.param_specs["b"] == P("sharding")
