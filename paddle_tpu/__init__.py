@@ -42,6 +42,7 @@ from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
+from . import text  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 

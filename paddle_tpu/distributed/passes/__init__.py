@@ -114,6 +114,8 @@ class AMPPass(PassBase):
     name = "auto_parallel_amp"
 
     def _apply_impl(self, spec):
+        if self.name in spec.applied:  # idempotent: one autocast wrap
+            return spec
         from ...amp import auto_cast
         level = self.attrs.get("level", "O1")
         dtype = self.attrs.get("dtype", "bfloat16")
@@ -135,6 +137,8 @@ class RecomputePass(PassBase):
     name = "auto_parallel_recompute"
 
     def _apply_impl(self, spec):
+        if self.name in spec.applied:  # nesting checkpoint only re-runs
+            return spec                # the forward redundantly
         import jax
         policy = self.attrs.get("policy")
         kw = {"policy": policy} if policy is not None else {}
@@ -152,11 +156,20 @@ class GradientMergePass(PassBase):
     def _apply_impl(self, spec):
         from ...optimizer import GradientMergeOptimizer
         k = self.attrs.get("k_steps", 1)
-        if k <= 1 or isinstance(spec.optimizer, GradientMergeOptimizer):
-            return spec  # idempotent: never double-wrap (k would compound)
+        avg = self.attrs.get("avg", True)
+        if isinstance(spec.optimizer, GradientMergeOptimizer):
+            # re-application RECONFIGURES (never nests — k would compound)
+            inner = spec.optimizer._inner
+            if k <= 1:
+                return dataclasses.replace(spec, optimizer=inner)
+            return dataclasses.replace(
+                spec, optimizer=GradientMergeOptimizer(inner, k_steps=k,
+                                                       avg=avg))
+        if k <= 1:
+            return spec
         return dataclasses.replace(
-            spec, optimizer=GradientMergeOptimizer(
-                spec.optimizer, k_steps=k, avg=self.attrs.get("avg", True)))
+            spec, optimizer=GradientMergeOptimizer(spec.optimizer, k_steps=k,
+                                                   avg=avg))
 
 
 class ShardingPass(PassBase):
@@ -178,16 +191,28 @@ class ShardingPass(PassBase):
 
         import warnings
 
-        def shard_first_free(s):
+        # shape-aware when example params are provided (the safe path:
+        # group_sharded.shard_spec_for picks a divisible dim); spec-only
+        # otherwise, touching ONLY explicit None dims
+        example = self.attrs.get("example_params")
+        axis_size = (spec.mesh.shape[axis]
+                     if spec.mesh is not None and axis in getattr(
+                         spec.mesh, "shape", {}) else None)
+
+        def shard_first_free(s, leaf=None):
             if not isinstance(s, P):
                 return s
             if axis in tuple(s):  # idempotent: never duplicate a mesh axis
                 return s
-            dims = list(s) + [None] * (0 if s else 1)
+            dims = list(s)
             for i, d in enumerate(dims):
-                if d is None:
-                    dims[i] = axis
-                    return P(*dims)
+                if d is not None:
+                    continue
+                if leaf is not None and axis_size is not None and \
+                        leaf.shape[i] % axis_size != 0:
+                    continue  # dim not divisible by the axis: skip it
+                dims[i] = axis
+                return P(*dims)
             # a spec like P('mp') may still have implicit free trailing
             # dims, but the spec alone doesn't carry the array rank — be
             # loud instead of silently leaving the param replicated
@@ -197,8 +222,13 @@ class ShardingPass(PassBase):
                 f"with explicit None dims for stage-3)")
             return s
 
-        new_specs = jax.tree.map(shard_first_free, spec.param_specs,
-                                 is_leaf=lambda x: isinstance(x, P))
+        is_spec = lambda x: isinstance(x, P)
+        if example is not None:
+            new_specs = jax.tree.map(shard_first_free, spec.param_specs,
+                                     example, is_leaf=is_spec)
+        else:
+            new_specs = jax.tree.map(shard_first_free, spec.param_specs,
+                                     is_leaf=is_spec)
         return dataclasses.replace(spec, param_specs=new_specs)
 
 

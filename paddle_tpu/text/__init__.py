@@ -1,0 +1,103 @@
+"""paddle.text equivalent (reference: python/paddle/text/ — dataset
+wrappers + ViterbiDecoder backed by phi viterbi_decode kernels).
+
+The datasets in the reference are thin download helpers (out of scope on
+an air-gapped TPU host — use paddle_tpu.io.Dataset over local data); the
+real op is Viterbi decoding for CRF-style sequence labeling, implemented
+here as a lax.scan (jit/vmap/grad-safe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True):
+    """Most-likely tag sequence under a linear-chain CRF (reference:
+    python/paddle/text/viterbi_decode.py → phi viterbi_decode kernel).
+
+    potentials: [B, S, T] unary emission scores.
+    transition_params: [T, T] (+2 virtual BOS/EOS tags when
+        include_bos_eos_tag, matching the reference convention where the
+        last two rows/cols are BOS/EOS).
+    lengths: [B] valid sequence lengths (default: full).
+
+    Returns (scores [B], paths [B, S]) — positions beyond a sequence's
+    length hold 0.
+    """
+    potentials = jnp.asarray(potentials)
+    trans = jnp.asarray(transition_params, jnp.float32)
+    B, S, T = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    if include_bos_eos_tag:
+        # virtual start/stop: trans[-2] = from-BOS row, trans[:, -1] = to-EOS
+        ntags = T
+        start = trans[-2, :ntags]
+        stop = trans[:ntags, -1]
+        trans_core = trans[:ntags, :ntags]
+    else:
+        start = jnp.zeros((T,), jnp.float32)
+        stop = jnp.zeros((T,), jnp.float32)
+        trans_core = trans
+
+    em = potentials.astype(jnp.float32)
+    alpha0 = em[:, 0] + start[None, :]
+
+    def step(carry, t):
+        alpha = carry  # [B, T]
+        scores = alpha[:, :, None] + trans_core[None, :, :]  # prev->cur
+        best_prev = jnp.argmax(scores, axis=1)               # [B, T]
+        alpha_new = jnp.max(scores, axis=1) + em[:, t]
+        # positions past the length keep their alpha (masked later)
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, alpha_new, alpha)
+        return alpha, best_prev
+
+    alpha, backptrs = lax.scan(step, alpha0, jnp.arange(1, S))
+    # add the stop transition at each sequence's final position
+    final = alpha + stop[None, :]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)  # [B]
+
+    # backtrack (positions t >= length emit 0)
+    def back(carry, bp_t):
+        tag, t = carry
+        bp, idx = bp_t  # bp: [B, T] best_prev for step idx+1
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        active = (idx + 1) < lengths
+        on_path = (idx + 1) <= (lengths - 1)
+        emit = jnp.where(on_path, tag, 0)
+        tag = jnp.where(active, prev, tag)
+        return (tag, t - 1), emit
+
+    (first_tag, _), rev_path = lax.scan(
+        back, (last_tag, S - 2), (backptrs[::-1], jnp.arange(S - 2, -1, -1)))
+    path = jnp.concatenate([first_tag[:, None], rev_path[::-1].T], axis=1)
+    # zero positions beyond each length
+    mask = jnp.arange(S)[None, :] < lengths[:, None]
+    return scores, jnp.where(mask, path, 0).astype(jnp.int32)
+
+
+class ViterbiDecoder(Layer):
+    """(reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        del name
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

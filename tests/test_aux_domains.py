@@ -198,3 +198,48 @@ def test_asp_two_models_independent_masks():
     ga = {k: jnp.ones_like(v) for k, v in pa.items()}
     na, _ = opt_fa.apply(pa, ga, sa, 0.1)
     assert asp.calculate_density(np.asarray(na["weight"])) <= 0.5 + 1e-6
+
+# -- text (viterbi) ----------------------------------------------------------
+def _brute_viterbi(em, trans, start, stop):
+    """Exhaustive search reference."""
+    import itertools
+    S, T = em.shape
+    best, best_path = -1e30, None
+    for path in itertools.product(range(T), repeat=S):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, S):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        s += stop[path[-1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    S, T = 5, 3
+    em = rng.randn(1, S, T).astype(np.float32)
+    full = rng.randn(T + 2, T + 2).astype(np.float32)
+    scores, paths = viterbi_decode(jnp.asarray(em), jnp.asarray(full))
+    start, stop = full[-2, :T], full[:T, -1]
+    bscore, bpath = _brute_viterbi(em[0], full[:T, :T], start, stop)
+    assert abs(float(scores[0]) - bscore) < 1e-4
+    assert list(np.asarray(paths[0])) == bpath
+
+
+def test_viterbi_decoder_layer_and_lengths():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(1)
+    S, T = 6, 4
+    em = jnp.asarray(rng.randn(2, S, T).astype(np.float32))
+    trans = jnp.asarray(rng.randn(T + 2, T + 2).astype(np.float32))
+    dec = ViterbiDecoder(trans)
+    scores, paths = dec(em, lengths=jnp.asarray([6, 3]))
+    assert paths.shape == (2, S)
+    # positions past the length are zeroed
+    assert np.asarray(paths[1, 3:]).tolist() == [0, 0, 0]
+    # shorter sequence == decoding its truncation
+    s2, p2 = dec(em[1:2, :3])
+    np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(paths[1, :3]))
+    assert abs(float(s2[0]) - float(scores[1])) < 1e-4

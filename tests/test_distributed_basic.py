@@ -197,9 +197,10 @@ def test_passes_registry_and_transforms():
     ], ctx)
     assert isinstance(out.optimizer, GradientMergeOptimizer)
     assert out.schedule == "VPP" and out.virtual_pp == 2
-    # stage-3: first free dim of every spec now carries the sharding axis
+    # stage-3: first explicit free dim carries the sharding axis; the
+    # empty spec stays replicated (rank unknown without example params)
     assert out.param_specs["w"] == P("sharding", "mp")
-    assert out.param_specs["b"] == P("sharding")
+    assert out.param_specs["b"] == P()
     assert len(ctx.passes) == 4
     # original spec untouched (passes are functional)
     assert spec.schedule == "1F1B" and spec.param_specs["b"] == P()
@@ -269,4 +270,33 @@ def test_sharding_pass_idempotent():
     once = apply_passes(spec, [("auto_parallel_sharding", {"stage": 3})])
     twice = apply_passes(once, [("auto_parallel_sharding", {"stage": 3})])
     assert twice.param_specs["w"] == P("sharding", "mp")
-    assert twice.param_specs["b"] == P("sharding")
+    assert twice.param_specs["b"] == P()  # rank-unknown: left replicated
+
+
+def test_sharding_pass_shape_aware_and_grad_merge_reconfigure():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.passes import TrainSpec, apply_passes
+    from paddle_tpu.optimizer import GradientMergeOptimizer
+
+    mesh = dist.build_mesh({"sharding": 8})
+    example = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((6,))}
+    spec = TrainSpec(loss_fn=lambda p, t, l: 0.0,
+                     optimizer=paddle.optimizer.SGD(0.1),
+                     param_specs={"w": P(None, None), "b": P(None)},
+                     mesh=mesh)
+    out = apply_passes(spec, [("auto_parallel_sharding",
+                               {"stage": 3, "example_params": example})])
+    assert out.param_specs["w"] == P("sharding", None)  # 16 % 8 == 0
+    assert out.param_specs["b"] == P(None)              # 6 % 8 != 0: skipped
+
+    # gradient-merge re-application reconfigures k instead of nesting
+    gm1 = apply_passes(spec, [("auto_parallel_gradient_merge",
+                               {"k_steps": 2})])
+    gm2 = apply_passes(gm1, [("auto_parallel_gradient_merge",
+                              {"k_steps": 8})])
+    assert isinstance(gm2.optimizer, GradientMergeOptimizer)
+    assert gm2.optimizer.k_steps == 8
+    assert not isinstance(gm2.optimizer._inner, GradientMergeOptimizer)
