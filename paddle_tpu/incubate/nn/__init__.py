@@ -1,1 +1,4 @@
 from . import functional  # noqa: F401
+from .layer import FusedMultiTransformer  # noqa: F401
+
+__all__ = ["functional", "FusedMultiTransformer"]

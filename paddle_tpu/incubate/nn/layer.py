@@ -1,0 +1,155 @@
+"""Fused transformer layer classes (reference: python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiTransformer over
+fused_multi_transformer_kernel.cu: the whole decoder stack, prefill and
+cached decode, in one call).
+
+TPU design: stacked [L, ...] parameters + lax.scan over layers; ONE block
+implementation serves all three modes (no-cache forward, prefill-into-
+cache, single-token decode) so the paths cannot drift. Prefill rides the
+registry flash attention; decode rides masked_multihead_attention over
+the same KVCache the generation engine uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["FusedMultiTransformer"]
+
+
+class FusedMultiTransformer(Layer):
+    """Pre-LN GPT-style decoder stack with fused-style stacked weights.
+
+    forward(src) -> [B, S, H]                                 (no cache)
+    forward(src, caches, time_step=0) -> (out, caches)        (prefill)
+    forward(src[B,1,H], caches, time_step=t) -> (out, caches) (decode)
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, dim_feedforward: int,
+                 dropout_rate: float = 0.0, activation: str = "gelu",
+                 normalize_before: bool = True, num_layers: int = 1,
+                 epsilon: float = 1e-5, name=None):
+        super().__init__()
+        del name
+        assert normalize_before, "reference kernel is pre-LN only"
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dim_feedforward = dim_feedforward
+        self.num_layers = num_layers
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+
+        H, FF, L = embed_dim, dim_feedforward, num_layers
+        from ...nn.initializer import Constant, Normal
+        mk = lambda shape, init=None: self.create_parameter(
+            shape, default_initializer=init or Normal(std=0.02))
+        ones, zeros = Constant(1.0), Constant(0.0)
+        self.ln1_g = mk((L, H), ones)
+        self.ln1_b = mk((L, H), zeros)
+        self.qkv_w = mk((L, H, 3 * H))
+        self.qkv_b = mk((L, 3 * H), zeros)
+        self.proj_w = mk((L, H, H))
+        self.proj_b = mk((L, H), zeros)
+        self.ln2_g = mk((L, H), ones)
+        self.ln2_b = mk((L, H), zeros)
+        self.fc1_w = mk((L, H, FF))
+        self.fc1_b = mk((L, FF), zeros)
+        self.fc2_w = mk((L, FF, H))
+        self.fc2_b = mk((L, H), zeros)
+
+    # -- helpers -------------------------------------------------------------
+    def _ln(self, x, g, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+        return ((xf - mu) * lax.rsqrt(var + self.epsilon)
+                ).astype(x.dtype) * g + b
+
+    def _drop(self, x):
+        from ...nn import functional as F
+        return F.dropout(x, self.dropout_rate, training=self.training)
+
+    def _block(self, p, x, ck, cv, pos, attn_fn):
+        """One pre-LN block. ck/cv of None means no cache (plain forward);
+        otherwise this block's K/V slab is written at `pos` before
+        attn_fn(q, k, v, ck, cv) runs — shared by every mode."""
+        from ...nn import functional as F
+        B, S, H = x.shape
+        h = self._ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = (h @ p["qkv_w"] + p["qkv_b"]).reshape(
+            B, S, self.num_heads, 3, self.head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if ck is not None:
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, pos, 0, 0))
+        attn = attn_fn(q, k, v, ck, cv)
+        x = x + self._drop(attn.reshape(B, S, H) @ p["proj_w"]
+                           + p["proj_b"])
+        h = self._ln(x, p["ln2_g"], p["ln2_b"])
+        m = getattr(F, self.activation)(h @ p["fc1_w"] + p["fc1_b"])
+        return x + self._drop(m @ p["fc2_w"] + p["fc2_b"]), ck, cv
+
+    def _stacked(self):
+        names = ["ln1_g", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                 "ln2_g", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b"]
+        return {n: getattr(self, n).value for n in names}
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, src, caches=None, time_step: Optional[int] = None,
+                attn_mask=None):
+        from ...nn import functional as F
+        params = self._stacked()
+        S = src.shape[1]
+
+        if caches is None or S > 1:
+            # full-sequence attention (causal [+ optional additive/bool
+            # padding mask]); with a cache this is PREFILL at offset
+            # time_step (reference usage: first call fills the cache)
+            def attn(q, k, v, ck, cv):
+                return F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, is_causal=True,
+                    dropout_p=self.dropout_rate, training=self.training)
+        else:
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "decode mode masks via cache positions (seq_len), not "
+                    "attn_mask — pass lengths through the cache instead")
+            assert time_step is not None, "decode needs time_step"
+            from ...models.generation import masked_multihead_attention
+
+            def attn(q, k, v, ck, cv):
+                return masked_multihead_attention(q, ck, cv, time_step + 1)
+
+        pos = 0 if time_step is None else time_step
+
+        if caches is None:
+            def body(x, p):
+                x, _, _ = self._block(p, x, None, None, pos, attn)
+                return x, None
+            out, _ = lax.scan(body, src, params)
+            return out
+
+        from ...models.generation import KVCache
+
+        def body(x, layer):
+            p, ck, cv = layer
+            x, ck, cv = self._block(p, x, ck, cv, pos, attn)
+            return x, (ck, cv)
+
+        out, (ks, vs) = lax.scan(body, src, (params, caches.k, caches.v))
+        return out, KVCache(ks, vs)
+
+    def gen_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        from ...models.generation import KVCache
+        return KVCache.zeros(self.num_layers, batch, max_len,
+                             self.num_heads, self.head_dim, dtype)
