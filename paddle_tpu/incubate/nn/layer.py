@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -112,9 +113,15 @@ class FusedMultiTransformer(Layer):
         S = src.shape[1]
 
         if caches is None or S > 1:
+            if caches is not None and time_step not in (None, 0):
+                # chunked prefill would need cross-chunk attention over the
+                # cached prefix; silently attending within the chunk only
+                # would be WRONG — prefill from 0, then decode per token
+                raise NotImplementedError(
+                    "multi-token prefill must start at time_step=0 (the "
+                    "chunk cannot attend to earlier cached tokens)")
             # full-sequence attention (causal [+ optional additive/bool
-            # padding mask]); with a cache this is PREFILL at offset
-            # time_step (reference usage: first call fills the cache)
+            # padding mask]); with a cache this is PREFILL filling [0, S)
             def attn(q, k, v, ck, cv):
                 return F.scaled_dot_product_attention(
                     q, k, v, attn_mask=attn_mask, is_causal=True,
@@ -132,21 +139,32 @@ class FusedMultiTransformer(Layer):
 
         pos = 0 if time_step is None else time_step
 
+        # independent dropout mask per layer: a key drawn inside the scan
+        # body would be a trace-time constant shared by EVERY layer
+        from ...random import next_key, rng_guard
+        use_drop = self.training and self.dropout_rate > 0.0
+        keys = (jax.random.split(next_key(), self.num_layers) if use_drop
+                else jnp.zeros((self.num_layers, 2), jnp.uint32))
+
         if caches is None:
-            def body(x, p):
-                x, _, _ = self._block(p, x, None, None, pos, attn)
+            def body(x, pk):
+                p, key = pk
+                with rng_guard(key):
+                    x, _, _ = self._block(p, x, None, None, pos, attn)
                 return x, None
-            out, _ = lax.scan(body, src, params)
+            out, _ = lax.scan(body, src, (params, keys))
             return out
 
         from ...models.generation import KVCache
 
         def body(x, layer):
-            p, ck, cv = layer
-            x, ck, cv = self._block(p, x, ck, cv, pos, attn)
+            p, ck, cv, key = layer
+            with rng_guard(key):
+                x, ck, cv = self._block(p, x, ck, cv, pos, attn)
             return x, (ck, cv)
 
-        out, (ks, vs) = lax.scan(body, src, (params, caches.k, caches.v))
+        out, (ks, vs) = lax.scan(body, src,
+                                 (params, caches.k, caches.v, keys))
         return out, KVCache(ks, vs)
 
     def gen_cache(self, batch: int, max_len: int, dtype=jnp.float32):

@@ -116,3 +116,62 @@ def test_swiglu_op():
     run_op_test(swiglu, np_swiglu, [_randn(3, 6, seed=16),
                                     _randn(3, 6, seed=17)],
                 grad_argnums=(0, 1))
+
+
+def test_conv2d_op_golden():
+    """Conv2D vs scipy correlate (NCHW, stride 1, valid padding)."""
+    from scipy import signal
+    x = _randn(1, 2, 6, 6, seed=20)
+    w = _randn(3, 2, 3, 3, seed=21)
+
+    def np_conv(x, w):
+        B, Cin, Hh, Ww = x.shape
+        Cout = w.shape[0]
+        out = np.zeros((B, Cout, Hh - 2, Ww - 2), np.float32)
+        for b in range(B):
+            for co in range(Cout):
+                for ci in range(Cin):
+                    out[b, co] += signal.correlate2d(x[b, ci], w[co, ci],
+                                                     mode="valid")
+        return out
+
+    check_forward(lambda x, w: F.conv2d(x, w, stride=1, padding=0),
+                  np_conv, [x, w], rtol=1e-4, atol=1e-5)
+    check_grad(lambda x, w: F.conv2d(x, w, stride=1, padding=0), [x, w],
+               argnums=0)
+    check_grad(lambda x, w: F.conv2d(x, w, stride=1, padding=0), [x, w],
+               argnums=1)
+
+
+def test_max_avg_pool_op_golden():
+    x = _randn(1, 1, 4, 4, seed=22)
+
+    def np_maxpool(x):
+        return x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+
+    def np_avgpool(x):
+        return x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+
+    check_forward(lambda x: F.max_pool2d(x, kernel_size=2, stride=2),
+                  np_maxpool, [x])
+    check_forward(lambda x: F.avg_pool2d(x, kernel_size=2, stride=2),
+                  np_avgpool, [x])
+    check_grad(lambda x: F.avg_pool2d(x, kernel_size=2, stride=2), [x])
+
+
+def test_batch_norm_op_golden():
+    x = _randn(4, 3, 5, seed=23)  # N, C, L
+    g = _randn(3, seed=24, scale=0.1) + 1.0
+    b = _randn(3, seed=25, scale=0.1)
+
+    def np_bn(x, g, b):
+        mu = x.mean(axis=(0, 2), keepdims=True)
+        var = x.var(axis=(0, 2), keepdims=True)
+        xn = (x - mu) / np.sqrt(var + 1e-5)
+        return xn * g[None, :, None] + b[None, :, None]
+
+    # training=True always returns (out, new_mean, new_var)
+    check_forward(
+        lambda x, g, b: F.batch_norm(x, jnp.zeros(3), jnp.ones(3), g, b,
+                                     training=True, epsilon=1e-5)[0],
+        np_bn, [x, g, b], rtol=1e-4, atol=1e-5)
