@@ -33,7 +33,12 @@ from . import kernels  # noqa: F401  (registers Pallas fast paths)
 from . import incubate  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
+from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
+from . import decomposition  # noqa: F401
+from . import geometric  # noqa: F401
+from . import onnx  # noqa: F401
+from . import signal  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
@@ -53,14 +58,6 @@ def grad(func, argnums=0, has_aux=False):
     """Functional gradient (the framework's autodiff entrypoint)."""
     import jax
     return jax.grad(func, argnums=argnums, has_aux=has_aux)
-
-
-def jit(func=None, **kwargs):
-    """Alias of jax.jit; the framework's program-capture mechanism."""
-    import jax
-    if func is None:
-        return lambda f: jax.jit(f, **kwargs)
-    return jax.jit(func, **kwargs)
 
 
 def no_grad(func=None):

@@ -1,0 +1,10 @@
+"""paddle.audio equivalent (reference: python/paddle/audio/ —
+functional windows/mel/dct utilities + feature layers; the reference's
+``backends``/``datasets`` depend on soundfile/librosa-style IO which is out
+of scope for the compute framework — waveforms enter as arrays)."""
+
+from . import features, functional  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

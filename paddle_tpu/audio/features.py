@@ -1,0 +1,114 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram:45, MelSpectrogram:130, LogMelSpectrogram:237, MFCC:344).
+
+TPU pipeline per layer: STFT (XLA fft) → |·|^p → mel filterbank matmul
+(MXU) → log / DCT matmul. Filterbank and DCT matrices are constants folded
+into the compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from .. import signal as _signal
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """(layers.py:45) |STFT|^power, [N, n_fft//2+1, num_frames]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 1.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        assert power > 0, "Power of spectrogram must be > 0."
+        self.power = power
+        win_length = win_length or n_fft
+        fft_window = get_window(window, win_length, fftbins=True, dtype=dtype)
+        self.register_buffer("fft_window", fft_window)
+        self._stft = partial(_signal.stft, n_fft=n_fft, hop_length=hop_length,
+                             win_length=win_length, window=fft_window,
+                             center=center, pad_mode=pad_mode)
+
+    def forward(self, x):
+        return jnp.abs(self._stft(x)) ** self.power
+
+
+class MelSpectrogram(Layer):
+    """(layers.py:130) Spectrogram → mel filterbank, [N, n_mels, frames]."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 128,
+                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode, dtype)
+        fbank = compute_fbank_matrix(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                     f_min=f_min, f_max=f_max, htk=htk,
+                                     norm=norm, dtype=dtype)
+        self.register_buffer("fbank_matrix", fbank)  # [n_mels, nfreq]
+
+    def forward(self, x):
+        spect = self._spectrogram(x)                 # [..., nfreq, F]
+        return jnp.matmul(self.fbank_matrix, spect)  # [..., n_mels, F]
+
+
+class LogMelSpectrogram(Layer):
+    """(layers.py:237) power_to_db(MelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 128,
+                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return power_to_db(self._melspectrogram(x), ref_value=self.ref_value,
+                           amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """(layers.py:344) DCT of log-mel, [N, n_mfcc, num_frames]."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 128,
+                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix", create_dct(n_mfcc, n_mels,
+                                                      dtype=dtype))
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)            # [..., n_mels, F]
+        return jnp.einsum("mk,...mf->...kf", self.dct_matrix, mel)

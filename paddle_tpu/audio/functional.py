@@ -1,0 +1,313 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py — hz_to_mel:29, mel_to_hz:83, mel_frequencies:126,
+fft_frequencies:166, compute_fbank_matrix:189, power_to_db:262,
+create_dct:306; window.py — get_window:341 with a window registry).
+
+TPU design: every matrix here (mel filterbank, DCT basis, windows) is a
+host-computed constant baked into the compiled program; the per-frame work
+(STFT → filterbank matmul → log) is XLA fft + one MXU matmul.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """(functional.py:29) Slaney by default, HTK optional."""
+    if htk:
+        if isinstance(freq, (int, float)):
+            return 2595.0 * math.log10(1.0 + freq / 700.0)
+        return 2595.0 * jnp.log10(1.0 + jnp.asarray(freq) / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if isinstance(freq, (int, float)):
+        if freq >= min_log_hz:
+            return min_log_mel + math.log(freq / min_log_hz) / logstep
+        return (freq - f_min) / f_sp
+    freq = jnp.asarray(freq)
+    linear = (freq - f_min) / f_sp
+    log_t = min_log_mel + jnp.log(jnp.maximum(freq, 1e-10) / min_log_hz) / logstep
+    return jnp.where(freq >= min_log_hz, log_t, linear)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """(functional.py:83)"""
+    if htk:
+        if isinstance(mel, (int, float)):
+            return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+        return 700.0 * (10.0 ** (jnp.asarray(mel) / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if isinstance(mel, (int, float)):
+        if mel >= min_log_mel:
+            return min_log_hz * math.exp(logstep * (mel - min_log_mel))
+        return f_min + f_sp * mel
+    mel = jnp.asarray(mel)
+    linear = f_min + f_sp * mel
+    log_t = min_log_hz * jnp.exp(logstep * (mel - min_log_mel))
+    return jnp.where(mel >= min_log_mel, log_t, linear)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """(functional.py:126) n_mels points evenly spaced on the mel scale."""
+    mels = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk).astype(dtype)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """(functional.py:166)"""
+    return jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """(functional.py:189) Triangular mel filterbank, [n_mels, 1+n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft, dtype="float64")
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype="float64")
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]          # [n_mels+2, nfreq]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.sum(jnp.abs(weights) ** norm, axis=-1, keepdims=True)
+            ** (1.0 / norm), 1e-10)
+    return weights.astype(dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """(functional.py:262) 10*log10(x/ref), numerically stable, optional
+    dynamic-range clip at top_db below peak."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    spect = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """(functional.py:306) DCT-II basis, [n_mels, n_mfcc]."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)      # [n_mfcc, n_mels]
+    if norm is None:
+        dct *= 2.0
+    else:
+        assert norm == "ortho"
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    return jnp.asarray(dct.T, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# windows (reference: window.py — registry of 12 window types, get_window:341)
+# --------------------------------------------------------------------------
+def _extend(M: int, sym: bool):
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, needed: bool):
+    return w[:-1] if needed else w
+
+
+def _general_cosine(M: int, a, sym: bool):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = np.linspace(-math.pi, math.pi, M)
+    w = np.zeros(M)
+    for k, ak in enumerate(a):
+        w += ak * np.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _general_hamming(M: int, alpha: float, sym: bool):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+_WINDOWS = {}
+
+
+def _register(name):
+    def deco(fn):
+        _WINDOWS[name] = fn
+        return fn
+    return deco
+
+
+@_register("hamming")
+def _hamming(M, sym=True):
+    return _general_hamming(M, 0.54, sym)
+
+
+@_register("hann")
+def _hann(M, sym=True):
+    return _general_hamming(M, 0.5, sym)
+
+
+@_register("blackman")
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+@_register("bohman")
+def _bohman(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = np.abs(np.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * np.cos(math.pi * fac) + 1.0 / math.pi * np.sin(math.pi * fac)
+    return _truncate(np.concatenate([[0.0], w, [0.0]]), trunc)
+
+
+@_register("cosine")
+def _cosine(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    return _truncate(np.sin(math.pi / M * (np.arange(M) + 0.5)), trunc)
+
+
+@_register("triang")
+def _triang(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = np.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+@_register("gaussian")
+def _gaussian(M, std, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = np.arange(M) - (M - 1.0) / 2.0
+    return _truncate(np.exp(-(n ** 2) / (2 * std * std)), trunc)
+
+
+@_register("exponential")
+def _exponential(M, center=None, tau=1.0, sym=True):
+    if sym and center is not None:
+        raise ValueError("If sym==True, center must be None.")
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    return _truncate(np.exp(-np.abs(np.arange(M) - center) / tau), trunc)
+
+
+@_register("tukey")
+def _tukey(M, alpha=0.5, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    if alpha <= 0:
+        return np.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym)
+    M, trunc = _extend(M, sym)
+    n = np.arange(M)
+    width = int(alpha * (M - 1) / 2.0)
+    n1, n2, n3 = n[: width + 1], n[width + 1: M - width - 1], n[M - width - 1:]
+    w1 = 0.5 * (1 + np.cos(math.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w3 = 0.5 * (1 + np.cos(math.pi * (-2.0 / alpha + 1 + 2.0 * n3 / alpha / (M - 1))))
+    return _truncate(np.concatenate([w1, np.ones(n2.shape), w3]), trunc)
+
+
+@_register("taylor")
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = math.acosh(B) / math.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.zeros(nbar - 1)
+    signs = np.empty_like(ma); signs[::2] = 1; signs[1::2] = -1
+    m2 = ma * ma
+    for mi, _ in enumerate(ma):
+        numer = signs[mi] * np.prod(1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(1 - m2[mi] / m2[mi + 1:])
+        Fm[mi] = numer / denom
+
+    def W(n):
+        return 1 + 2 * np.dot(
+            Fm, np.cos(2 * math.pi * ma[:, None] * (n - M / 2.0 + 0.5) / M))
+
+    w = W(np.arange(M))
+    if norm:
+        w = w / W((M - 1) / 2)
+    return _truncate(w, trunc)
+
+
+@_register("general_gaussian")
+def _general_gaussian(M, p, sig, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = np.arange(M) - (M - 1.0) / 2.0
+    return _truncate(np.exp(-0.5 * np.abs(n / sig) ** (2 * p)), trunc)
+
+
+@_register("general_cosine")
+def _general_cosine_pub(M, a, sym=True):
+    return _general_cosine(M, a, sym)
+
+
+@_register("general_hamming")
+def _general_hamming_pub(M, alpha, sym=True):
+    return _general_hamming(M, alpha, sym)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64"):
+    """(window.py:341) window by name or (name, *params) tuple."""
+    sym = not fftbins
+    if isinstance(window, (str,)):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        name, args = window[0], window[1:]
+    else:
+        raise ValueError(f"cannot parse window spec {window!r}")
+    if name not in _WINDOWS:
+        raise ValueError(f"unknown window type {name!r}; "
+                         f"known: {sorted(_WINDOWS)}")
+    return jnp.asarray(_WINDOWS[name](win_length, *args, sym=sym), dtype=dtype)

@@ -1,0 +1,215 @@
+"""Composite-op decomposition registry.
+
+TPU-native equivalent of the reference's prim/primitive subsystem
+(reference: python/paddle/decomposition/{register,rules,decomp}.py —
+registry of rules decomposing big ops into primitive ops;
+paddle/fluid/primitive/composite/ C++ composite rules;
+paddle/fluid/primitive/rule/vjp/ VJP rules).
+
+On TPU most of this is free: jax traces every composite op down to lax
+primitives, and higher-order AD (the reference's motivation for
+decomposition) works through any jnp composition. What remains useful —
+and what this module provides — is:
+
+* an explicit registry of decomposition rules in *pure lax/jnp primitive
+  form* (no fused/opaque ops), so compiler passes and numerics audits can
+  substitute a transparent form for any composite op;
+* ``decompose(...)``: a context manager that swaps registered ops'
+  implementations for their primitive rules inside the block (whitelist /
+  blacklist semantics mirroring the reference's ``decompose(program,
+  whitelist, blacklist)``);
+* ``call_decomp(name, *args)`` for direct rule invocation in tests.
+
+VJP rules come for free via jax.grad over the rule body, mirroring how the
+reference derives higher-order AD from composite rules.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.registry import _OPS
+
+__all__ = ["register_decomp", "has_decomp", "get_decomp_rule", "call_decomp",
+           "list_decomps", "decompose"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_decomp(name: str):
+    """(reference: decomposition/register.py register_decomp) Register a
+    primitive-form rule for composite op ``name``."""
+
+    def deco(fn: Callable):
+        _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def has_decomp(name: str) -> bool:
+    return name in _RULES
+
+
+def get_decomp_rule(name: str) -> Callable:
+    return _RULES[name]
+
+
+def call_decomp(name: str, *args, **kwargs):
+    return _RULES[name](*args, **kwargs)
+
+
+def list_decomps() -> List[str]:
+    return sorted(_RULES)
+
+
+def _functional_modules():
+    from ..nn import functional as F
+    from ..nn.functional import activation, norm
+    return (F, activation, norm)
+
+
+@contextlib.contextmanager
+def decompose(whitelist: Optional[Iterable[str]] = None,
+              blacklist: Optional[Iterable[str]] = None):
+    """Substitute primitive-form rules for composite ops inside the block
+    (reference: decomposition/decomp.py decompose — rewrites a program;
+    here the rewrite happens at trace time by rerouting the two dispatch
+    surfaces: the op registry — with Pallas fast paths suppressed — and the
+    ``nn.functional`` module attributes, so both registry-dispatched ops and
+    plain functional calls hit the primitive rule).
+
+    Callers holding a direct ``from ... import softmax`` reference bound
+    before the block keep the original implementation — reroute applies to
+    module-attribute lookups (``F.softmax(...)``), the idiom every layer in
+    this framework uses.
+    """
+    black = set(blacklist or ())
+    requested = list(_RULES if whitelist is None else whitelist)
+    names = [n for n in requested if n in _RULES and n not in black]
+    missing = [n for n in requested if n not in _RULES]
+    if missing:
+        raise KeyError(f"no decomposition rule registered for {missing}; "
+                       f"known rules: {list_decomps()}")
+    saved_ops = {}
+    saved_pallas = {}
+    saved_attrs = []  # (module, attr, original)
+    mods = _functional_modules()
+    try:
+        for n in names:
+            rule = _RULES[n]
+            if n in _OPS:
+                saved_ops[n] = _OPS[n].fn
+                saved_pallas[n] = _OPS[n].pallas_impl
+                _OPS[n].fn = rule
+                _OPS[n].pallas_impl = None  # rule must win over fast paths
+            for mod in mods:
+                orig = getattr(mod, n, None)
+                if callable(orig) and orig is not rule:
+                    saved_attrs.append((mod, n, orig))
+                    setattr(mod, n, rule)
+        yield
+    finally:
+        for n, fn in saved_ops.items():
+            _OPS[n].fn = fn
+            _OPS[n].pallas_impl = saved_pallas[n]
+        for mod, attr, orig in saved_attrs:
+            setattr(mod, attr, orig)
+
+
+# ---------------------------------------------------------------------------
+# rules (reference: python/paddle/decomposition/rules.py; each body uses only
+# lax / elementwise jnp primitives so the decomposed program is transparent
+# to passes and supports arbitrary-order AD)
+# ---------------------------------------------------------------------------
+@register_decomp("softmax")
+def _softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    m = lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    m = lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    s = x - m
+    return s - jnp.log(jnp.sum(jnp.exp(s), axis=axis, keepdims=True))
+
+
+@register_decomp("sigmoid")
+def _sigmoid(x):
+    return 0.5 * (jnp.tanh(0.5 * x) + 1.0)
+
+
+@register_decomp("silu")
+def _silu(x):
+    return x * _sigmoid(x)
+
+
+@register_decomp("gelu")
+def _gelu(x, approximate=False):
+    if approximate:
+        c = jnp.sqrt(2.0 / jnp.pi).astype(x.dtype)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+    return 0.5 * x * (1.0 + lax.erf(x / jnp.sqrt(2.0).astype(x.dtype)))
+
+
+@register_decomp("layer_norm")
+def _layer_norm(x, normalized_shape=None, weight=None, bias=None,
+                epsilon=1e-5, name=None):
+    del normalized_shape, name  # rule normalizes the trailing axis
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@register_decomp("rms_norm")
+def _rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) \
+        if begin_norm_axis not in (-1, x.ndim - 1) else (-1,)
+    ms = jnp.mean(x * x, axis=axes, keepdims=True)
+    y = x * lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@register_decomp("mean")
+def _mean(x, axis=None, keepdim=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdim) / (
+        x.size if axis is None else x.shape[axis])
+
+
+@register_decomp("squared_l2_norm")
+def _squared_l2_norm(x):
+    return jnp.sum(x * x)
+
+
+@register_decomp("softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x,
+                     jnp.logaddexp(beta * x, 0.0) / beta)
+
+
+@register_decomp("swiglu")
+def _swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return _silu(x) * y

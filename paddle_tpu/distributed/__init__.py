@@ -17,6 +17,8 @@ from .collective import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                          barrier, batch_isend_irecv, broadcast, get_group,
                          new_group, ppermute, recv, reduce, reduce_scatter,
                          scatter, send)
+from . import check  # noqa: F401
+from .check import CommCheckError, nan_guard
 from . import checkpoint  # noqa: F401
 from .store import MasterStore, TCPStore
 from . import passes  # noqa: F401
@@ -56,4 +58,5 @@ __all__ = [
     # checkpoint
     "checkpoint", "save_state_dict", "load_state_dict",
     "TCPStore", "MasterStore", "rpc", "passes", "CommWatchdog", "get_watchdog",
+    "check", "CommCheckError", "nan_guard",
 ]

@@ -1,0 +1,150 @@
+"""Collective correctness checks.
+
+TPU-native equivalent of the reference's comm guards
+(reference: paddle/phi/core/distributed/check/static_check.cc —
+``CommStaticCheck::SameShape/ScatterLikeShape/GatherLikeShape/CheckDataType``;
+paddle/phi/core/distributed/check/nccl_dynamic_check.cc —
+``NCCLDynamicCheck::CheckDataType/CheckShape`` + NaN scan of comm buffers).
+
+Design. The reference validates buffers right before launching NCCL:
+*static* checks compare shapes/dtypes/ranks host-side, *dynamic* checks
+broadcast rank-0's dtype/shape through the communicator and scan device
+buffers for NaN. On TPU the communicator is the XLA program, so:
+
+- **Static checks run at trace time.** Every rank traces the same program,
+  so a shape/dtype mismatch *within* one program is structurally impossible;
+  what can still go wrong is the eager tier (rank-major arrays whose dim 0
+  must equal the group size) and cross-host disagreement about the group.
+  ``check_*`` functions validate those before dispatch.
+- **Dynamic NaN checks are compiled into the program.** ``nan_guard``
+  wraps a value in an ``error_if`` (jax.experimental.checkify-free debug
+  assert via ``jnp.isnan`` + ``lax.cond`` → ``jax.debug.print``) or, for
+  eager arrays, a host-side scan — mirroring
+  ``FLAGS_enable_nccl_dynamic_check``'s NaN scan of comm buffers.
+
+Enable via ``FLAGS_enable_comm_static_check`` / ``FLAGS_enable_comm_dynamic_check``
+(reference flag: FLAGS_enable_nccl_dynamic_check, paddle/common/flags.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..flags import define_flag, flag
+
+__all__ = [
+    "check_same_shape", "check_scatter_like_shape", "check_gather_like_shape",
+    "check_dtype", "check_rank", "nan_guard", "CommCheckError",
+]
+
+define_flag("enable_comm_static_check", False,
+            "validate shapes/dtypes/group layout before eager collectives")
+define_flag("enable_comm_dynamic_check", False,
+            "scan collective inputs for NaN/Inf (compiled into the program)")
+
+
+class CommCheckError(ValueError):
+    """Raised when a pre-collective static check fails."""
+
+
+def _shape_dtype(x):
+    return tuple(getattr(x, "shape", ())), jnp.result_type(x)
+
+
+def check_rank(rank: int, nranks: int) -> None:
+    """(static_check.cc CheckRank) rank must be a valid group index."""
+    if not 0 <= rank < nranks:
+        raise CommCheckError(
+            f"rank {rank} out of range for group of size {nranks}")
+
+
+def check_same_shape(tensor, nranks: int, op_name: str = "collective") -> None:
+    """(static_check.cc SameShape) eager rank-major input: dim 0 must equal
+    the group size and every per-rank slice is implicitly identical."""
+    shape, _ = _shape_dtype(tensor)
+    if not shape or shape[0] != nranks:
+        raise CommCheckError(
+            f"{op_name}: eager input must be rank-major with dim0 == group "
+            f"size {nranks}, got shape {shape}")
+
+
+def check_scatter_like_shape(tensor, nranks: int, scatter_dim: int = 0,
+                             op_name: str = "reduce_scatter") -> None:
+    """(static_check.cc ScatterLikeShape) the scattered dim must divide
+    evenly by the group size."""
+    shape, _ = _shape_dtype(tensor)
+    data_shape = shape[1:] if len(shape) > 1 else shape
+    if not data_shape or data_shape[scatter_dim] % nranks != 0:
+        raise CommCheckError(
+            f"{op_name}: dim {scatter_dim} of per-rank shape {data_shape} "
+            f"must be divisible by group size {nranks}")
+
+
+def check_gather_like_shape(out_numel: int, in_numel: int, nranks: int,
+                            op_name: str = "all_gather") -> None:
+    """(static_check.cc GatherLikeShape) out numel == in numel * nranks."""
+    if out_numel != in_numel * nranks:
+        raise CommCheckError(
+            f"{op_name}: output numel {out_numel} != input numel {in_numel} "
+            f"* group size {nranks}")
+
+
+def check_dtype(*tensors, op_name: str = "collective") -> None:
+    """(nccl_dynamic_check.cc CheckDataType) all participants agree on dtype.
+    Within one traced program agreement is structural; for eager inputs we
+    verify the caller didn't mix dtypes across a rank-major batch."""
+    dtypes = {str(getattr(t, "dtype", None) or jnp.result_type(t))
+              for t in tensors if t is not None}
+    if len(dtypes) > 1:
+        raise CommCheckError(f"{op_name}: mixed dtypes across participants: "
+                             f"{sorted(dtypes)}")
+
+
+def _host_nan_scan(x, op_name: str) -> None:
+    arr = np.asarray(x)
+    if arr.dtype.kind in "fc":
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            raise FloatingPointError(
+                f"{op_name}: {int(bad.sum())}/{arr.size} non-finite values "
+                f"in collective input (comm-buffer NaN check)")
+
+
+def nan_guard(x, op_name: str = "collective"):
+    """Dynamic NaN/Inf scan of a collective input.
+
+    Traced values get a compiled guard (``jax.debug.print`` on any
+    non-finite element — XLA keeps it out of the hot path when clean);
+    concrete arrays get a host-side scan that raises, matching the
+    reference's abort-on-NaN behaviour. Returns ``x`` unchanged so it can
+    be used inline: ``psum(nan_guard(x), axis)``.
+    """
+    if not flag("enable_comm_dynamic_check"):
+        return x
+    if isinstance(x, jax.core.Tracer):
+        if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+            bad = jnp.size(x) - jnp.isfinite(x).sum()
+            jax.lax.cond(
+                bad > 0,
+                lambda: jax.debug.print(
+                    "[comm-check] {op}: {n} non-finite values in input",
+                    op=op_name, n=bad),
+                lambda: None)
+        return x
+    _host_nan_scan(x, op_name)
+    return x
+
+
+def static_check(tensor, nranks: int, op_name: str,
+                 scatter_dim: Optional[int] = None) -> None:
+    """Entry point used by the eager collective tier when
+    ``FLAGS_enable_comm_static_check`` is on."""
+    if not flag("enable_comm_static_check"):
+        return
+    check_same_shape(tensor, nranks, op_name)
+    if scatter_dim is not None:
+        check_scatter_like_shape(tensor, nranks, scatter_dim, op_name)

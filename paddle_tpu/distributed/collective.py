@@ -110,13 +110,17 @@ def _reduce_traced(x, op, axis):
     return _REDUCERS[op](x, axis)
 
 
-def _eager_collective(x, group, per_shard_fn, out_rank_major=True):
+def _eager_collective(x, group, per_shard_fn, out_rank_major=True,
+                      op_name="collective", scatter_dim=None):
     """Run `per_shard_fn(local)` under shard_map over the group axis, with
     rank-major input (dim 0 = group)."""
     x = jnp.asarray(x)
     mesh = group.mesh if group is not None and group.mesh is not None else _world_mesh()
     axis = default_axis(group)
     n = mesh.shape[axis]
+    from .check import nan_guard, static_check
+    static_check(x, n, op_name, scatter_dim=scatter_dim)
+    x = nan_guard(x, op_name)
     assert x.shape[0] == n, (
         f"eager collective expects rank-major input with dim0 == group size "
         f"{n}, got shape {x.shape}")
@@ -138,7 +142,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
     def f(local):
         return _reduce_traced(local, op, default_axis(group))
 
-    return _eager_collective(tensor, group, f)
+    return _eager_collective(tensor, group, f, op_name="all_reduce")
 
 
 def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
@@ -180,7 +184,8 @@ def reduce_scatter(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None
                                scatter_dimension=scatter_dim, tiled=True)
         return out[None]
 
-    return _eager_collective(tensor, group, f)
+    return _eager_collective(tensor, group, f, op_name="reduce_scatter",
+                             scatter_dim=scatter_dim)
 
 
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None,

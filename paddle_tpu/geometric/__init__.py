@@ -1,0 +1,172 @@
+"""paddle.geometric equivalent (reference: python/paddle/geometric/ —
+math.py segment_sum:29/segment_mean:88/segment_min:149/segment_max:209,
+message_passing/send_recv.py send_u_recv:55/send_ue_recv:210/send_uv:413,
+reindex.py reindex_graph:32, sampling/neighbors.py sample_neighbors).
+
+TPU design: message passing = gather + ``jax.ops.segment_*`` (XLA scatter
+with static segment count — pass ``num_segments``/``out_size`` under jit;
+eager calls infer it host-side, matching the reference's dynamic out size).
+Graph re-indexing and neighbor sampling are host-side data-prep (numpy) —
+they produce the static-shape index tables the device program consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "sample_neighbors"]
+
+_SEGMENT_FNS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _num_segments(segment_ids, num_segments: Optional[int]) -> int:
+    if num_segments is not None:
+        return int(num_segments)
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops under jit need a static segment count; pass "
+            "num_segments= (reference infers it from data, which would be a "
+            "dynamic shape on TPU)")
+    return int(np.asarray(segment_ids).max()) + 1 if np.asarray(segment_ids).size else 0
+
+
+def _segment(pool, data, segment_ids, num_segments):
+    n = _num_segments(segment_ids, num_segments)
+    data = jnp.asarray(data)
+    ids = jnp.asarray(segment_ids)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids, n)
+        cnt = cnt.reshape((-1,) + (1,) * (s.ndim - 1))
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0)
+    out = _SEGMENT_FNS[pool](data, ids, n)
+    if pool in ("min", "max"):
+        # empty segments: reference yields 0, jax yields +/-inf identities
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids, n)
+        out = jnp.where((cnt > 0).reshape((-1,) + (1,) * (out.ndim - 1)),
+                        out, 0)
+    return out
+
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None):
+    """(math.py:29)"""
+    return _segment("sum", data, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None):
+    """(math.py:88)"""
+    return _segment("mean", data, segment_ids, num_segments)
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None):
+    """(math.py:149)"""
+    return _segment("min", data, segment_ids, num_segments)
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None):
+    """(math.py:209)"""
+    return _segment("max", data, segment_ids, num_segments)
+
+
+def _apply_edge_op(msg, e, compute_fn: str):
+    if compute_fn == "add":
+        return msg + e
+    if compute_fn == "sub":
+        return msg - e
+    if compute_fn == "mul":
+        return msg * e
+    if compute_fn == "div":
+        return msg / e
+    raise ValueError(f"unsupported message op {compute_fn!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """(send_recv.py:55) gather x[src] → segment-reduce onto dst."""
+    msg = jnp.take(jnp.asarray(x), jnp.asarray(src_index), axis=0)
+    n = out_size if out_size is not None else jnp.asarray(x).shape[0]
+    return _segment(reduce_op, msg, dst_index, n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None):
+    """(send_recv.py:210) (x[src] op edge_feat) → reduce onto dst."""
+    msg = jnp.take(jnp.asarray(x), jnp.asarray(src_index), axis=0)
+    e = jnp.asarray(y)
+    if e.ndim < msg.ndim:  # broadcast edge scalars over feature dims
+        e = e.reshape(e.shape + (1,) * (msg.ndim - e.ndim))
+    msg = _apply_edge_op(msg, e, message_op)
+    n = out_size if out_size is not None else jnp.asarray(x).shape[0]
+    return _segment(reduce_op, msg, dst_index, n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
+    """(send_recv.py:413) per-edge message x[src] op y[dst]."""
+    xs = jnp.take(jnp.asarray(x), jnp.asarray(src_index), axis=0)
+    yd = jnp.take(jnp.asarray(y), jnp.asarray(dst_index), axis=0)
+    return _apply_edge_op(xs, yd, message_op)
+
+
+def reindex_graph(x, neighbors, count):
+    """(reindex.py:32) Compact a sampled subgraph's global node ids into
+    local ids: returns (reindex_src, reindex_dst, out_nodes) with out_nodes
+    = unique(x ++ neighbors) keeping x's ids first. Host-side data prep."""
+    x = np.asarray(x)
+    neighbors = np.asarray(neighbors)
+    count = np.asarray(count)
+    order = {}
+    for v in x.tolist():
+        order.setdefault(v, len(order))
+    for v in neighbors.tolist():
+        order.setdefault(v, len(order))
+    out_nodes = np.fromiter(order.keys(), dtype=x.dtype, count=len(order))
+    reindex_src = np.fromiter((order[v] for v in neighbors.tolist()),
+                              dtype=np.int64, count=neighbors.size)
+    reindex_dst = np.repeat(
+        np.fromiter((order[v] for v in x.tolist()), dtype=np.int64,
+                    count=x.size), count)
+    return (jnp.asarray(reindex_src), jnp.asarray(reindex_dst),
+            jnp.asarray(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     seed: Optional[int] = None):
+    """(sampling/neighbors.py sample_neighbors) uniform neighbor sampling
+    from CSC (row, colptr). Host-side; returns (out_neighbors, out_count[,
+    out_eids])."""
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    nodes = np.asarray(input_nodes)
+    rng = np.random.default_rng(seed)
+    neigh, cnts, out_eids = [], [], []
+    for v in nodes.tolist():
+        lo, hi = int(colptr[v]), int(colptr[v + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        neigh.append(row[sel])
+        cnts.append(len(sel))
+        if return_eids and eids is not None:
+            out_eids.append(np.asarray(eids)[sel])
+    out_n = jnp.asarray(np.concatenate(neigh) if neigh else
+                        np.empty(0, row.dtype))
+    out_c = jnp.asarray(np.asarray(cnts, dtype=np.int64))
+    if return_eids and eids is not None:
+        cat = (np.concatenate(out_eids) if out_eids
+               else np.empty(0, np.asarray(eids).dtype))
+        return out_n, out_c, jnp.asarray(cat)
+    return out_n, out_c

@@ -1,0 +1,50 @@
+"""paddle.onnx equivalent (reference: python/paddle/onnx/export.py —
+a thin wrapper delegating to the external ``paddle2onnx`` converter).
+
+TPU design: the framework's native interchange format is **StableHLO**
+(jax.export) — the deploy artifact every XLA-backed runtime (incl. IREE,
+TF, serving stacks) consumes directly, the role ONNX plays for the
+reference. ``export`` produces that artifact via :func:`paddle_tpu.jit.save`
+and additionally emits a real ``.onnx`` file when an ONNX converter for
+StableHLO/JAX is importable in the environment (none is baked into this
+image, mirroring how the reference hard-depends on the external
+``paddle2onnx`` package)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """(reference: onnx/export.py export) Export ``layer`` for inference.
+
+    Always writes ``<path>.stablehlo`` + ``<path>.pdiparams`` (the native
+    deploy pair, loadable via ``paddle_tpu.jit.load`` or the inference
+    Predictor). Writes ``<path>.onnx`` as well iff a JAX→ONNX converter
+    (``jax2onnx``/``tf2onnx``) is available; otherwise raises only if the
+    caller demanded strict ONNX via ``configs['require_onnx']=True``."""
+    from ..jit import save as jit_save
+
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit_save(layer, prefix, input_spec=input_spec,
+             example_args=configs.pop("example_args", None))
+
+    try:
+        import jax2onnx  # type: ignore  # not in this image; external envs
+    except ImportError:
+        jax2onnx = None
+    if jax2onnx is not None:
+        fn = layer.forward if hasattr(layer, "forward") else layer
+        model = jax2onnx.to_onnx(fn, inputs=input_spec)
+        with open(prefix + ".onnx", "wb") as f:
+            f.write(model.SerializeToString())
+        return prefix + ".onnx"
+    if configs.get("require_onnx"):
+        raise RuntimeError(
+            "no JAX->ONNX converter available in this environment; the "
+            "StableHLO artifact was written to %s.stablehlo (the TPU-native "
+            "interchange format)" % prefix)
+    return prefix + ".stablehlo"

@@ -1,0 +1,132 @@
+"""Short-time Fourier transform API (reference: python/paddle/signal.py —
+``stft`` :272, ``istft`` :449).
+
+TPU design: framing is a gather-free ``reshape``-style strided slice
+(implemented as an indexed take so XLA lowers it to a single gather with a
+static index table), FFTs are XLA's native ``fft`` HLO. Everything is
+jit-able and differentiable; no cuFFT handle management survives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frames_last(x, frame_length: int, hop_length: int):
+    """[..., T] -> [..., num_frames, frame_length] (internal layout)."""
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num_frames)[:, None])  # [F, L] static
+    return jnp.take(x, jnp.asarray(idx), axis=-1)
+
+
+def _overlap_add_last(frames, hop_length: int):
+    """[..., num_frames, frame_length] -> [..., T] scatter-add."""
+    *batch, num_frames, frame_length = frames.shape
+    n = frame_length + hop_length * (num_frames - 1)
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num_frames)[:, None]).reshape(-1)
+    flat = frames.reshape(*batch, num_frames * frame_length)
+    out = jnp.zeros((*batch, n), dtype=frames.dtype)
+    return out.at[..., jnp.asarray(idx)].add(flat)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """(reference: python/paddle/signal.py:42) Slice a signal into
+    overlapping frames. ``axis`` must be -1 (``[..., T]`` input, output
+    ``[..., frame_length, num_frames]``) or 0 (``[T, ...]`` input, output
+    ``[num_frames, frame_length, ...]``) — reference layout exactly."""
+    x = jnp.asarray(x)
+    if axis in (-1, x.ndim - 1):
+        out = _frames_last(x, frame_length, hop_length)       # [..., F, L]
+        return jnp.swapaxes(out, -1, -2)                      # [..., L, F]
+    if axis == 0:
+        xt = jnp.moveaxis(x, 0, -1)                           # [..., T]
+        out = _frames_last(xt, frame_length, hop_length)      # [..., F, L]
+        return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 0)  # [F, L, ...]
+    raise ValueError(f"axis must be 0 or -1, got {axis}")
+
+
+def overlap_add(frames, hop_length: int, axis: int = -1, name=None):
+    """(reference: python/paddle/signal.py overlap_add) Inverse of
+    :func:`frame`; accepts the same axis-dependent layouts."""
+    frames = jnp.asarray(frames)
+    if axis in (-1, frames.ndim - 1):
+        return _overlap_add_last(jnp.swapaxes(frames, -1, -2), hop_length)
+    if axis == 0:
+        f = jnp.moveaxis(jnp.moveaxis(frames, 0, -1), 0, -1)  # [..., F, L]
+        return jnp.moveaxis(_overlap_add_last(f, hop_length), -1, 0)
+    raise ValueError(f"axis must be 0 or -1, got {axis}")
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """(reference: python/paddle/signal.py:272) Returns
+    ``[..., n_fft//2+1 (or n_fft), num_frames]`` complex spectrogram."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones(win_length, dtype=x.real.dtype)
+    window = jnp.asarray(window)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        widths = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, widths, mode=pad_mode)
+    frames = _frames_last(x, n_fft, hop_length)   # [..., F, n_fft]
+    frames = frames * window
+    if jnp.iscomplexobj(x) or not onesided:
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+    else:
+        spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)             # [..., freq, F]
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """(reference: python/paddle/signal.py:449) Window-weighted
+    overlap-add inverse with COLA normalization."""
+    x = jnp.asarray(x)                            # [..., freq, F]
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones(win_length, dtype=jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    spec = jnp.swapaxes(x, -1, -2)                # [..., F, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    y = _overlap_add_last(frames * window, hop_length)
+    wsq = _overlap_add_last(
+        jnp.broadcast_to(window * window, frames.shape), hop_length)
+    y = y / jnp.where(wsq > 1e-11, wsq, 1.0)
+    if center:
+        y = y[..., n_fft // 2: y.shape[-1] - n_fft // 2]
+        wsq = wsq[..., n_fft // 2: wsq.shape[-1] - n_fft // 2]
+    if length is not None:
+        y = y[..., :length]
+    return y

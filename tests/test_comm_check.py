@@ -1,0 +1,70 @@
+"""Collective correctness-check tests (reference pattern:
+paddle/phi/core/distributed/check/static_check.cc,
+nccl_dynamic_check.cc NaN scan)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.check import (
+    CommCheckError, check_dtype, check_gather_like_shape, check_rank,
+    check_same_shape, check_scatter_like_shape, nan_guard)
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    paddle.set_flags({"FLAGS_enable_comm_static_check": False,
+                      "FLAGS_enable_comm_dynamic_check": False})
+
+
+def test_static_checks_pass_and_fail():
+    check_rank(3, 8)
+    with pytest.raises(CommCheckError):
+        check_rank(8, 8)
+    x = np.zeros((8, 4))
+    check_same_shape(x, 8)
+    with pytest.raises(CommCheckError):
+        check_same_shape(x, 4)
+    check_scatter_like_shape(np.zeros((8, 16)), 8)
+    with pytest.raises(CommCheckError):
+        check_scatter_like_shape(np.zeros((8, 15)), 8)
+    check_gather_like_shape(32, 4, 8)
+    with pytest.raises(CommCheckError):
+        check_gather_like_shape(31, 4, 8)
+    check_dtype(np.zeros(2, np.float32), np.ones(2, np.float32))
+    with pytest.raises(CommCheckError):
+        check_dtype(np.zeros(2, np.float32), np.ones(2, np.float64))
+
+
+def test_eager_collective_static_check_flag():
+    paddle.set_flags({"FLAGS_enable_comm_static_check": True})
+    with pytest.raises(CommCheckError):
+        dist.all_reduce(np.ones((3, 4), np.float32))  # dim0 != world size 8
+    out = dist.all_reduce(np.ones((8, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+
+def test_nan_guard_host_scan():
+    paddle.set_flags({"FLAGS_enable_comm_dynamic_check": True})
+    nan_guard(np.ones(4, np.float32))  # clean passes
+    bad = np.array([1.0, np.nan], np.float32)
+    with pytest.raises(FloatingPointError):
+        nan_guard(bad)
+    with pytest.raises(FloatingPointError):
+        dist.all_reduce(np.full((8, 2), np.nan, np.float32))
+
+
+def test_nan_guard_traced_is_transparent():
+    import jax
+    import jax.numpy as jnp
+    paddle.set_flags({"FLAGS_enable_comm_dynamic_check": True})
+
+    @jax.jit
+    def f(x):
+        return nan_guard(x, "test").sum()
+
+    assert np.isfinite(float(f(jnp.ones(4))))
+    # compiled guard must not alter values or crash on NaN (prints instead)
+    assert np.isnan(float(f(jnp.array([1.0, np.nan]))))

@@ -1,0 +1,104 @@
+"""Decomposition-registry tests (reference pattern:
+python/paddle/decomposition/ rules validated against composite ops,
+higher-order AD through composite rules)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import decomposition as D
+from paddle_tpu.nn import functional as F
+
+
+@pytest.mark.parametrize("name,composite,args", [
+    ("softmax", lambda x: F.softmax(x, axis=-1), (np.random.randn(4, 8),)),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), (np.random.randn(4, 8),)),
+    ("sigmoid", F.sigmoid, (np.random.randn(32),)),
+    ("silu", F.silu, (np.random.randn(32),)),
+    ("gelu", lambda x: F.gelu(x), (np.random.randn(32),)),
+    ("softplus", F.softplus, (np.random.randn(32),)),
+    ("squared_l2_norm", lambda x: jnp.sum(x * x), (np.random.randn(16),)),
+])
+def test_rules_match_composites(name, composite, args):
+    args = tuple(jnp.asarray(a, jnp.float32) for a in args)
+    assert D.has_decomp(name)
+    got = D.call_decomp(name, *args)
+    want = composite(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_norm_rules_match():
+    x = jnp.asarray(np.random.randn(4, 16), jnp.float32)
+    w = jnp.asarray(np.random.rand(16) + 0.5, jnp.float32)
+    b = jnp.asarray(np.random.randn(16), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(D.call_decomp("layer_norm", x, 16, w, b)),
+        np.asarray(F.layer_norm(x, normalized_shape=16, weight=w, bias=b)),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(D.call_decomp("rms_norm", x, w)),
+        np.asarray(F.rms_norm(x, w)), rtol=1e-5, atol=1e-5)
+
+
+def test_higher_order_ad_through_rules():
+    # the reference decomposes ops *so that* double grad works; here we
+    # assert grad-of-grad through every scalar-capable rule
+    for name in ("sigmoid", "silu", "gelu", "softplus"):
+        rule = D.get_decomp_rule(name)
+        g2 = jax.grad(jax.grad(lambda t: rule(t).sum()))(jnp.float32(0.7))
+        assert np.isfinite(float(g2))
+
+
+def test_decompose_context_swaps_registry_impl():
+    from paddle_tpu.ops.registry import get_op
+    x = jnp.asarray(np.random.randn(4, 16), jnp.float32)
+    w = jnp.ones(16, jnp.float32)
+    before = get_op("rms_norm").fn
+    before_pallas = get_op("rms_norm").pallas_impl
+    with D.decompose(whitelist=["rms_norm"]):
+        inside = get_op("rms_norm").fn
+        assert get_op("rms_norm").pallas_impl is None  # fast path suppressed
+        out = get_op("rms_norm").dispatch(x, w)
+    assert inside is D.get_decomp_rule("rms_norm")
+    assert get_op("rms_norm").fn is before
+    assert get_op("rms_norm").pallas_impl is before_pallas
+    np.testing.assert_allclose(np.asarray(out), np.asarray(F.rms_norm(x, w)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decompose_reroutes_functional_namespace():
+    # plain functional calls (not registry-dispatched) must hit the rule too
+    x = jnp.asarray(np.random.randn(4, 8), jnp.float32)
+    with D.decompose(whitelist=["softmax"]):
+        assert F.softmax is D.get_decomp_rule("softmax")
+        out = F.softmax(x, axis=-1)
+    assert F.softmax is not D.get_decomp_rule("softmax")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(F.softmax(x, axis=-1)),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(KeyError):
+        with D.decompose(whitelist=["not_an_op"]):
+            pass
+
+
+def test_decompose_rule_signatures_match_public_ops():
+    # positional bias must not be swallowed as epsilon (review regression)
+    x = jnp.asarray(np.random.randn(4, 16), jnp.float32)
+    w = jnp.asarray(np.random.rand(16) + 0.5, jnp.float32)
+    b = jnp.asarray(np.random.randn(16), jnp.float32)
+    want = F.rms_norm(x, w, b)
+    got = D.call_decomp("rms_norm", x, w, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # gelu default must match the public default (exact, not tanh)
+    t = jnp.asarray(np.random.randn(32), jnp.float32)
+    np.testing.assert_allclose(np.asarray(D.call_decomp("gelu", t)),
+                               np.asarray(F.gelu(t)), rtol=1e-5, atol=1e-5)
+    # softplus beta/threshold path
+    np.testing.assert_allclose(
+        np.asarray(D.call_decomp("softplus", t, 2.0, 1.0)),
+        np.asarray(F.softplus(t, beta=2.0, threshold=1.0)),
+        rtol=1e-5, atol=1e-5)

@@ -1,0 +1,200 @@
+"""signal / audio / geometric / onnx domain tests (reference patterns:
+test/legacy_test/test_stft_op.py, test_audio_functions.py golden checks vs
+scipy/librosa formulas, test_segment_ops.py, test_graph_send_recv.py)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, signal
+
+
+# ---------------------------------------------------------------- signal
+def test_frame_overlap_add_roundtrip():
+    x = np.random.randn(2, 64).astype(np.float32)
+    f = signal.frame(x, frame_length=16, hop_length=16)  # non-overlapping
+    assert f.shape == (2, 16, 4)  # [..., frame_length, num_frames] (ref layout)
+    # frame 1 is samples 16:32
+    np.testing.assert_allclose(np.asarray(f)[0, :, 1], x[0, 16:32])
+    y = signal.overlap_add(f, hop_length=16)
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-6)
+
+
+def test_frame_axis0_matches_axis_neg1():
+    x = np.random.randn(64, 2).astype(np.float32)
+    f0 = signal.frame(x, 16, 8, axis=0)            # [F, L, 2]
+    f1 = signal.frame(x.T, 16, 8, axis=-1)          # [2, L, F]
+    assert f0.shape == (7, 16, 2)
+    np.testing.assert_allclose(np.asarray(f0),
+                               np.transpose(np.asarray(f1), (2, 1, 0)))
+    y0 = signal.overlap_add(f0, 8, axis=0)
+    np.testing.assert_allclose(np.asarray(y0),
+                               np.asarray(signal.overlap_add(f1, 8)).T,
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        signal.frame(np.random.randn(4, 64, 2), 16, 8, axis=1)
+
+
+def test_stft_matches_numpy_fft():
+    x = np.random.randn(128).astype(np.float32)
+    n_fft, hop = 32, 8
+    spec = signal.stft(x, n_fft=n_fft, hop_length=hop, center=False)
+    # frame 0 golden: rfft of the first 32 samples (rectangular window)
+    want = np.fft.rfft(x[:n_fft])
+    np.testing.assert_allclose(np.asarray(spec[:, 0]), want, rtol=1e-4,
+                               atol=1e-4)
+    assert spec.shape == (n_fft // 2 + 1, 1 + (128 - n_fft) // hop)
+
+
+def test_stft_istft_roundtrip():
+    x = np.random.randn(1, 256).astype(np.float32)
+    w = np.asarray(audio.functional.get_window("hann", 64, dtype="float32"))
+    spec = signal.stft(x, n_fft=64, hop_length=16, window=w)
+    y = signal.istft(spec, n_fft=64, hop_length=16, window=w,
+                     length=x.shape[-1])
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-4)
+
+
+# ----------------------------------------------------------------- audio
+def test_mel_conversions_roundtrip():
+    for htk in (False, True):
+        hz = np.array([0.0, 440.0, 1000.0, 4000.0, 11025.0])
+        mel = audio.functional.hz_to_mel(hz, htk=htk)
+        back = audio.functional.mel_to_hz(mel, htk=htk)
+        np.testing.assert_allclose(np.asarray(back), hz, rtol=1e-4, atol=1e-3)
+    # scalar path
+    assert abs(audio.functional.mel_to_hz(
+        audio.functional.hz_to_mel(440.0)) - 440.0) < 1e-6
+
+
+def test_windows_match_scipy_formulas():
+    # hann golden: 0.5 - 0.5 cos(2 pi n / M) (periodic/fftbins form)
+    M = 16
+    w = np.asarray(audio.functional.get_window("hann", M))
+    n = np.arange(M)
+    np.testing.assert_allclose(w, 0.5 - 0.5 * np.cos(2 * math.pi * n / M),
+                               atol=1e-12)
+    for name in ("hamming", "blackman", "triang", "cosine", "bohman",
+                 ("gaussian", 3.0), ("exponential", None, 1.0),
+                 ("tukey", 0.5), ("taylor", 4, 30),
+                 ("general_gaussian", 1.5, 5), ("general_hamming", 0.6)):
+        w = np.asarray(audio.functional.get_window(name, 15, fftbins=False))
+        assert w.shape == (15,) and np.all(np.isfinite(w))
+        assert abs(w[7] - w.max()) < 1e-6 or name == "exponential"
+
+
+def test_fbank_and_dct_shapes_and_partition():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert float(jnp.min(fb)) >= 0.0
+    dct = audio.functional.create_dct(13, 40)
+    assert dct.shape == (40, 13)
+    # orthonormality of DCT columns
+    g = np.asarray(dct).T @ np.asarray(dct)
+    np.testing.assert_allclose(g, np.eye(13), atol=1e-5)
+
+
+def test_power_to_db_golden():
+    v = audio.functional.power_to_db(jnp.asarray(3.0), top_db=None)
+    assert abs(float(v) - 10 * math.log10(3.0)) < 1e-5
+
+
+def test_feature_layers_pipeline():
+    x = jnp.asarray(np.random.randn(2, 4000).astype(np.float32) * 0.1)
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(x)
+    assert spec.shape[:2] == (2, 129)
+    mel = audio.MelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                               n_mels=32)(x)
+    assert mel.shape[:2] == (2, 32)
+    logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                                     n_mels=32)(x)
+    assert np.all(np.isfinite(np.asarray(logmel)))
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128,
+                      n_mels=32)(x)
+    assert mfcc.shape[:2] == (2, 13)
+    # jit-able end to end
+    jitted = jax.jit(audio.MFCC(sr=8000, n_mfcc=13, n_fft=256,
+                                hop_length=128, n_mels=32).forward)
+    np.testing.assert_allclose(np.asarray(jitted(x)), np.asarray(mfcc),
+                               rtol=2e-3, atol=2e-3)
+
+
+# ------------------------------------------------------------- geometric
+def test_segment_ops_golden():
+    data = jnp.asarray([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]])
+    ids = jnp.asarray([0, 0, 1])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_sum(data, ids)),
+        [[4., 4., 4.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_mean(data, ids)),
+        [[2., 2., 2.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_min(data, ids)),
+        [[1., 2., 1.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_max(data, ids)),
+        [[3., 2., 3.], [4., 5., 6.]])
+    # empty segment -> 0 (reference semantics), static count under jit
+    out = jax.jit(lambda d, i: geometric.segment_max(d, i, num_segments=4))(
+        data, ids)
+    np.testing.assert_allclose(np.asarray(out)[2:], 0.0)
+
+
+def test_send_recv_golden():
+    x = jnp.asarray([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]])
+    src = jnp.asarray([0, 1, 2, 0])
+    dst = jnp.asarray([1, 2, 1, 0])
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    # dst 0 <- x[0]; dst 1 <- x[0]+x[2]; dst 2 <- x[1]
+    np.testing.assert_allclose(np.asarray(out),
+                               [[0., 2., 3.], [2., 8., 10.], [1., 4., 5.]])
+    e = jnp.asarray([1., 2., 3., 4.])
+    out2 = geometric.send_ue_recv(x, e, src, dst, message_op="mul",
+                                  reduce_op="max")
+    np.testing.assert_allclose(np.asarray(out2)[0], [0., 8., 12.])
+    uv = geometric.send_uv(x, x, src, dst, message_op="add")
+    assert uv.shape == (4, 3)
+    np.testing.assert_allclose(np.asarray(uv)[0], np.asarray(x[0] + x[1]))
+
+
+def test_reindex_and_sampling():
+    x = np.array([0, 5, 9])
+    neighbors = np.array([8, 9, 0, 4, 7, 6, 7])
+    count = np.array([2, 3, 2])
+    rs, rd, nodes = geometric.reindex_graph(x, neighbors, count)
+    assert list(np.asarray(nodes)[:3]) == [0, 5, 9]
+    assert rs.shape == (7,) and rd.shape == (7,)
+    # dst expands x by count
+    np.testing.assert_array_equal(np.asarray(rd), [0, 0, 1, 1, 1, 2, 2])
+    # ids all valid
+    assert int(np.asarray(rs).max()) < nodes.shape[0]
+
+    # CSC graph: 3 nodes, node0 <- {1,2}, node1 <- {0}, node2 <- {0,1}
+    row = np.array([1, 2, 0, 0, 1])
+    colptr = np.array([0, 2, 3, 5])
+    out_n, out_c = geometric.sample_neighbors(row, colptr, np.array([0, 2]),
+                                              sample_size=1, seed=0)
+    assert out_n.shape == (2,) and list(np.asarray(out_c)) == [1, 1]
+    full_n, full_c = geometric.sample_neighbors(row, colptr, np.array([0]),
+                                                sample_size=-1)
+    np.testing.assert_array_equal(np.sort(np.asarray(full_n)), [1, 2])
+
+
+# ------------------------------------------------------------------ onnx
+def test_onnx_export_writes_native_artifact(tmp_path):
+    from paddle_tpu import nn
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    p = str(tmp_path / "model")
+    out = paddle.onnx.export(m, p,
+                             example_args=(jnp.zeros((1, 4), jnp.float32),))
+    assert out.endswith(".stablehlo")
+    import os
+    assert os.path.exists(p + ".stablehlo") and os.path.exists(p + ".pdiparams")
+    loaded = paddle.jit.load(p)
+    y = loaded(jnp.ones((1, 4), jnp.float32))
+    assert np.asarray(y).shape == (1, 2)
