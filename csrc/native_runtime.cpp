@@ -29,6 +29,7 @@
 #include <deque>
 #include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -406,6 +407,113 @@ struct TokenReader {
 // ---------------------------------------------------------------------------
 // C ABI
 // ---------------------------------------------------------------------------
+// Actor-model pipeline runtime (FleetExecutor equivalent).
+//
+// Reference components this replaces (behavior, not code):
+//   * Carrier + Interceptor message loops —
+//     paddle/fluid/distributed/fleet_executor/{carrier.h, interceptor.h:51}
+//     (per-actor mailboxes, id→rank routing, SOURCE_ID/SINK_ID)
+//   * MessageBus (brpc inter-node) —
+//     paddle/fluid/distributed/fleet_executor/message_bus.cc — here a framed
+//     TCP peer mesh reusing this file's socket helpers.
+//
+// Compute itself stays in Python/XLA (interceptor handlers run jitted
+// steps); the native tier owns mailboxes, routing, and the cross-node bus
+// so message passing runs off-GIL.
+// ---------------------------------------------------------------------------
+struct ActorMessage {
+  int64_t src = 0;
+  int64_t dst = 0;
+  int32_t type = 0;
+  int64_t scope = 0;  // microbatch ("scope_idx" in the reference)
+  std::string payload;
+};
+
+struct ActorInbox {
+  std::deque<ActorMessage> q;
+  bool closed = false;
+};
+
+struct Carrier {
+  int64_t rank = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<int64_t, ActorInbox> inboxes;      // actor id -> mailbox
+  std::map<int64_t, int64_t> routes;          // actor id -> rank
+  std::map<int64_t, int> peer_fds;            // rank -> socket
+  // per-peer write locks so a stalled peer only blocks its own edge;
+  // peer_mu guards the maps themselves
+  std::map<int64_t, std::unique_ptr<std::mutex>> peer_write_mus;
+  std::mutex peer_mu;
+  std::atomic<bool> running{false};
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
+
+  void deliver(ActorMessage &&m) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = inboxes.find(m.dst);
+    if (it == inboxes.end() || it->second.closed) return;  // drop: unknown
+    it->second.q.push_back(std::move(m));
+    cv.notify_all();
+  }
+
+  bool read_message(int fd, ActorMessage *m) {
+    uint64_t plen;
+    if (!read_full(fd, &m->src, 8)) return false;
+    if (!read_full(fd, &m->dst, 8)) return false;
+    if (!read_full(fd, &m->type, 4)) return false;
+    if (!read_full(fd, &m->scope, 8)) return false;
+    if (!read_full(fd, &plen, 8)) return false;
+    if (plen > kMaxFrameBytes) return false;
+    m->payload.resize(plen);
+    if (plen && !read_full(fd, &m->payload[0], plen)) return false;
+    return true;
+  }
+
+  void conn_loop(int fd) {
+    ActorMessage m;
+    while (running && read_message(fd, &m)) deliver(std::move(m));
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    while (running) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(conn_mu);
+        conn_fds.push_back(fd);
+      }
+      conn_threads.emplace_back(&Carrier::conn_loop, this, fd);
+    }
+  }
+
+  bool send_remote(int64_t dst_rank, const ActorMessage &m) {
+    int fd;
+    std::mutex *wmu;
+    {
+      std::lock_guard<std::mutex> g(peer_mu);
+      auto it = peer_fds.find(dst_rank);
+      if (it == peer_fds.end()) return false;
+      fd = it->second;
+      wmu = peer_write_mus[dst_rank].get();
+    }
+    std::lock_guard<std::mutex> w(*wmu);
+    uint64_t plen = m.payload.size();
+    if (!write_full(fd, &m.src, 8) || !write_full(fd, &m.dst, 8) ||
+        !write_full(fd, &m.type, 4) || !write_full(fd, &m.scope, 8) ||
+        !write_full(fd, &plen, 8))
+      return false;
+    if (plen && !write_full(fd, m.payload.data(), plen)) return false;
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------------------
 extern "C" {
 
 void *pts_server_start(int port) {
@@ -599,5 +707,183 @@ void ptn_reader_stop(void *h) {
   if (r->worker.joinable()) r->worker.join();
   delete r;
 }
+
+// --- actor runtime (FleetExecutor equivalent) ------------------------------
+void *afx_carrier_create(int64_t rank) {
+  auto *c = new Carrier();
+  c->rank = rank;
+  c->running = true;
+  return c;
+}
+
+// start the inter-carrier bus listener; returns the bound port (0 on error)
+int afx_carrier_listen(void *h) {
+  auto *c = static_cast<Carrier *>(h);
+  c->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(c->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;
+  if (::bind(c->listen_fd, reinterpret_cast<sockaddr *>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(c->listen_fd, 64) != 0) {
+    ::close(c->listen_fd);
+    c->listen_fd = -1;
+    return 0;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(c->listen_fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  c->port = ntohs(addr.sin_port);
+  c->accept_thread = std::thread(&Carrier::accept_loop, c);
+  return c->port;
+}
+
+int afx_carrier_connect(void *h, int64_t peer_rank, const char *host,
+                        int port, long timeout_ms) {
+  auto *c = static_cast<Carrier *>(h);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  auto deadline = Clock::now() + std::chrono::milliseconds(
+                                     timeout_ms < 0 ? 30000 : timeout_ms);
+  int fd = -1;
+  for (;;) {
+    // a failed connect leaves the socket in unspecified state (POSIX) —
+    // every retry needs a fresh fd or the loop can never succeed
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                  sizeof(addr)) == 0)
+      break;
+    ::close(fd);
+    fd = -1;
+    if (Clock::now() > deadline) return 0;
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::lock_guard<std::mutex> g(c->peer_mu);
+  auto it = c->peer_fds.find(peer_rank);
+  if (it != c->peer_fds.end()) ::close(it->second);
+  c->peer_fds[peer_rank] = fd;
+  if (!c->peer_write_mus.count(peer_rank))
+    c->peer_write_mus[peer_rank] = std::make_unique<std::mutex>();
+  return 1;
+}
+
+void afx_carrier_register(void *h, int64_t actor_id) {
+  auto *c = static_cast<Carrier *>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->inboxes[actor_id];  // create empty mailbox
+  c->routes[actor_id] = c->rank;
+}
+
+void afx_carrier_set_route(void *h, int64_t actor_id, int64_t rank) {
+  auto *c = static_cast<Carrier *>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->routes[actor_id] = rank;
+}
+
+// route by id: local mailbox or remote peer (reference: Carrier::Send →
+// local EnqueueInterceptorMessage vs MessageBus::Send)
+int afx_carrier_send(void *h, int64_t src, int64_t dst, int32_t type,
+                     int64_t scope, const char *payload, uint64_t len) {
+  auto *c = static_cast<Carrier *>(h);
+  ActorMessage m;
+  m.src = src;
+  m.dst = dst;
+  m.type = type;
+  m.scope = scope;
+  if (len) m.payload.assign(payload, len);
+  int64_t dst_rank;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->routes.find(dst);
+    if (it == c->routes.end()) return 0;
+    dst_rank = it->second;
+  }
+  if (dst_rank == c->rank) {
+    c->deliver(std::move(m));
+    return 1;
+  }
+  return c->send_remote(dst_rank, m) ? 1 : 0;
+}
+
+// blocking pop from an actor's mailbox; returns 1 on message, 0 on
+// timeout/closed. Payload is malloc'd; caller frees via ptn_free.
+int afx_carrier_recv(void *h, int64_t actor_id, long timeout_ms,
+                     int64_t *src, int32_t *type, int64_t *scope,
+                     char **payload, uint64_t *len) {
+  auto *c = static_cast<Carrier *>(h);
+  std::unique_lock<std::mutex> lk(c->mu);
+  auto *box = &c->inboxes[actor_id];
+  bool ok = wait_until(c->cv, lk, timeout_ms, [&] {
+    return !box->q.empty() || box->closed || !c->running;
+  });
+  if (!ok || box->q.empty()) return 0;
+  ActorMessage m = std::move(box->q.front());
+  box->q.pop_front();
+  *src = m.src;
+  *type = m.type;
+  *scope = m.scope;
+  *len = m.payload.size();
+  if (m.payload.empty()) {
+    *payload = nullptr;
+  } else {
+    *payload = static_cast<char *>(::malloc(m.payload.size()));
+    ::memcpy(*payload, m.payload.data(), m.payload.size());
+  }
+  return 1;
+}
+
+uint64_t afx_carrier_pending(void *h, int64_t actor_id) {
+  auto *c = static_cast<Carrier *>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->inboxes.find(actor_id);
+  return it == c->inboxes.end() ? 0 : it->second.q.size();
+}
+
+// phase 1: wake every blocked recv and tear down sockets/threads, but keep
+// the object alive — callers may still be inside afx_carrier_recv/send
+// (their calls return 0 once running=false). Idempotent.
+void afx_carrier_shutdown(void *h) {
+  auto *c = static_cast<Carrier *>(h);
+  if (!c->running.exchange(false)) return;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    for (auto &kv : c->inboxes) kv.second.closed = true;
+  }
+  c->cv.notify_all();
+  if (c->listen_fd >= 0) {
+    ::shutdown(c->listen_fd, SHUT_RDWR);
+    ::close(c->listen_fd);
+    c->listen_fd = -1;
+  }
+  if (c->accept_thread.joinable()) c->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(c->conn_mu);
+    for (int fd : c->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto &t : c->conn_threads)
+    if (t.joinable()) t.join();
+  {
+    std::lock_guard<std::mutex> g(c->peer_mu);
+    for (auto &kv : c->peer_fds) ::close(kv.second);
+    c->peer_fds.clear();
+  }
+}
+
+// phase 2: free. Only call after every thread using the handle has exited.
+void afx_carrier_destroy(void *h) {
+  auto *c = static_cast<Carrier *>(h);
+  afx_carrier_shutdown(h);
+  delete c;
+}
+
+// legacy one-shot form (shutdown + free); safe only when no other thread
+// can still be inside a carrier call
+void afx_carrier_stop(void *h) { afx_carrier_destroy(h); }
 
 }  // extern "C"

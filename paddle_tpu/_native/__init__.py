@@ -65,6 +65,20 @@ def _declare(lib):
         "ptn_rb_destroy": ([P], None),
         "ptn_reader_start": ([CP, L, L, L, L, P], P),
         "ptn_reader_stop": ([P], None),
+        "afx_carrier_create": ([c.c_int64], P),
+        "afx_carrier_listen": ([P], I),
+        "afx_carrier_connect": ([P, c.c_int64, CP, I, L], I),
+        "afx_carrier_register": ([P, c.c_int64], None),
+        "afx_carrier_set_route": ([P, c.c_int64, c.c_int64], None),
+        "afx_carrier_send": ([P, c.c_int64, c.c_int64, c.c_int32,
+                              c.c_int64, CP, U64], I),
+        "afx_carrier_recv": ([P, c.c_int64, L, c.POINTER(c.c_int64),
+                              c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+                              c.POINTER(P), c.POINTER(U64)], I),
+        "afx_carrier_pending": ([P, c.c_int64], U64),
+        "afx_carrier_shutdown": ([P], None),
+        "afx_carrier_destroy": ([P], None),
+        "afx_carrier_stop": ([P], None),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

@@ -22,6 +22,9 @@ from .check import CommCheckError, nan_guard
 from . import checkpoint  # noqa: F401
 from .store import MasterStore, TCPStore
 from . import passes  # noqa: F401
+from . import fleet_executor  # noqa: F401
+from .fleet_executor import FleetExecutor, TaskNode
+from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .watchdog import CommWatchdog, get_watchdog
 from .checkpoint import load_state_dict, save_state_dict
@@ -59,4 +62,5 @@ __all__ = [
     "checkpoint", "save_state_dict", "load_state_dict",
     "TCPStore", "MasterStore", "rpc", "passes", "CommWatchdog", "get_watchdog",
     "check", "CommCheckError", "nan_guard",
+    "fleet_executor", "FleetExecutor", "TaskNode", "ps",
 ]

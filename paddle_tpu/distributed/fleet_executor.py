@@ -1,0 +1,492 @@
+"""Actor-model pipeline runtime (FleetExecutor equivalent).
+
+Reference: paddle/fluid/distributed/fleet_executor/ —
+``FleetExecutor`` (fleet_executor.h:36), ``Carrier`` (carrier.h:50),
+``Interceptor`` message loops (interceptor.h:51, SOURCE_ID/SINK_ID at
+:48-49), ``ComputeInterceptor``/``AmplifierInterceptor``
+(compute_interceptor.cc, amplifier_interceptor.cc), ``TaskNode``
+(task_node.h:36), brpc ``MessageBus`` (message_bus.cc).
+
+TPU design. The reference uses this actor runtime to drive *static-graph
+pipeline parallelism*: each pipeline stage is an interceptor that runs an
+InterpreterCore program when its data-dependency credits allow, with
+messages flowing DATA_IS_READY downstream and DATA_IS_USELESS upstream.
+On TPU the *intra-chip* pipeline is the compiled SPMD program
+(meta_parallel/pp_utils/spmd_pipeline.py) — XLA schedules it. What the
+actor tier still owns is **host-side orchestration across processes/hosts**:
+micro-batch admission control, multi-stage driver loops that mix compute
+(jitted steps) with IO/eviction, and cross-host control messaging. The
+mailboxes/routing/TCP bus run in native C++ (csrc/native_runtime.cpp
+``Carrier``) so message passing is off-GIL; interceptor handlers run
+Python (typically invoking jitted XLA programs).
+
+A pure-Python carrier fallback keeps the runtime available when the
+native toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import _native
+
+__all__ = ["SOURCE_ID", "SINK_ID", "MessageType", "TaskNode", "Carrier",
+           "Interceptor", "ComputeInterceptor", "AmplifierInterceptor",
+           "FleetExecutor"]
+
+SOURCE_ID = -1  # reference: interceptor.h:48
+SINK_ID = -2    # reference: interceptor.h:49
+
+
+class MessageType:
+    """(reference: interceptor_message.proto MessageType)"""
+    START = 0
+    DATA_IS_READY = 1
+    DATA_IS_USELESS = 2
+    ERR = 3
+    RESET = 4
+    STOP = 5
+
+
+@dataclass
+class Message:
+    src: int
+    dst: int
+    type: int
+    scope: int = 0
+    payload: bytes = b""
+
+
+@dataclass
+class TaskNode:
+    """(reference: task_node.h:36) one pipeline-stage task: its rank, the
+    number of micro-batch runs, and up/downstream edges with buffer sizes
+    (= in-flight micro-batch credits)."""
+    rank: int
+    task_id: int
+    max_run_times: int = 1
+    run_fn: Optional[Callable[[int], Any]] = None  # called with scope idx
+    node_type: str = "Compute"
+    # task_id -> buffer_size (credit window), reference task_node.h upstream_/downstream_
+    upstream: Dict[int, int] = field(default_factory=dict)
+    downstream: Dict[int, int] = field(default_factory=dict)
+
+    def add_upstream_task(self, task_id: int, buffer_size: int = 2):
+        self.upstream[task_id] = buffer_size
+
+    def add_downstream_task(self, task_id: int, buffer_size: int = 2):
+        self.downstream[task_id] = buffer_size
+
+
+class _PyCarrier:
+    """Pure-Python mailbox fallback (same-process only)."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self._boxes: Dict[int, "queue.Queue[Message]"] = {}
+        self._routes: Dict[int, int] = {}
+        self._peers: Dict[int, "_PyCarrier"] = {}
+
+    def listen(self):
+        return 0
+
+    def connect(self, peer_rank, host, port, timeout_ms=-1):
+        raise RuntimeError("python fallback carrier cannot cross processes; "
+                           "native runtime unavailable")
+
+    def link_local_peer(self, other: "_PyCarrier"):
+        self._peers[other.rank] = other
+
+    def register(self, actor_id: int):
+        self._boxes[actor_id] = queue.Queue()
+        self._routes[actor_id] = self.rank
+
+    def set_route(self, actor_id: int, rank: int):
+        self._routes[actor_id] = rank
+
+    def send(self, msg: Message) -> bool:
+        rank = self._routes.get(msg.dst)
+        if rank is None:
+            return False
+        if rank == self.rank:
+            box = self._boxes.get(msg.dst)
+            if box is None:
+                return False
+            box.put(msg)
+            return True
+        peer = self._peers.get(rank)
+        return peer is not None and peer.send(msg)
+
+    def recv(self, actor_id: int, timeout_ms: int = -1) -> Optional[Message]:
+        try:
+            t = None if timeout_ms is None or timeout_ms < 0 else timeout_ms / 1e3
+            return self._boxes[actor_id].get(timeout=t)
+        except queue.Empty:
+            return None
+
+    def pending(self, actor_id: int) -> int:
+        return self._boxes[actor_id].qsize()
+
+    def stop(self):
+        for box in self._boxes.values():
+            box.put(None)  # wake any waiter
+
+
+class Carrier:
+    """Mailbox + routing + cross-host bus (reference: carrier.h:50). Backed
+    by the native C++ carrier when available."""
+
+    def __init__(self, rank: int = 0, use_native: Optional[bool] = None):
+        self._lib = _native.load() if use_native in (None, True) else None
+        if use_native is True and self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.rank = rank
+        if self._lib is not None:
+            self._h = self._lib.afx_carrier_create(rank)
+            self._py = None
+        else:
+            self._h = None
+            self._py = _PyCarrier(rank)
+        self._stopped = False
+
+    # --- bus (reference: message_bus.cc) ---
+    def listen(self) -> int:
+        if self._py is not None:
+            return self._py.listen()
+        return int(self._lib.afx_carrier_listen(self._h))
+
+    def connect(self, peer_rank: int, host: str, port: int,
+                timeout_ms: int = 10000) -> bool:
+        if self._py is not None:
+            return self._py.connect(peer_rank, host, port, timeout_ms)
+        return bool(self._lib.afx_carrier_connect(
+            self._h, peer_rank, host.encode(), port, timeout_ms))
+
+    # --- mailboxes ---
+    def register(self, actor_id: int):
+        if self._py is not None:
+            self._py.register(actor_id)
+        else:
+            self._lib.afx_carrier_register(self._h, actor_id)
+
+    def set_route(self, actor_id: int, rank: int):
+        if self._py is not None:
+            self._py.set_route(actor_id, rank)
+        else:
+            self._lib.afx_carrier_set_route(self._h, actor_id, rank)
+
+    def send(self, msg: Message) -> bool:
+        if self._py is not None:
+            return self._py.send(msg)
+        if self._h is None:
+            return False
+        return bool(self._lib.afx_carrier_send(
+            self._h, msg.src, msg.dst, msg.type, msg.scope,
+            msg.payload, len(msg.payload)))
+
+    def recv(self, actor_id: int, timeout_ms: int = -1) -> Optional[Message]:
+        if self._py is not None:
+            return self._py.recv(actor_id, timeout_ms)
+        if self._h is None:
+            return None
+        src = ctypes.c_int64()
+        typ = ctypes.c_int32()
+        scope = ctypes.c_int64()
+        ptr = ctypes.c_void_p()
+        ln = ctypes.c_uint64()
+        ok = self._lib.afx_carrier_recv(
+            self._h, actor_id, timeout_ms, ctypes.byref(src),
+            ctypes.byref(typ), ctypes.byref(scope), ctypes.byref(ptr),
+            ctypes.byref(ln))
+        if not ok:
+            return None
+        payload = _native.take_bytes(self._lib, ptr, ln.value)
+        return Message(src=src.value, dst=actor_id, type=typ.value,
+                       scope=scope.value, payload=payload)
+
+    def pending(self, actor_id: int) -> int:
+        if self._py is not None:
+            return self._py.pending(actor_id)
+        if self._h is None:
+            return 0
+        return int(self._lib.afx_carrier_pending(self._h, actor_id))
+
+    def shutdown(self):
+        """Wake every blocked recv; the handle stays valid (calls return
+        None/False) until :meth:`destroy`. Safe while actor threads run."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._py is not None:
+            self._py.stop()
+        else:
+            self._lib.afx_carrier_shutdown(self._h)
+
+    def destroy(self):
+        """Free the native carrier. Only after all user threads joined."""
+        self.shutdown()
+        if self._py is None and self._h is not None:
+            self._lib.afx_carrier_destroy(self._h)
+            self._h = None
+
+    def stop(self):
+        self.destroy()
+
+
+class Interceptor:
+    """Message-driven actor (reference: interceptor.h:51). Subclasses
+    override ``handle``; a thread drains the mailbox until STOP."""
+
+    def __init__(self, carrier: Carrier, node: TaskNode):
+        self.carrier = carrier
+        self.node = node
+        self.id = node.task_id
+        carrier.register(self.id)
+        self._thread: Optional[threading.Thread] = None
+        self.stopped = threading.Event()
+
+    def send(self, dst: int, type_: int, scope: int = 0,
+             payload: bytes = b"") -> bool:
+        return self.carrier.send(Message(self.id, dst, type_, scope, payload))
+
+    def handle(self, msg: Message):
+        raise NotImplementedError
+
+    def _loop(self):
+        while not self.stopped.is_set():
+            msg = self.carrier.recv(self.id, timeout_ms=200)
+            if msg is None:
+                continue
+            if msg.type == MessageType.STOP:
+                break
+            self.handle(msg)
+        self.stopped.set()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"interceptor-{self.id}")
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self):
+        self.stopped.set()
+        if self._thread is not None and self._thread.is_alive():
+            self.send(self.id, MessageType.STOP)
+            self._thread.join(timeout=5)
+
+
+class ComputeInterceptor(Interceptor):
+    """Credit-based compute actor (reference: compute_interceptor.cc).
+
+    Runs ``node.run_fn(scope)`` when every upstream has a ready micro-batch
+    and every downstream has buffer credit; then tells downstream
+    DATA_IS_READY and upstream DATA_IS_USELESS.
+    """
+
+    def __init__(self, carrier: Carrier, node: TaskNode):
+        super().__init__(carrier, node)
+        self._in_ready = {u: 0 for u in node.upstream}
+        self._out_credit = dict(node.downstream)  # start with full buffers
+        self._step = 0
+        self.results: List[Any] = []
+
+    def _can_run(self) -> bool:
+        if self._step >= self.node.max_run_times:
+            return False
+        ups = all(v > 0 for v in self._in_ready.values()) \
+            if self._in_ready else True
+        downs = all(v > 0 for v in self._out_credit.values()) \
+            if self._out_credit else True
+        return ups and downs
+
+    def _run_loop_once(self):
+        while self._can_run():
+            scope = self._step
+            if self.node.run_fn is not None:
+                self.results.append(self.node.run_fn(scope))
+            self._step += 1
+            for u in self._in_ready:
+                self._in_ready[u] -= 1
+                self.send(u, MessageType.DATA_IS_USELESS, scope)
+            for d in self._out_credit:
+                self._out_credit[d] -= 1
+                self.send(d, MessageType.DATA_IS_READY, scope)
+
+    def handle(self, msg: Message):
+        if msg.type == MessageType.DATA_IS_READY:
+            self._in_ready[msg.src] = self._in_ready.get(msg.src, 0) + 1
+        elif msg.type == MessageType.DATA_IS_USELESS:
+            self._out_credit[msg.src] = self._out_credit.get(msg.src, 0) + 1
+        elif msg.type == MessageType.RESET:
+            self._step = 0
+        self._run_loop_once()
+
+
+class AmplifierInterceptor(ComputeInterceptor):
+    """(reference: amplifier_interceptor.cc) runs every ``run_per_steps``
+    micro-batches at ``run_at_offset`` — the gradient-merge / k-step
+    accumulation actor."""
+
+    def __init__(self, carrier: Carrier, node: TaskNode,
+                 run_per_steps: int = 1, run_at_offset: int = 0):
+        super().__init__(carrier, node)
+        self.run_per_steps = run_per_steps
+        self.run_at_offset = run_at_offset
+
+    def _run_loop_once(self):
+        while self._can_run():
+            scope = self._step
+            if (self.node.run_fn is not None
+                    and scope % self.run_per_steps == self.run_at_offset):
+                self.results.append(self.node.run_fn(scope))
+            self._step += 1
+            for u in self._in_ready:
+                self._in_ready[u] -= 1
+                self.send(u, MessageType.DATA_IS_USELESS, scope)
+            for d in self._out_credit:
+                self._out_credit[d] -= 1
+                self.send(d, MessageType.DATA_IS_READY, scope)
+
+
+class _SourceInterceptor(Interceptor):
+    """(reference: source_interceptor.cc) feeds max_run_times micro-batches
+    downstream, throttled by downstream buffer credit."""
+
+    def __init__(self, carrier: Carrier, node: TaskNode):
+        super().__init__(carrier, node)
+        self._credit = dict(node.downstream)
+        self._fed = 0
+
+    def _feed(self):
+        while (self._fed < self.node.max_run_times
+               and all(v > 0 for v in self._credit.values())):
+            for d in self._credit:
+                self._credit[d] -= 1
+                self.send(d, MessageType.DATA_IS_READY, self._fed)
+            self._fed += 1
+
+    def handle(self, msg: Message):
+        if msg.type == MessageType.START:
+            self._fed = 0
+            self._credit = dict(self.node.downstream)
+        elif msg.type == MessageType.DATA_IS_USELESS:
+            self._credit[msg.src] = self._credit.get(msg.src, 0) + 1
+        self._feed()
+
+
+class _SinkInterceptor(Interceptor):
+    """(reference: sink_interceptor.cc) acks upstream and signals job
+    completion after max_run_times micro-batches."""
+
+    def __init__(self, carrier: Carrier, node: TaskNode,
+                 done_event: threading.Event):
+        super().__init__(carrier, node)
+        self._seen = 0
+        self._done = done_event
+
+    def handle(self, msg: Message):
+        if msg.type == MessageType.RESET:
+            self._seen = 0
+        elif msg.type == MessageType.DATA_IS_READY:
+            self._seen += 1
+            self.send(msg.src, MessageType.DATA_IS_USELESS, msg.scope)
+            if self._seen >= self.node.max_run_times:
+                self._done.set()
+
+
+class FleetExecutor:
+    """(reference: fleet_executor.h:36) builds a Carrier from TaskNodes,
+    wires SOURCE/SINK, runs the micro-batch message flow to completion.
+
+    ``cluster`` (optional): {rank: (host, port)} for multi-process runs —
+    every non-local task routes through the TCP bus, the reference's
+    brpc MessageBus topology.
+    """
+
+    def __init__(self, task_nodes: List[TaskNode], rank: int = 0,
+                 num_micro_batches: Optional[int] = None,
+                 cluster: Optional[Dict[int, Tuple[str, int]]] = None,
+                 use_native: Optional[bool] = None):
+        self.rank = rank
+        self.carrier = Carrier(rank, use_native=use_native)
+        self.port = self.carrier.listen() if cluster is not None else 0
+        n_mb = num_micro_batches or max(
+            (t.max_run_times for t in task_nodes), default=1)
+        local = [t for t in task_nodes if t.rank == rank]
+        remote = [t for t in task_nodes if t.rank != rank]
+
+        # roots: local tasks fed by nothing -> SOURCE feeds them.
+        # leaves: local tasks feeding nothing -> report to SINK. When every
+        # local task feeds a remote stage (pipeline head rank), probe the
+        # last local task so "locally done" is still observable.
+        roots = [t for t in local if not t.upstream]
+        leaves = [t for t in local if not t.downstream]
+        if not leaves and local:
+            leaves = [max(local, key=lambda t: t.task_id)]
+        self._done = threading.Event()
+        src_node = TaskNode(rank=rank, task_id=SOURCE_ID,
+                            max_run_times=n_mb, node_type="Source")
+        sink_node = TaskNode(rank=rank, task_id=SINK_ID,
+                             max_run_times=n_mb * max(len(leaves), 1),
+                             node_type="Sink")
+        for t in roots:
+            t.add_upstream_task(SOURCE_ID, 2)
+            src_node.add_downstream_task(t.task_id, 2)
+        for t in leaves:
+            t.add_downstream_task(SINK_ID, 2)
+            sink_node.add_upstream_task(t.task_id, 2)
+
+        self.interceptors: Dict[int, Interceptor] = {}
+        for t in local:
+            cls = (AmplifierInterceptor if t.node_type == "Amplifier"
+                   else ComputeInterceptor)
+            self.interceptors[t.task_id] = cls(self.carrier, t)
+        self._source = _SourceInterceptor(self.carrier, src_node)
+        self._sink = _SinkInterceptor(self.carrier, sink_node, self._done)
+        self.interceptors[SOURCE_ID] = self._source
+        self.interceptors[SINK_ID] = self._sink
+
+        for t in remote:
+            self.carrier.set_route(t.task_id, t.rank)
+        if cluster:
+            for r, (host, port) in cluster.items():
+                if r != rank:
+                    self.carrier.connect(r, host, port)
+
+        for it in self.interceptors.values():
+            it.start()
+
+    def run(self, timeout: Optional[float] = 60.0) -> bool:
+        """Kick the source and block until the sink saw every micro-batch
+        (single-rank jobs) or until locally done (multi-rank). Repeatable:
+        each run RESETs step counters first (reference: per-step
+        FleetExecutor::Run re-entering the same carrier). Mailboxes are
+        FIFO, so RESET lands before the new run's first DATA_IS_READY."""
+        self._done.clear()
+        for tid in self.interceptors:
+            if tid != SOURCE_ID:
+                self.carrier.send(Message(SOURCE_ID, tid, MessageType.RESET))
+        self.carrier.send(Message(SOURCE_ID, SOURCE_ID, MessageType.START))
+        return self._done.wait(timeout)
+
+    def results(self, task_id: int) -> List[Any]:
+        it = self.interceptors[task_id]
+        return getattr(it, "results", [])
+
+    def shutdown(self):
+        # ordered teardown: signal actors, wake blocked recvs (handle stays
+        # valid), join every thread, then free the native carrier — a slow
+        # run_fn can no longer race a freed handle
+        for it in self.interceptors.values():
+            it.stopped.set()
+        self.carrier.shutdown()
+        for it in self.interceptors.values():
+            it.join(timeout=120)
+        self.carrier.destroy()

@@ -1,0 +1,54 @@
+"""Parameter-server mode (reference: paddle/fluid/distributed/ps/ — brpc
+services + tables; python/paddle/distributed/ps/the_one_ps.py runtime;
+fleet PS mode via ``fleet.init(role_maker)`` non-collective).
+
+The rec-sys workload class: embedding tables too large for chip memory
+live server-side (host RAM), TPU workers pull touched rows / push grads.
+See table.py and service.py for the split mirroring the reference's
+table/accessor vs brpc service layers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .service import PsClient, PsServer, TableConfig
+from .table import (AdaGradRule, AdamRule, DenseTable, SGDRule, SparseTable,
+                    make_rule)
+
+__all__ = ["TableConfig", "PsServer", "PsClient", "DenseTable", "SparseTable",
+           "SGDRule", "AdamRule", "AdaGradRule", "make_rule", "TheOnePs",
+           "PsRole"]
+
+
+class PsRole:
+    SERVER = "server"
+    WORKER = "worker"
+
+
+class TheOnePs:
+    """(reference: python/paddle/distributed/ps/the_one_ps.py TheOnePSRuntime)
+    role-driven runtime facade: servers build and serve tables, workers get
+    a connected client."""
+
+    def __init__(self, role: str, configs: Optional[List[TableConfig]] = None,
+                 endpoint: Optional[str] = None, client_id: int = 0):
+        self.role = role
+        self.server: Optional[PsServer] = None
+        self.client: Optional[PsClient] = None
+        if role == PsRole.SERVER:
+            if configs is None:
+                raise ValueError("server role needs table configs")
+            self.server = PsServer(configs)
+            self.endpoint = self.server.endpoint
+        else:
+            if endpoint is None:
+                raise ValueError("worker role needs the server endpoint")
+            self.client = PsClient(endpoint, client_id=client_id)
+            self.endpoint = endpoint
+
+    def stop(self):
+        if self.client is not None:
+            self.client.close()
+        if self.server is not None:
+            self.server.stop()

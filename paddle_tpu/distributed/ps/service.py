@@ -1,0 +1,188 @@
+"""Parameter-server service: server + client over the native TCP store
+(reference: paddle/fluid/distributed/ps/service/ — brpc_ps_server.cc
+request dispatch by PsCmdID, brpc_ps_client.cc async push/pull,
+the_one_ps.proto table configs).
+
+Transport design: the reference runs a brpc service per server; here the
+framework's native TCPStore (csrc/native_runtime.cpp) doubles as the
+message fabric — clients claim a request slot via the store's atomic
+counter, write the pickled request, and block on the reply key. The
+store's blocking-get *is* the request queue, so the PS needs no second
+native server. Control-plane simplicity over raw throughput: the dense
+minibatch math runs on the TPU; only touched embedding rows cross this
+channel (the rec-sys access pattern PS mode exists for).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..store import TCPStore
+from .table import DenseTable, SparseTable, make_rule
+
+__all__ = ["PsServer", "PsClient", "TableConfig"]
+
+
+class TableConfig:
+    """(reference: the_one_ps.proto TableParameter)"""
+
+    def __init__(self, table_id: int, kind: str, shape=None, dim: int = 0,
+                 rule: str = "sgd", initializer: str = "normal", **rule_kwargs):
+        self.table_id = table_id
+        self.kind = kind  # "dense" | "sparse"
+        self.shape = shape
+        self.dim = dim
+        self.rule = rule
+        self.rule_kwargs = rule_kwargs
+        self.initializer = initializer
+
+    def build(self):
+        rule = make_rule(self.rule, **self.rule_kwargs)
+        if self.kind == "dense":
+            return DenseTable(self.shape, rule, initializer=self.initializer)
+        return SparseTable(self.dim, rule, initializer=self.initializer)
+
+
+class PsServer:
+    """(reference: brpc_ps_server.cc) request loop over table ops."""
+
+    def __init__(self, configs: List[TableConfig],
+                 store: Optional[TCPStore] = None, server_id: int = 0):
+        self.store = store or TCPStore(is_master=True)
+        self.server_id = server_id
+        self.tables: Dict[int, object] = {c.table_id: c.build()
+                                          for c in configs}
+        self._stop = threading.Event()
+        self._served = 0
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"ps-server-{server_id}")
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"{self.store.host}:{self.store.port}"
+
+    def _serve(self):
+        while not self._stop.is_set():
+            key = f"ps/{self.server_id}/req/{self._served}"
+            try:
+                raw = self.store.get(key, timeout=0.5)
+            except Exception:
+                continue
+            self._served += 1
+            self.store.delete_key(key)
+            # one malformed request must not kill the serve thread: decode
+            # errors are answered (when a reply key survived decoding) or
+            # dropped, never raised out of the loop
+            reply_key = None
+            try:
+                req = pickle.loads(raw)
+                reply_key = req["reply"]
+                op = req["op"]
+            except Exception as e:
+                if reply_key is not None:
+                    self.store.set(reply_key,
+                                   pickle.dumps({"ok": False, "err": repr(e)}))
+                continue
+            if op == "stop":
+                self.store.set(reply_key, pickle.dumps({"ok": True}))
+                break
+            try:
+                out = self._dispatch(op, req)
+                reply = {"ok": True, "out": out}
+            except Exception as e:  # served back to the client
+                reply = {"ok": False, "err": repr(e)}
+            self.store.set(reply_key, pickle.dumps(reply))
+
+    def _dispatch(self, op: str, req: dict):
+        t = self.tables[req.get("table", 0)]
+        if op == "pull_dense":
+            return t.pull()
+        if op == "push_dense":
+            return t.push(req["grad"])
+        if op == "pull_sparse":
+            return t.pull(req["ids"])
+        if op == "push_sparse":
+            return t.push(req["ids"], req["grads"])
+        if op == "set_dense":
+            return t.set(req["value"])
+        if op == "save":
+            return {tid: tab.state_dict() for tid, tab in self.tables.items()}
+        if op == "load":
+            for tid, sd in req["state"].items():
+                self.tables[int(tid)].load_state_dict(sd)
+            return True
+        raise ValueError(f"unknown ps op {op!r}")
+
+    def stop(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.store.close()
+
+
+class PsClient:
+    """(reference: brpc_ps_client.cc) sync/async pull-push API."""
+
+    def __init__(self, endpoint: str, client_id: int = 0, server_id: int = 0):
+        host, port = endpoint.rsplit(":", 1)
+        self.store = TCPStore(host=host, port=int(port), is_master=False)
+        self.client_id = client_id
+        self.server_id = server_id
+        # client_id is caller-facing metadata; reply routing needs a token
+        # that is unique even when every worker keeps the default id
+        self._token = uuid.uuid4().hex
+        self._seq = 0
+
+    def _call(self, op: str, timeout: float = 30.0, **kwargs):
+        slot = self.store.add(f"ps/{self.server_id}/req_count", 1) - 1
+        reply_key = f"ps/{self.server_id}/reply/{self._token}/{self._seq}"
+        self._seq += 1
+        req = {"op": op, "reply": reply_key, **kwargs}
+        self.store.set(f"ps/{self.server_id}/req/{slot}", pickle.dumps(req))
+        raw = self.store.get(reply_key, timeout=timeout)
+        self.store.delete_key(reply_key)
+        rep = pickle.loads(raw)
+        if not rep.get("ok"):
+            raise RuntimeError(f"ps server error: {rep.get('err')}")
+        return rep.get("out")
+
+    # dense
+    def pull_dense(self, table: int = 0) -> np.ndarray:
+        return self._call("pull_dense", table=table)
+
+    def push_dense(self, grad, table: int = 0):
+        return self._call("push_dense", table=table, grad=np.asarray(grad))
+
+    def set_dense(self, value, table: int = 0):
+        return self._call("set_dense", table=table, value=np.asarray(value))
+
+    # sparse
+    def pull_sparse(self, ids, table: int = 0) -> np.ndarray:
+        return self._call("pull_sparse", table=table, ids=np.asarray(ids))
+
+    def push_sparse(self, ids, grads, table: int = 0):
+        return self._call("push_sparse", table=table, ids=np.asarray(ids),
+                          grads=np.asarray(grads))
+
+    # lifecycle
+    def save(self):
+        return self._call("save")
+
+    def load(self, state):
+        return self._call("load", state=state)
+
+    def stop_server(self):
+        try:
+            self._call("stop", timeout=5.0)
+        except Exception:
+            pass
+
+    def close(self):
+        self.store.close()

@@ -1,0 +1,185 @@
+"""Parameter-server tables (reference: paddle/fluid/distributed/ps/table/ —
+memory_dense_table.cc (dense params + sgd/adam rules),
+memory_sparse_table.cc (hash-bucketed sparse rows, init-on-first-pull),
+sparse accessors ctr_accessor.cc / sparse_sgd_rule.cc).
+
+TPU stance: PS mode serves the sparse/rec-sys workload class — huge
+embedding tables that cannot live on-chip. Tables are host-memory numpy
+state behind the PS service; the TPU worker pulls the few rows a batch
+touches (dense minibatch → XLA) and pushes gradients back. Optimizer rules
+run server-side, exactly the reference's accessor split.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SGDRule", "AdamRule", "AdaGradRule", "DenseTable", "SparseTable",
+           "make_rule"]
+
+
+class SGDRule:
+    """(reference: ps/table/sparse_sgd_rule.cc SparseNaiveSGDRule)"""
+
+    name = "sgd"
+
+    def __init__(self, lr: float = 0.01):
+        self.lr = lr
+
+    def init_state(self, shape) -> dict:
+        return {}
+
+    def apply(self, param: np.ndarray, grad: np.ndarray, state: dict):
+        param -= self.lr * grad
+
+
+class AdaGradRule:
+    """(reference: sparse_sgd_rule.cc SparseAdaGradSGDRule)"""
+
+    name = "adagrad"
+
+    def __init__(self, lr: float = 0.01, epsilon: float = 1e-8):
+        self.lr = lr
+        self.epsilon = epsilon
+
+    def init_state(self, shape) -> dict:
+        return {"g2": np.zeros(shape, np.float32)}
+
+    def apply(self, param, grad, state):
+        state["g2"] += grad * grad
+        param -= self.lr * grad / (np.sqrt(state["g2"]) + self.epsilon)
+
+
+class AdamRule:
+    """(reference: sparse_sgd_rule.cc SparseAdamSGDRule /
+    memory_dense_table.cc adam)"""
+
+    name = "adam"
+
+    def __init__(self, lr: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        self.lr, self.beta1, self.beta2, self.epsilon = lr, beta1, beta2, epsilon
+
+    def init_state(self, shape) -> dict:
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": 0}
+
+    def apply(self, param, grad, state):
+        state["t"] += 1
+        state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        state["v"] = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        mhat = state["m"] / (1 - self.beta1 ** state["t"])
+        vhat = state["v"] / (1 - self.beta2 ** state["t"])
+        param -= self.lr * mhat / (np.sqrt(vhat) + self.epsilon)
+
+
+_RULES = {"sgd": SGDRule, "adagrad": AdaGradRule, "adam": AdamRule}
+
+
+def make_rule(name: str, **kwargs):
+    return _RULES[name](**kwargs)
+
+
+class DenseTable:
+    """(reference: ps/table/memory_dense_table.cc) replicated dense block;
+    push applies the optimizer rule under a lock (async-SGD semantics —
+    concurrent worker pushes interleave, the reference's default)."""
+
+    def __init__(self, shape, rule: Optional[object] = None,
+                 initializer: str = "zeros", seed: int = 0):
+        self.rule = rule or SGDRule()
+        rng = np.random.default_rng(seed)
+        if initializer == "zeros":
+            self.param = np.zeros(shape, np.float32)
+        else:
+            self.param = rng.normal(0, 0.01, size=shape).astype(np.float32)
+        self._state = self.rule.init_state(shape)
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self.param.copy()
+
+    def push(self, grad: np.ndarray):
+        with self._mu:
+            self.rule.apply(self.param, np.asarray(grad, np.float32),
+                            self._state)
+
+    def set(self, value: np.ndarray):
+        with self._mu:
+            self.param[...] = value
+
+    def state_dict(self):
+        with self._mu:
+            return {"param": self.param.copy(),
+                    "state": copy.deepcopy(self._state)}
+
+    def load_state_dict(self, d):
+        with self._mu:
+            self.param[...] = d["param"]
+            self._state = copy.deepcopy(d["state"])
+
+
+class SparseTable:
+    """(reference: ps/table/memory_sparse_table.cc) id -> embedding-row map
+    with init-on-first-pull and server-side optimizer state per row."""
+
+    def __init__(self, dim: int, rule: Optional[object] = None,
+                 initializer: str = "normal", init_scale: float = 0.01,
+                 seed: int = 0):
+        self.dim = dim
+        self.rule = rule or SGDRule()
+        self.initializer = initializer
+        self.init_scale = init_scale
+        self._rows: Dict[int, np.ndarray] = {}
+        self._states: Dict[int, dict] = {}
+        self._rng = np.random.default_rng(seed)
+        self._mu = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self._rows.get(i)
+        if r is None:
+            if self.initializer == "zeros":
+                r = np.zeros(self.dim, np.float32)
+            else:
+                r = self._rng.normal(0, self.init_scale,
+                                     self.dim).astype(np.float32)
+            self._rows[i] = r
+            self._states[i] = self.rule.init_state((self.dim,))
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        with self._mu:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        # dedup repeated ids within one push (reference accumulates)
+        acc: Dict[int, np.ndarray] = {}
+        for i, g in zip(ids, grads):
+            i = int(i)
+            acc[i] = acc[i] + g if i in acc else g.copy()
+        with self._mu:
+            for i, g in acc.items():
+                self.rule.apply(self._row(i), g, self._states[i])
+
+    def __len__(self):
+        with self._mu:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._mu:
+            return {"rows": {k: v.copy() for k, v in self._rows.items()},
+                    "states": copy.deepcopy(self._states)}
+
+    def load_state_dict(self, d):
+        with self._mu:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in d["rows"].items()}
+            self._states = copy.deepcopy(d["states"])

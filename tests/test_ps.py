@@ -1,0 +1,152 @@
+"""Parameter-server tests (reference pattern: test/legacy_test PS-mode
+tests — server/worker roles, dense+sparse push/pull, async-SGD training)."""
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.distributed.ps import (
+    AdamRule, DenseTable, PsClient, PsRole, PsServer, SGDRule, SparseTable,
+    TableConfig, TheOnePs)
+
+
+def test_tables_rules():
+    d = DenseTable((4, 3), SGDRule(lr=0.5), initializer="zeros")
+    d.push(np.ones((4, 3), np.float32))
+    np.testing.assert_allclose(d.pull(), -0.5)
+
+    s = SparseTable(dim=4, rule=SGDRule(lr=1.0), initializer="zeros")
+    rows = s.pull([5, 9, 5])
+    assert rows.shape == (3, 4) and len(s) == 2
+    # duplicate ids accumulate in one push (reference accessor semantics)
+    s.push([5, 5], np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(s.pull([5]), -2.0)
+    np.testing.assert_allclose(s.pull([9]), 0.0)
+
+    a = DenseTable((2,), AdamRule(lr=0.1), initializer="zeros")
+    for _ in range(3):
+        a.push(np.ones(2, np.float32))
+    assert np.all(a.pull() < 0)
+
+
+def test_server_client_roundtrip():
+    server = PsServer([
+        TableConfig(0, "dense", shape=(3, 2), rule="sgd", lr=0.1,
+                    initializer="zeros"),
+        TableConfig(1, "sparse", dim=2, rule="sgd", lr=1.0,
+                    initializer="zeros"),
+    ])
+    client = PsClient(server.endpoint)
+    try:
+        w = client.pull_dense(table=0)
+        assert w.shape == (3, 2)
+        client.push_dense(np.ones((3, 2)), table=0)
+        np.testing.assert_allclose(client.pull_dense(table=0), -0.1,
+                                   rtol=1e-6)
+        client.set_dense(np.full((3, 2), 7.0), table=0)
+        np.testing.assert_allclose(client.pull_dense(table=0), 7.0)
+
+        rows = client.pull_sparse([3, 8], table=1)
+        assert rows.shape == (2, 2)
+        client.push_sparse([3], np.ones((1, 2)), table=1)
+        np.testing.assert_allclose(client.pull_sparse([3], table=1), -1.0)
+
+        # save/load round-trip
+        snap = client.save()
+        client.push_dense(np.ones((3, 2)), table=0)
+        client.load(snap)
+        np.testing.assert_allclose(client.pull_dense(table=0), 7.0)
+
+        # unknown op surfaces server-side errors
+        with pytest.raises(RuntimeError):
+            client._call("bogus")
+
+        # a malformed request must not kill the serve loop (review regression)
+        import pickle as _p
+        slot = client.store.add(f"ps/0/req_count", 1) - 1
+        client.store.set(f"ps/0/req/{slot}", b"\x00not-pickle")
+        np.testing.assert_allclose(client.pull_dense(table=0), 7.0)
+
+        # two default-id clients must not cross replies (review regression)
+        c2 = PsClient(server.endpoint)
+        assert c2._token != client._token
+        np.testing.assert_allclose(c2.pull_dense(table=0), 7.0)
+        c2.close()
+    finally:
+        client.stop_server()
+        client.close()
+        server.stop()
+
+
+def test_async_sgd_embedding_regression_converges():
+    """Two async workers train a sparse embedding + dense head against a
+    linear target; loss must drop (the reference's async PS training loop,
+    dense compute on-device, rows over the PS channel)."""
+    vocab, dim = 50, 8
+    rng = np.random.default_rng(0)
+    true_emb = rng.normal(0, 1, (vocab, dim)).astype(np.float32)
+    w_true = rng.normal(0, 1, (dim,)).astype(np.float32)
+
+    server = PsServer([
+        TableConfig(0, "sparse", dim=dim, rule="sgd", lr=0.3),
+        TableConfig(1, "dense", shape=(dim,), rule="sgd", lr=0.05,
+                    initializer="normal"),
+    ])
+
+    @jax.jit
+    def grads(rows, w, y):
+        def loss_fn(rows, w):
+            pred = rows @ w
+            return jnp.mean((pred - y) ** 2)
+        l, g = jax.value_and_grad(loss_fn, argnums=(0, 1))(rows, w)
+        return l, g[0], g[1]
+
+    losses = {0: [], 1: []}
+
+    def worker(cid):
+        c = PsClient(server.endpoint, client_id=cid)
+        r = np.random.default_rng(cid)
+        for _ in range(150):
+            ids = r.integers(0, vocab, size=16)
+            y = jnp.asarray(true_emb[ids] @ w_true)
+            rows = jnp.asarray(c.pull_sparse(ids, table=0))
+            w = jnp.asarray(c.pull_dense(table=1))
+            l, gr, gw = grads(rows, w, y)
+            c.push_sparse(ids, np.asarray(gr), table=0)
+            c.push_dense(np.asarray(gw), table=1)
+            losses[cid].append(float(l))
+        c.close()
+
+    try:
+        ts = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        for cid in (0, 1):
+            assert len(losses[cid]) == 150
+            early = np.mean(losses[cid][:10])
+            late = np.mean(losses[cid][-10:])
+            assert late < early * 0.2, (cid, early, late)
+    finally:
+        server.stop()
+
+
+def test_the_one_ps_roles():
+    srv = TheOnePs(PsRole.SERVER,
+                   configs=[TableConfig(0, "dense", shape=(2,), rule="sgd",
+                                        initializer="zeros")])
+    wrk = TheOnePs(PsRole.WORKER, endpoint=srv.endpoint)
+    try:
+        wrk.client.push_dense(np.ones(2))
+        assert wrk.client.pull_dense().shape == (2,)
+    finally:
+        wrk.stop()
+        srv.stop()
+    with pytest.raises(ValueError):
+        TheOnePs(PsRole.SERVER)
+    with pytest.raises(ValueError):
+        TheOnePs(PsRole.WORKER)
