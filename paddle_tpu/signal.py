@@ -84,6 +84,9 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
         pad = n_fft // 2
         widths = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
         x = jnp.pad(x, widths, mode=pad_mode)
+    if jnp.iscomplexobj(x) and onesided:
+        raise ValueError("stft: onesided must be False for complex input "
+                         "(reference: python/paddle/signal.py stft check)")
     frames = _frames_last(x, n_fft, hop_length)   # [..., F, n_fft]
     frames = frames * window
     if jnp.iscomplexobj(x) or not onesided:
