@@ -49,8 +49,8 @@ class MelSpectrogram(Layer):
                  hop_length: Optional[int] = 512,
                  win_length: Optional[int] = None, window: str = "hann",
                  power: float = 2.0, center: bool = True,
-                 pad_mode: str = "reflect", n_mels: int = 128,
-                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
                  htk: bool = False, norm="slaney", dtype: str = "float32"):
         super().__init__()
         self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
@@ -68,12 +68,12 @@ class MelSpectrogram(Layer):
 class LogMelSpectrogram(Layer):
     """(layers.py:237) power_to_db(MelSpectrogram)."""
 
-    def __init__(self, sr: int = 22050, n_fft: int = 2048,
-                 hop_length: Optional[int] = 512,
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
                  power: float = 2.0, center: bool = True,
-                 pad_mode: str = "reflect", n_mels: int = 128,
-                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
                  htk: bool = False, norm="slaney", ref_value: float = 1.0,
                  amin: float = 1e-10, top_db: Optional[float] = None,
                  dtype: str = "float32"):
@@ -91,12 +91,12 @@ class LogMelSpectrogram(Layer):
 class MFCC(Layer):
     """(layers.py:344) DCT of log-mel, [N, n_mfcc, num_frames]."""
 
-    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 2048,
-                 hop_length: Optional[int] = 512,
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
                  power: float = 2.0, center: bool = True,
-                 pad_mode: str = "reflect", n_mels: int = 128,
-                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
                  htk: bool = False, norm="slaney", ref_value: float = 1.0,
                  amin: float = 1e-10, top_db: Optional[float] = None,
                  dtype: str = "float32"):

@@ -27,6 +27,7 @@ reference derives higher-order AD from composite rules.
 from __future__ import annotations
 
 import contextlib
+import math
 from typing import Callable, Dict, Iterable, List, Optional
 
 import jax
@@ -166,10 +167,16 @@ def _gelu(x, approximate=False):
 @register_decomp("layer_norm")
 def _layer_norm(x, normalized_shape=None, weight=None, bias=None,
                 epsilon=1e-5, name=None):
-    del normalized_shape, name  # rule normalizes the trailing axis
-    mu = jnp.mean(x, axis=-1, keepdims=True)
+    del name
+    if normalized_shape is None:
+        axes = (-1,)
+    else:
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        axes = tuple(range(-len(normalized_shape), 0))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
     xc = x - mu
-    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    var = jnp.mean(xc * xc, axis=axes, keepdims=True)
     y = xc * lax.rsqrt(var + epsilon)
     if weight is not None:
         y = y * weight
@@ -193,8 +200,13 @@ def _rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
 
 @register_decomp("mean")
 def _mean(x, axis=None, keepdim=False):
-    return jnp.sum(x, axis=axis, keepdims=keepdim) / (
-        x.size if axis is None else x.shape[axis])
+    if axis is None:
+        n = x.size
+    elif isinstance(axis, (tuple, list)):
+        n = math.prod(x.shape[a] for a in axis)
+    else:
+        n = x.shape[axis]
+    return jnp.sum(x, axis=axis, keepdims=keepdim) / n
 
 
 @register_decomp("squared_l2_norm")

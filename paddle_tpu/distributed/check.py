@@ -77,7 +77,7 @@ def check_scatter_like_shape(tensor, nranks: int, scatter_dim: int = 0,
     """(static_check.cc ScatterLikeShape) the scattered dim must divide
     evenly by the group size."""
     shape, _ = _shape_dtype(tensor)
-    data_shape = shape[1:] if len(shape) > 1 else shape
+    data_shape = shape[1:]
     if not data_shape or data_shape[scatter_dim] % nranks != 0:
         raise CommCheckError(
             f"{op_name}: dim {scatter_dim} of per-rank shape {data_shape} "

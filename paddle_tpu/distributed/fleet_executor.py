@@ -458,7 +458,12 @@ class FleetExecutor:
         if cluster:
             for r, (host, port) in cluster.items():
                 if r != rank:
-                    self.carrier.connect(r, host, port)
+                    if not self.carrier.connect(r, host, port):
+                        self.carrier.shutdown()  # close listener + peers
+                        raise RuntimeError(
+                            f"fleet executor rank {rank}: failed to connect "
+                            f"to peer rank {r} at {host}:{port}; messages to "
+                            f"that rank would be silently dropped")
 
         for it in self.interceptors.values():
             it.start()

@@ -18,6 +18,7 @@ from __future__ import annotations
 import pickle
 import threading
 import uuid
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -67,13 +68,37 @@ class PsServer:
     def endpoint(self):
         return f"{self.store.host}:{self.store.port}"
 
+    # a slot is claimed (req_count incremented) before its request body is
+    # written; a client that dies in between would stall the strictly-ordered
+    # serve loop forever, so an unwritten-but-claimed slot is abandoned after
+    # this many consecutive 0.5 s poll timeouts
+    _SLOT_TIMEOUTS = 20
+
     def _serve(self):
+        slot_misses = 0
+        abandoned: list[int] = []
         while not self._stop.is_set():
+            self._sweep_abandoned(abandoned)
             key = f"ps/{self.server_id}/req/{self._served}"
             try:
                 raw = self.store.get(key, timeout=0.5)
             except Exception:
+                try:
+                    claimed = self.store.add(f"ps/{self.server_id}/req_count", 0)
+                except Exception:
+                    continue
+                if claimed > self._served:
+                    slot_misses += 1
+                    if slot_misses >= self._SLOT_TIMEOUTS:
+                        warnings.warn(
+                            f"ps server {self.server_id}: abandoning request "
+                            f"slot {self._served} (claimed but never written "
+                            f"— client likely died)")
+                        abandoned.append(self._served)
+                        self._served += 1
+                        slot_misses = 0
                 continue
+            slot_misses = 0
             self._served += 1
             self.store.delete_key(key)
             # one malformed request must not kill the serve thread: decode
@@ -98,6 +123,28 @@ class PsServer:
             except Exception as e:  # served back to the client
                 reply = {"ok": False, "err": repr(e)}
             self.store.set(reply_key, pickle.dumps(reply))
+
+    def _sweep_abandoned(self, abandoned: list) -> None:
+        """A slow-but-alive client may write an abandoned slot's request
+        after the serve loop gave up on it; answer with an explicit error
+        (so the client fails fast instead of a silent reply timeout) and
+        delete the orphaned key so it doesn't leak in the store."""
+        for slot in abandoned[:]:
+            key = f"ps/{self.server_id}/req/{slot}"
+            try:
+                raw = self.store.get(key, timeout=0.01)
+            except Exception:
+                continue
+            abandoned.remove(slot)
+            self.store.delete_key(key)
+            try:
+                reply_key = pickle.loads(raw)["reply"]
+            except Exception:
+                continue
+            self.store.set(reply_key, pickle.dumps(
+                {"ok": False,
+                 "err": f"request slot {slot} was abandoned by the server "
+                        f"(written too late)"}))
 
     def _dispatch(self, op: str, req: dict):
         t = self.tables[req.get("table", 0)]

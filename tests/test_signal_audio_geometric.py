@@ -123,6 +123,27 @@ def test_feature_layers_pipeline():
                                rtol=2e-3, atol=2e-3)
 
 
+def test_feature_layer_reference_defaults():
+    """Default-constructed layers must match reference defaults
+    (audio/features/layers.py: MelSpectrogram n_mels=64/f_min=50;
+    LogMelSpectrogram & MFCC additionally n_fft=512/hop_length=None)."""
+    x = jnp.asarray(np.random.randn(1, 22050).astype(np.float32) * 0.1)
+    mel = audio.MelSpectrogram()  # sr=22050, n_fft=2048, hop=512, n_mels=64
+    out = mel(x)
+    assert out.shape[:2] == (1, 64)
+    assert mel.fbank_matrix.shape == (64, 1025)
+    # f_min=50 → the lowest-frequency bins get no filter weight
+    assert float(np.abs(np.asarray(mel.fbank_matrix)[:, :3]).sum()) == 0.0
+    logmel = audio.LogMelSpectrogram()  # n_fft=512, hop=None → 128
+    out = logmel(x)
+    assert out.shape[:2] == (1, 64)
+    assert out.shape[2] == 1 + 22050 // 128  # hop_length None → n_fft//4
+    mfcc = audio.MFCC()  # n_mfcc=40 over the same log-mel
+    out = mfcc(x)
+    assert out.shape[:2] == (1, 40)
+    assert out.shape[2] == 1 + 22050 // 128
+
+
 # ------------------------------------------------------------- geometric
 def test_segment_ops_golden():
     data = jnp.asarray([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]])
