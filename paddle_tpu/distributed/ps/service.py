@@ -77,8 +77,15 @@ class PsServer:
     def _serve(self):
         slot_misses = 0
         abandoned: list[int] = []
+        sweep_tick = 0
         while not self._stop.is_set():
-            self._sweep_abandoned(abandoned)
+            # sweep rarely: each abandoned slot costs a 10 ms blocking poll,
+            # so checking every iteration would tax steady-state latency
+            sweep_tick += 1
+            if abandoned and sweep_tick % 50 == 0:
+                self._sweep_abandoned(abandoned)
+                del abandoned[:-64]  # age out; orphans older than 64 slots
+                #                      were answered or will never arrive
             key = f"ps/{self.server_id}/req/{self._served}"
             try:
                 raw = self.store.get(key, timeout=0.5)

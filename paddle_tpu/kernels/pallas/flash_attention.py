@@ -1,9 +1,9 @@
 """Tiled flash attention for TPU (Pallas).
 
 TPU-native replacement for the reference's CUDA FlashAttention-2 integration
-(reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu and
-third_party/flashattn; Python surface python/paddle/nn/functional/
-flash_attention.py:242).
+(reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu — dense :68 and
+varlen :213 entry points; third_party/flashattn; Python surface
+python/paddle/nn/functional/flash_attention.py:242,976,1098).
 
 Design (FlashAttention-2 style, mapped onto the TPU memory hierarchy):
   * grid = (batch*heads, q_blocks, k_blocks); the k axis is innermost so the
@@ -11,20 +11,45 @@ Design (FlashAttention-2 style, mapped onto the TPU memory hierarchy):
     the scores matrix never exists in HBM (O(S) memory instead of O(S^2)).
   * QK^T and PV run on the MXU with fp32 accumulation
     (preferred_element_type); rescaling on the VPU.
-  * causal masking skips whole blocks above the diagonal (predicated with
-    pl.when) and applies an iota mask only on diagonal-straddling blocks.
+  * fully-masked blocks are skipped (predicated with pl.when) from the
+    *structure* of the mask — causal diagonal and sliding-window band — not
+    from a dense mask tensor; structured masks that can't be block-skipped
+    (segments, flashmask rows, additive bias) are applied elementwise inside
+    the tile, still O(S) HBM.
   * backward = two kernels (dkv with q innermost; dq with k innermost) using
     the saved logsumexp and a precomputed delta = rowsum(dO * O), per the
     FlashAttention-2 backward recurrence.
 
+Mask/variant support (all inside the kernel — nothing falls back to an
+O(S^2) composed path):
+  * causal, bottom-right aligned when sq != sk (matches the composed
+    reference and FlashAttention-2 semantics);
+  * GQA/MQA native: key/value may carry fewer heads (H % H_kv == 0); KV
+    blocks are *indexed* per query-head group via BlockSpec index maps — KV
+    is never repeated in HBM (reference repeats via expand before the CUDA
+    kernel when num_heads differ);
+  * packed-varlen segment ids (q/kv position → sequence id; cross-segment
+    scores masked) — the TPU analogue of the reference's cu_seqlens varlen
+    kernel (flash_attn_kernel.cu:213);
+  * sliding window (left, right) with block-level skipping;
+  * flashmask start/end row indices per key column ([B, 1|H, Sk] each; key
+    j masked for queries start<=q<end — the reference's
+    flashmask_attention O(S) mask representation);
+  * additive bias [1|B, 1|H, Sq, Sk] (covers bool masks converted to 0/-inf);
+  * dropout via the in-kernel TPU PRNG: the forward draws the keep mask from
+    (seed, head, q_block, k_block) and the backward re-derives the identical
+    mask from the same counters — no O(S^2) mask tensor is ever saved
+    (reference: philox seed/offset round-tripped through the CUDA kernel).
+
 Layouts: public API takes paddle convention [B, S, H, D]; kernels run on
-[B*H, S, D].
+[B*H, S, D] (queries) and [B*H_kv, S, D] (keys/values).
 """
 
 from __future__ import annotations
 
 import functools
 import math
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,38 +75,176 @@ def _pick_block(s: int, target: int = 1024) -> int:
     return max(b, 1)
 
 
+def _block_target(has_extras: bool) -> int:
+    # extra per-tile inputs (bias block, dropout bits) eat VMEM; shrink the
+    # scores tile so (scores + bias + bits) still fits comfortably
+    return 512 if has_extras else 1024
+
+
 def supported(query, key, value, attn_mask=None, dropout_p=0.0,
               is_causal=False, *args, **kwargs) -> bool:
-    """Gate for registry dispatch: the tiled kernel handles dense/causal
-    attention without dropout or ad-hoc masks; anything else falls back to
-    the XLA-composed reference op."""
-    if attn_mask is not None or dropout_p > 0.0:
-        return False
-    if query.ndim != 4 or key.ndim != 4 or value.ndim != 4:
+    """Gate for registry dispatch. The tiled kernel handles dense/causal/
+    masked/GQA attention with dropout; remaining fallbacks: rank != 4,
+    head_dim > 256, sequence lengths not multiples of 128 (pad upstream),
+    or a mask that isn't [1|B, 1|H, Sq, Sk]."""
+    if getattr(query, "ndim", 0) != 4 or key.ndim != 4 or value.ndim != 4:
         return False
     b, sq, h, d = query.shape
-    sk = key.shape[1]
-    if key.shape != (b, sk, h, d):  # GQA handled by the caller via head repeat
+    kb, sk, h_kv, kd = key.shape
+    if kb != b or kd != d or tuple(value.shape) != tuple(key.shape):
         return False
-    if tuple(value.shape) != tuple(key.shape):
-        return False
-    if is_causal and sq != sk:
+    if h_kv == 0 or h % h_kv != 0:
         return False
     if d > 256:
         return False
-    # blocks must tile the sequence exactly at lane granularity
-    # (pad upstream otherwise)
-    return sq % 128 == 0 and sk % 128 == 0
+    if not (sq % 128 == 0 and sk % 128 == 0):
+        return False
+    if attn_mask is not None:
+        if getattr(attn_mask, "ndim", 0) != 4:
+            return False
+        mb, mh, msq, msk = attn_mask.shape
+        if (msq, msk) != (sq, sk) or mb not in (1, b) or mh not in (1, h):
+            return False
+    if dropout_p and not 0.0 <= float(dropout_p) < 1.0:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# in-kernel mask application / block skipping
+# ---------------------------------------------------------------------------
+
+def _mask_scores(s, i, j, *, block_q, block_k, causal, offset, window,
+                 bias=None, qseg=None, kseg=None, fm_start=None, fm_end=None):
+    """Apply bias + structured masks to a scores tile. i/j are q/k block
+    ids; offset aligns causal bottom-right for sq != sk."""
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    need_pos = causal or window is not None or fm_start is not None
+    qpos = kpos = None
+    if need_pos:
+        qpos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+    masked = None
+
+    def _or(a, b):
+        return b if a is None else a | b
+
+    if causal:
+        masked = _or(masked, kpos > qpos + offset)
+    if window is not None:
+        left, right = window
+        if left is not None:
+            masked = _or(masked, kpos < qpos + offset - left)
+        if right is not None:
+            masked = _or(masked, kpos > qpos + offset + right)
+    if qseg is not None:
+        masked = _or(masked, qseg[:, None] != kseg[None, :])
+    if fm_start is not None:
+        masked = _or(masked, (qpos >= fm_start[None, :])
+                     & (qpos < fm_end[None, :]))
+    if masked is not None:
+        s = jnp.where(masked, _NEG_INF, s)
+    return s
+
+
+def _block_run(i, j, *, block_q, block_k, causal, offset, window):
+    """True iff block (i, j) can contain any unmasked score, from the
+    causal diagonal and window band alone (segments/flashmask/bias are
+    handled elementwise)."""
+    run = None
+    q_lo = i * block_q
+    q_hi = i * block_q + block_q - 1
+    k_lo = j * block_k
+    k_hi = j * block_k + block_k - 1
+
+    def _and(a, b):
+        return b if a is None else jnp.logical_and(a, b)
+
+    if causal:
+        run = _and(run, k_lo <= q_hi + offset)
+    if window is not None:
+        left, right = window
+        if left is not None:
+            run = _and(run, k_hi >= q_lo + offset - left)
+        if right is not None:
+            run = _and(run, k_lo <= q_hi + offset + right)
+    return True if run is None else run
+
+
+def _last_k_block(i, num_k, *, block_q, block_k, causal, offset, window):
+    """Index of the last k block that runs for q block i (finalize point)."""
+    if not causal and (window is None or window[1] is None):
+        return num_k - 1
+    hi = i * block_q + block_q - 1 + offset
+    if causal and window is not None and window[1] is not None:
+        hi = hi + 0  # causal is the tighter bound (right >= 0)
+    elif window is not None and window[1] is not None and not causal:
+        hi = hi + window[1]
+    return jnp.clip(hi // block_k, 0, num_k - 1)
+
+
+def _dropout_keep(seed_ref, bh, i, j, num_q, num_k, shape, dropout_p):
+    """Deterministic keep-mask for tile (bh, i, j): forward and backward
+    re-derive identical bits from the same counters. Mosaic allows at most
+    two seed words, so the tile coordinates fold into one id."""
+    tile = (bh * num_q + i) * num_k + j
+    pltpu.prng_seed(seed_ref[0], tile)
+    bits = pltpu.prng_random_bits(shape)
+    thresh = min(int(dropout_p * 2.0 ** 32), 2 ** 32 - 1)
+    return bits.astype(jnp.uint32) >= jnp.uint32(thresh)
+
+
+def _unpack_refs(refs, *, n_main, has_bias, has_seg, has_fm, dropout_p):
+    """Split positional pallas refs into (seed, main tensors, mask refs,
+    outputs+scratch) by the active feature flags — ONE walk shared by all
+    three kernels so the layouts cannot drift from the input assembly in
+    _fwd/_bwd_impl."""
+    idx = 0
+    seed = None
+    if dropout_p:
+        seed = refs[idx]; idx += 1
+    main = refs[idx:idx + n_main]; idx += n_main
+    bias = qseg = kseg = fms = fme = None
+    if has_bias:
+        bias = refs[idx]; idx += 1
+    if has_seg:
+        qseg, kseg = refs[idx:idx + 2]; idx += 2
+    if has_fm:
+        fms, fme = refs[idx:idx + 2]; idx += 2
+    return seed, main, (bias, qseg, kseg, fms, fme), refs[idx:]
+
+
+def _mask_ref_args(masks):
+    """Materialize the per-tile mask operands for _mask_scores."""
+    bias_ref, qseg_ref, kseg_ref, fms_ref, fme_ref = masks
+    return dict(
+        bias=bias_ref[0, 0] if bias_ref is not None else None,
+        qseg=qseg_ref[0, 0] if qseg_ref is not None else None,
+        kseg=kseg_ref[0, 0] if kseg_ref is not None else None,
+        fm_start=fms_ref[0, 0] if fms_ref is not None else None,
+        fm_end=fme_ref[0, 0] if fme_ref is not None else None)
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *, sm_scale, causal, block_q, block_k,
-                num_k):
-    """lse_ref is None on the inference path (no residual HBM write)."""
+def _fwd_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
+                num_k, has_bias, has_seg, has_fm, dropout_p, save_lse):
+    seed_ref, (q_ref, k_ref, v_ref), masks, rest = _unpack_refs(
+        refs, n_main=3, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
+        dropout_p=dropout_p)
+    if save_lse:
+        o_ref, lse_ref = rest[0], rest[1]
+        acc_sc, m_sc, l_sc = rest[2:5]
+    else:
+        o_ref, lse_ref = rest[0], None
+        acc_sc, m_sc, l_sc = rest[1:4]
+
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -91,10 +254,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    # causal: block (i, j) contributes iff some k pos <= some q pos
-    run = True
-    if causal:
-        run = j * block_k <= i * block_q + block_q - 1
+    run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
+                     offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -104,14 +265,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
-            # mask only matters on diagonal-straddling blocks, but applying
-            # it unconditionally inside the predicated body is branch-free
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        s = _mask_scores(
+            s, i, j, block_q=block_q, block_k=block_k, causal=causal,
+            offset=offset, window=window, **_mask_ref_args(masks))
         m_prev = m_sc[:, 0]                      # [bq]
         m_cur = jnp.max(s, axis=1)               # [bq]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -120,16 +276,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_sc[:] = (l_sc[:] * alpha[:, None]
                    + jnp.sum(p, axis=1)[:, None])
         m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        if dropout_p:
+            keep = _dropout_keep(seed_ref, b, i, j, pl.num_programs(1),
+                                  num_k, p.shape, dropout_p)
+            p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            p_use = p
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc_sc[:] = acc_sc[:] * alpha[:, None] + pv
 
-    if causal:
-        j_last = jnp.minimum(num_k - 1,
-                             (i * block_q + block_q - 1) // block_k)
-    else:
-        j_last = num_k - 1
+    j_last = _last_k_block(i, num_k, block_q=block_q, block_k=block_k,
+                           causal=causal, offset=offset, window=window)
 
     @pl.when(j == j_last)
     def _finalize():
@@ -141,34 +300,129 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, save_lse=True):
+def _bias_index(fwd_grid, bias_shape, h, h_kv, g, nq):
+    """Index map for the [1|B, 1|H, Sq, Sk] bias under a given grid
+    convention. fwd_grid: True for (bh, i, j) grids (fwd/dq), False for the
+    dkv grid (bh_kv, j, t)."""
+    mb, mh = bias_shape[0], bias_shape[1]
+    if fwd_grid:
+        def idx(b, i, j):
+            bi = b // h if mb > 1 else 0
+            hi = b % h if mh > 1 else 0
+            return (bi, hi, i, j)
+    else:
+        def idx(bkv, j, t):
+            bi = bkv // h_kv if mb > 1 else 0
+            hi = ((bkv % h_kv) * g + t // nq) if mh > 1 else 0
+            return (bi, hi, t % nq, j)
+    return idx
+
+
+def _build_specs(*, grid_kind, h, h_kv, g, nq, block_q, block_k, d,
+                 bias_shape, has_seg, has_fm, dropout_p):
+    """in_specs tail (bias/segments/flashmask) shared by fwd/dq/dkv, plus
+    the optional SMEM seed spec at the head."""
+    fwd_grid = grid_kind in ("fwd", "dq")
+    head = []
+    if dropout_p:
+        head.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    tail = []
+    if bias_shape is not None:
+        tail.append(pl.BlockSpec(
+            (1, 1, block_q, block_k),
+            _bias_index(fwd_grid, bias_shape, h, h_kv, g, nq)))
+    if has_seg:
+        # segment ids ride as [B, 1, S]: block (1, 1, block) keeps the
+        # second-to-last block dim equal to the array dim (TPU tiling rule)
+        if fwd_grid:
+            qidx = lambda b, i, j: (b // h, 0, i)
+            kidx = lambda b, i, j: (b // h, 0, j)
+        else:
+            qidx = lambda bkv, j, t: (bkv // h_kv, 0, t % nq)
+            kidx = lambda bkv, j, t: (bkv // h_kv, 0, j)
+        tail.append(pl.BlockSpec((1, 1, block_q), qidx))
+        tail.append(pl.BlockSpec((1, 1, block_k), kidx))
+    if has_fm:
+        # flashmask arrays ride flattened as [B*Hm, 1, Sk] (same tiling rule)
+        def fm_idx_factory(mh):
+            if fwd_grid:
+                def idx(b, i, j):
+                    return (b // h * mh + (b % h if mh > 1 else 0), 0, j)
+            else:
+                def idx(bkv, j, t):
+                    hi = ((bkv % h_kv) * g + t // nq) if mh > 1 else 0
+                    return (bkv // h_kv * mh + hi, 0, j)
+            return idx
+        tail.append(None)  # placeholder; filled by caller with mh known
+        tail.append(None)
+        return head, tail, fm_idx_factory
+    return head, tail, None
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
+         bias=None, qseg=None, kseg=None, fm_start=None, fm_end=None,
+         window=None, dropout_p=0.0, seed=None, save_lse=True):
+    """q: [B*H, Sq, D]; k/v: [B*H_kv, Sk, D]."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    g = h // h_kv
     nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
     grid = (bh, nq, nk)
-    base = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k=nk)
+    fm_mh = None
+    if qseg is not None:
+        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
+    if fm_start is not None:
+        fm_mh = fm_start.shape[1]
+        fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
+        fm_end = fm_end.reshape(-1, 1, fm_end.shape[-1])
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+        window=window, block_q=block_q, block_k=block_k, num_k=nk,
+        has_bias=bias is not None, has_seg=qseg is not None,
+        has_fm=fm_start is not None, dropout_p=dropout_p, save_lse=save_lse)
+
+    kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+    ]
+    head, tail, fm_idx_factory = _build_specs(
+        grid_kind="fwd", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
+        block_k=block_k, d=d, bias_shape=None if bias is None else bias.shape,
+        has_seg=qseg is not None, has_fm=fm_start is not None,
+        dropout_p=dropout_p)
+    if fm_idx_factory is not None:
+        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+    in_specs = head + in_specs + tail
+
+    inputs = []
+    if dropout_p:
+        inputs.append(seed)
+    inputs += [q, k, v]
+    if bias is not None:
+        inputs.append(bias)
+    if qseg is not None:
+        inputs += [qseg, kseg]
+    if fm_start is not None:
+        inputs += [fm_start, fm_end]
+
     ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     lspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     if save_lse:
-        kernel = base
         out_specs = [ospec, lspec]
         out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
                      jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32)]
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc):
-            base(q_ref, k_ref, v_ref, o_ref, None, acc_sc, m_sc, l_sc)
         out_specs = ospec
         out_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
     res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -177,7 +431,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, save_lse=True):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     if save_lse:
         out, lse = res
         return out, lse[:, :, 0]
@@ -188,20 +442,28 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, save_lse=True):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_sc, dv_sc, *, sm_scale, causal,
-                block_q, block_k, num_q):
-    j = pl.program_id(1)  # k block
-    i = pl.program_id(2)  # q block (innermost: carry dk/dv across q)
+def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
+                num_q, num_t, h, h_kv, g, has_bias, has_seg, has_fm,
+                dropout_p):
+    seed_ref, main, masks, rest = _unpack_refs(
+        refs, n_main=6, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
+        dropout_p=dropout_p)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = main
+    dk_ref, dv_ref, dk_sc, dv_sc = rest
 
-    @pl.when(i == 0)
+    bkv = pl.program_id(0)
+    j = pl.program_id(1)   # k block
+    t = pl.program_id(2)   # (q head in group) * num_q + q block — innermost
+    i = t % num_q
+    bh_q = bkv // h_kv * h + (bkv % h_kv) * g + t // num_q
+
+    @pl.when(t == 0)
     def _init():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    run = True
-    if causal:
-        run = i * block_q + block_q - 1 >= j * block_k
+    run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
+                     offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -214,34 +476,46 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
-        # dv += P^T dO
-        dv_sc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dP = dO V^T ; dS = P*(dP - delta)*scale
+        s = _mask_scores(
+            s, i, j, block_q=block_q, block_k=block_k, causal=causal,
+            offset=offset, window=window, **_mask_ref_args(masks))
+        p = jnp.exp(s - lse[:, None])  # [bq, bk], undropped softmax
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p:
+            keep = _dropout_keep(seed_ref, bh_q, i, j, num_q,
+                                 pl.num_programs(1), p.shape, dropout_p)
+            inv_keep = 1.0 / (1.0 - dropout_p)
+            p_drop = jnp.where(keep, p * inv_keep, 0.0)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        else:
+            p_drop = p
+        # dv += D(P)^T dO
+        dv_sc[:] += jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P*(dP∘M/keep - delta)*scale
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_sc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == num_q - 1)
+    @pl.when(t == num_t - 1)
     def _finalize():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_sc, *, sm_scale, causal, block_q, block_k, num_k):
+def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
+               num_k, has_bias, has_seg, has_fm, dropout_p):
+    seed_ref, main, masks, rest = _unpack_refs(
+        refs, n_main=6, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
+        dropout_p=dropout_p)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = main
+    dq_ref, dq_sc = rest
+
+    b = pl.program_id(0)
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block (innermost: carry dq)
 
@@ -249,9 +523,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    run = True
-    if causal:
-        run = j * block_k <= i * block_q + block_q - 1
+    run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
+                     offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -264,100 +537,139 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        s = _mask_scores(
+            s, i, j, block_q=block_q, block_k=block_k, causal=causal,
+            offset=offset, window=window, **_mask_ref_args(masks))
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p:
+            keep = _dropout_keep(seed_ref, b, i, j, pl.num_programs(1),
+                                  num_k, p.shape, dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta[:, None]) * sm_scale
         dq_sc[:] += jax.lax.dot_general(
             ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        j_last = jnp.minimum(num_k - 1,
-                             (i * block_q + block_q - 1) // block_k)
-    else:
-        j_last = num_k - 1
+    j_last = _last_k_block(i, num_k, block_q=block_q, block_k=block_k,
+                           causal=causal, offset=offset, window=window)
 
     @pl.when(j == j_last)
     def _finalize():
         dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
 
-def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k):
+def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
+              h, h_kv, bias=None, qseg=None, kseg=None, fm_start=None,
+              fm_end=None, window=None, dropout_p=0.0, seed=None):
     bh, sq, d = q.shape
-    sk = k.shape[1]
+    bh_kv, sk, _ = k.shape
+    g = h // h_kv
     nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [bh, sq]
     lse_r = jnp.broadcast_to(lse[:, :, None], (bh, sq, _LANES))
     delta_r = jnp.broadcast_to(delta[:, :, None], (bh, sq, _LANES))
 
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    rspec = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0))
+    fm_mh = None
+    if qseg is not None:
+        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
+    if fm_start is not None:
+        fm_mh = fm_start.shape[1]
+        fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
+        fm_end = fm_end.reshape(-1, 1, fm_end.shape[-1])
+
+    bias_shape = None if bias is None else bias.shape
+    has_seg = qseg is not None
+    has_fm = fm_start is not None
+
+    extra_inputs = []
+    if bias is not None:
+        extra_inputs.append(bias)
+    if has_seg:
+        extra_inputs += [qseg, kseg]
+    if has_fm:
+        extra_inputs += [fm_start, fm_end]
+    seed_inputs = [seed] if dropout_p else []
+
+    # ---- dk/dv: grid (B*H_kv, k blocks, group*q blocks) — the q-head
+    # group is folded into the innermost axis so GQA reductions accumulate
+    # in the VMEM scratch rather than racing on an HBM block.
+    num_t = g * nq
+    qspec = pl.BlockSpec(
+        (1, block_q, d),
+        lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
+                           t % nq, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
+    rspec = pl.BlockSpec(
+        (1, block_q, _LANES),
+        lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
+                           t % nq, 0))
+    head, tail, fm_idx_factory = _build_specs(
+        grid_kind="dkv", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
+        block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
+        has_fm=has_fm, dropout_p=dropout_p)
+    if fm_idx_factory is not None:
+        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=nq),
-        grid=(bh, nk, nq),
-        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+            window=window, block_q=block_q, block_k=block_k, num_q=nq,
+            num_t=num_t, h=h, h_kv=h_kv, g=g, has_bias=bias is not None,
+            has_seg=has_seg, has_fm=has_fm, dropout_p=dropout_p),
+        grid=(bh_kv, nk, num_t),
+        in_specs=head + [qspec, kspec, kspec, qspec, rspec, rspec] + tail,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse_r, delta_r)
+    )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)
 
+    # ---- dq: grid (B*H, q blocks, k blocks)
+    kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
     qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), kv_idx)
     rspec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    head, tail, fm_idx_factory = _build_specs(
+        grid_kind="dq", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
+        block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
+        has_fm=has_fm, dropout_p=dropout_p)
+    if fm_idx_factory is not None:
+        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k=nk),
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+            window=window, block_q=block_q, block_k=block_k, num_k=nk,
+            has_bias=bias is not None, has_seg=has_seg, has_fm=has_fm,
+            dropout_p=dropout_p),
         grid=(bh, nq, nk),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
+        in_specs=head + [qspec2, kspec2, kspec2, qspec2, rspec2, rspec2]
+        + tail,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse_r, delta_r)
+    )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public API ([B, S, H, D] layout, custom_vjp)
 # ---------------------------------------------------------------------------
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(query, key, value, causal=False, sm_scale=None,
-                    block_q=None, block_k=None):
-    """Fused attention. query/key/value: [B, S, H, D] → [B, S, H, D].
-
-    The primal (inference) path skips the logsumexp residual entirely — no
-    extra HBM traffic; it is produced only when jax needs the vjp."""
-    b, sq, h, d = query.shape
-    sk = key.shape[1]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    bq = block_q or _pick_block(sq)
-    bk = block_k or _pick_block(sk)
-    out, _ = _fwd(_prep(query), _prep(key), _prep(value), scale, causal,
-                  bq, bk, save_lse=False)
-    return _unprep(out, b, h)
-
 
 def _prep(x):
     b, s, h, d = x.shape
@@ -369,25 +681,118 @@ def _unprep(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-def _flash_fwd(query, key, value, causal, sm_scale, block_q, block_k):
+_STATIC = (7, 8, 9, 10, 11, 12)  # causal, sm_scale, block_q, block_k,
+#                                   window, dropout_p
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=_STATIC)
+def _flash(query, key, value, bias, q_seg, kv_seg, seed,
+           causal, sm_scale, block_q, block_k, window, dropout_p):
+    out, _ = _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
+                             causal, sm_scale, block_q, block_k, window,
+                             dropout_p, save_lse=False)
+    return out
+
+
+def _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
+                    causal, sm_scale, block_q, block_k, window, dropout_p,
+                    save_lse):
+    b, sq, h, d = query.shape
+    h_kv = key.shape[2]
+    fm_start = fm_end = None
+    if bias is not None and isinstance(bias, tuple):
+        bias, fm_start, fm_end = bias
+    q, k, v = _prep(query), _prep(key), _prep(value)
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, h=h,
+                    h_kv=h_kv, bias=bias, qseg=q_seg, kseg=kv_seg,
+                    fm_start=fm_start, fm_end=fm_end, window=window,
+                    dropout_p=dropout_p, seed=seed, save_lse=save_lse)
+    return _unprep(out, b, h), (q, k, v, out, lse, b, h, h_kv)
+
+
+def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
+               causal, sm_scale, block_q, block_k, window, dropout_p):
+    out, res = _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
+                               causal, sm_scale, block_q, block_k, window,
+                               dropout_p, save_lse=True)
+    return out, res + (bias, q_seg, kv_seg, seed)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
+               res, g):
+    q, k, v, out, lse, b, h, h_kv, bias, q_seg, kv_seg, seed = res
+    fm_start = fm_end = None
+    if bias is not None and isinstance(bias, tuple):
+        bias, fm_start, fm_end = bias
+    do = _prep(g)
+    dq, dk, dv = _bwd_impl(
+        q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, h=h,
+        h_kv=h_kv, bias=bias, qseg=q_seg, kseg=kv_seg, fm_start=fm_start,
+        fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed)
+    dbias = None
+    if bias is not None:
+        # the fast path treats the bias/mask as a constant (padding masks,
+        # flashmask rows); a *learned* bias needs the composed path
+        dbias = jax.tree_util.tree_map(jnp.zeros_like, bias)
+    return (_unprep(dq, b, h), _unprep(dk, b, h_kv), _unprep(dv, b, h_kv),
+            dbias, None, None, None)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(query, key, value, causal=False, sm_scale=None,
+                    block_q=None, block_k=None, *, bias=None,
+                    q_segment_ids=None, kv_segment_ids=None,
+                    startend_row_indices=None, window=None,
+                    dropout_p=0.0, dropout_seed=None):
+    """Fused attention. query: [B, Sq, H, D]; key/value: [B, Sk, H_kv, D]
+    with H % H_kv == 0 (GQA/MQA native — KV heads are indexed, not
+    repeated) → [B, Sq, H, D].
+
+    bias: additive [1|B, 1|H, Sq, Sk] (use 0/-1e30 for bool masks). Treated
+    as a constant by the vjp (no dbias).
+    q_segment_ids/kv_segment_ids: int32 [B, Sq]/[B, Sk] packed-varlen ids;
+    scores across different ids are masked.
+    startend_row_indices: (start, end) int32 [B, 1|H, Sk] flashmask pair —
+    key column j is masked for queries start[j] <= q < end[j].
+    window: (left, right) ints or None — sliding window around the
+    (bottom-right aligned) diagonal.
+    dropout_p/dropout_seed: attention-probability dropout drawn from the
+    in-kernel PRNG; seed is an int32 [1] array (required when p > 0).
+
+    The primal (inference) path skips the logsumexp residual entirely — no
+    extra HBM traffic; it is produced only when jax needs the vjp."""
     b, sq, h, d = query.shape
     sk = key.shape[1]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    bq = block_q or _pick_block(sq)
-    bk = block_k or _pick_block(sk)
-    q, k, v = _prep(query), _prep(key), _prep(value)
-    out, lse = _fwd(q, k, v, scale, causal, bq, bk)
-    return _unprep(out, b, h), (q, k, v, out, lse, b, h, scale)
-
-
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v, out, lse, b, h, scale = res
-    sq, sk = q.shape[1], k.shape[1]
-    bq = block_q or _pick_block(sq)
-    bk = block_k or _pick_block(sk)
-    do = _prep(g)
-    dq, dk, dv = _bwd_impl(q, k, v, out, lse, do, scale, causal, bq, bk)
-    return _unprep(dq, b, h), _unprep(dk, b, h), _unprep(dv, b, h)
-
-
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / math.sqrt(d)
+    has_extras = (bias is not None or q_segment_ids is not None
+                  or startend_row_indices is not None or dropout_p > 0)
+    target = _block_target(has_extras)
+    bq = block_q or _pick_block(sq, target)
+    bk = block_k or _pick_block(sk, target)
+    if bias is not None or q_segment_ids is not None \
+            or startend_row_indices is not None:
+        # bias/segment/flashmask BlockSpecs put the block size in the lane
+        # dim, so Mosaic needs 128-multiples there (seqs are already %128)
+        bq = bq if bq % 128 == 0 else 128
+        bk = bk if bk % 128 == 0 else 128
+    if window is not None:
+        left, right = window
+        window = (None if left is None or left < 0 else int(left),
+                  None if right is None or right < 0 else int(right))
+        if window == (None, None):
+            window = None
+    if dropout_p > 0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    if q_segment_ids is not None:
+        q_segment_ids = q_segment_ids.astype(jnp.int32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)
+    packed_bias = bias
+    if startend_row_indices is not None:
+        fm_start, fm_end = startend_row_indices
+        packed_bias = (bias, fm_start.astype(jnp.int32),
+                       fm_end.astype(jnp.int32))
+    return _flash(query, key, value, packed_bias, q_segment_ids,
+                  kv_segment_ids, dropout_seed, bool(causal), scale, bq, bk,
+                  window, float(dropout_p))

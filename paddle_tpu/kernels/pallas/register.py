@@ -8,6 +8,9 @@ flag and the per-kernel ``supported`` predicate.
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from ...ops import register_pallas_impl
 import paddle_tpu.kernels.pallas.flash_attention as fa
 import paddle_tpu.kernels.pallas.rms_norm as rn
@@ -16,8 +19,108 @@ import paddle_tpu.kernels.pallas.rms_norm as rn
 @register_pallas_impl("scaled_dot_product_attention", supported=fa.supported)
 def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
                  is_causal=False, training=True, name=None):
-    del attn_mask, dropout_p, training, name
-    return fa.flash_attention(query, key, value, is_causal)
+    del name
+    bias = None
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            # bool masks become additive in the compute dtype; -1e30 is
+            # representable in bf16 and matches the composed path's fill
+            bias = jnp.where(attn_mask, 0.0, -1e30).astype(query.dtype)
+        else:
+            bias = attn_mask
+    p, seed = _dropout_seed(dropout_p, training)
+    return fa.flash_attention(query, key, value, is_causal, bias=bias,
+                              dropout_p=p, dropout_seed=seed)
+
+
+def _dropout_seed(p, training):
+    if not (p and training):
+        return 0.0, None
+    from ...random import next_key
+    return float(p), jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
+                                        dtype=jnp.int32)
+
+
+def _unpadded_supported(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True):
+    if return_softmax or getattr(query, "ndim", 0) != 3:
+        return False
+    if query.shape[2] > 256 or key.shape[1] == 0:
+        return False
+    if query.shape[1] % key.shape[1] != 0:
+        return False
+    # packed-global causal == per-sequence causal only when q and k share
+    # the exact same packing
+    if causal and cu_seqlens_q is not cu_seqlens_k:
+        return False
+    return True
+
+
+@register_pallas_impl("flash_attn_unpadded", supported=_unpadded_supported)
+def _flash_attn_unpadded_pallas(query, key, value, cu_seqlens_q,
+                                cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, training=True):
+    """Varlen via in-kernel segment ids: pad totals to the 128-lane
+    boundary (pad rows get non-matching segment ids, so they contribute
+    nothing and their output rows are sliced off)."""
+    from ...nn.functional.flash_attention import _segments_from_cu
+    tq, h, d = query.shape
+    tk = key.shape[0]
+    seg_q = _segments_from_cu(cu_seqlens_q, tq)
+    seg_k = _segments_from_cu(cu_seqlens_k, tk)
+    pq, pk = (-tq) % 128, (-tk) % 128
+    if pq:
+        query = jnp.pad(query, ((0, pq), (0, 0), (0, 0)))
+        seg_q = jnp.pad(seg_q, (0, pq), constant_values=-1)
+    if pk:
+        key = jnp.pad(key, ((0, pk), (0, 0), (0, 0)))
+        value = jnp.pad(value, ((0, pk), (0, 0), (0, 0)))
+        seg_k = jnp.pad(seg_k, (0, pk), constant_values=-2)
+    p, seed = _dropout_seed(dropout, training)
+    out = fa.flash_attention(
+        query[None], key[None], value[None], causal, scale,
+        q_segment_ids=seg_q[None], kv_segment_ids=seg_k[None],
+        dropout_p=p, dropout_seed=seed)
+    return out[0, :tq], None
+
+
+def _flashmask_supported(query, key, value, startend_row_indices=None,
+                         dropout=0.0, causal=True, window_size=None):
+    if not fa.supported(query, key, value, dropout_p=dropout):
+        return False
+    if startend_row_indices is not None:
+        idx = startend_row_indices
+        if getattr(idx, "ndim", 0) != 4 or idx.shape[-1] not in (1, 2):
+            return False
+        b, sq, h = query.shape[0], query.shape[1], query.shape[2]
+        if idx.shape[0] != b or idx.shape[1] not in (1, h):
+            return False
+        if idx.shape[2] != key.shape[1]:
+            return False
+    return True
+
+
+@register_pallas_impl("flashmask_attention", supported=_flashmask_supported)
+def _flashmask_pallas(query, key, value, startend_row_indices=None,
+                      dropout=0.0, causal=True, window_size=None):
+    fm = None
+    if startend_row_indices is not None:
+        idx = startend_row_indices
+        start = idx[..., 0]
+        end = (idx[..., 1] if idx.shape[-1] == 2
+               else jnp.full_like(start, query.shape[1]))
+        fm = (start, end)
+    window = None
+    if window_size is not None:
+        w = window_size if isinstance(window_size, int) else window_size[0]
+        window = (int(w), None)
+    p, seed = _dropout_seed(dropout, True)
+    out = fa.flash_attention(query, key, value, causal, None,
+                             startend_row_indices=fm, window=window,
+                             dropout_p=p, dropout_seed=seed)
+    return out, None
 
 
 def _rms_supported(x, weight=None, bias=None, epsilon=1e-6,

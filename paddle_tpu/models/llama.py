@@ -116,12 +116,11 @@ def _rope(x, cos, sin):
 
 
 def _flash_gqa(q, k, v, num_heads: int, num_kv_heads: int):
-    """Expand KV groups and ride the registry attention (Pallas flash
-    kernel on TPU) — shared by the eager layer and dense_forward."""
-    g = num_heads // num_kv_heads
-    return F.scaled_dot_product_attention(
-        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
-        is_causal=True)
+    """Ride the registry attention with native GQA — the Pallas kernel
+    indexes KV heads per query-head group (no HBM head repeat); the
+    XLA-composed fallback repeats on the fly."""
+    del num_heads, num_kv_heads
+    return F.scaled_dot_product_attention(q, k, v, is_causal=True)
 
 
 def _gqa_attention(q, k, v):

@@ -28,7 +28,17 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
 
 def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
                     is_causal=False, scale=None, training=True):
-    """XLA-composed attention. q,k,v: [B, S, H, D]."""
+    """XLA-composed attention. q: [B, S, H, D]; k/v may carry fewer (GQA)
+    heads ([B, S, H_kv, D], H % H_kv == 0) — repeated on the fly.
+
+    Contract shared with the Pallas fast path: attn_mask is a *constant*
+    (no gradient flows into it — the reference's flash kernels likewise
+    never produce a mask gradient), and fully-masked query rows produce
+    zeros, not a uniform average."""
+    if key.ndim == 4 and key.shape[2] != query.shape[2]:
+        g = query.shape[2] // key.shape[2]
+        key = jnp.repeat(key, g, axis=2)
+        value = jnp.repeat(value, g, axis=2)
     q = jnp.swapaxes(query, 1, 2)  # [B, H, S, D]
     k = jnp.swapaxes(key, 1, 2)
     v = jnp.swapaxes(value, 1, 2)
@@ -42,11 +52,17 @@ def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
         mask = jnp.tril(jnp.ones((q_len, k_len), bool), k_len - q_len)
         logits = jnp.where(mask, logits, -1e30)
     if attn_mask is not None:
+        attn_mask = jax.lax.stop_gradient(attn_mask)
         if attn_mask.dtype == jnp.bool_:
             logits = jnp.where(attn_mask, logits, -1e30)
         else:
             logits = logits + attn_mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if is_causal or attn_mask is not None:
+        # a fully-masked row softmaxes to a uniform average of V; emit
+        # zeros instead (matches the flash kernel's l==0 guard)
+        probs = jnp.where(
+            jnp.max(logits, axis=-1, keepdims=True) <= -1e29, 0.0, probs)
     if dropout_p > 0.0 and training:
         from ...random import next_key
         keep = 1.0 - dropout_p
@@ -61,6 +77,10 @@ def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
+    """attn_mask is a constant (bool keep-mask or additive float): no
+    gradient flows into it on either the Pallas fast path or the composed
+    fallback — matching the reference flash kernels, which never emit a
+    mask gradient. Compose attention manually for a *learned* bias."""
     del name
     return _sdpa_reference(query, key, value, attn_mask, dropout_p, is_causal,
                            training=training)
@@ -82,6 +102,15 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _segments_from_cu(cu_seqlens, total):
+    """Position → sequence id for cu_seqlens-packed layouts (shared by the
+    composed fallback and the Pallas fast path so both mask identically)."""
+    return jnp.searchsorted(cu_seqlens, jnp.arange(total),
+                            side="right").astype(jnp.int32)
+
+
+@register_op("flash_attn_unpadded", tags=["attention", "fusion"],
+             dispatch=True)
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
                         causal=False, return_softmax=False, training=True):
@@ -89,11 +118,14 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
     Implemented by segment-masking within one attention call: position i may
     attend to j iff they fall in the same cu_seqlens segment (and j<=i for
-    causal). This keeps static shapes for XLA."""
+    causal). This keeps static shapes for XLA. On TPU the registry routes
+    this through the Pallas kernel's in-kernel segment-id masking (the
+    analogue of the reference's cu_seqlens varlen kernel,
+    flash_attn_kernel.cu:213) — see _flash_attn_unpadded_pallas."""
     tq = query.shape[0]
     tk = key.shape[0]
-    seg_q = jnp.searchsorted(cu_seqlens_q, jnp.arange(tq), side="right")
-    seg_k = jnp.searchsorted(cu_seqlens_k, jnp.arange(tk), side="right")
+    seg_q = _segments_from_cu(cu_seqlens_q, tq)
+    seg_k = _segments_from_cu(cu_seqlens_k, tk)
     mask = seg_q[:, None] == seg_k[None, :]
     if causal:
         pos_q = jnp.arange(tq) - jnp.take(cu_seqlens_q, seg_q - 1)
@@ -108,13 +140,17 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     return out, None
 
 
+@register_op("flashmask_attention", tags=["attention", "fusion"],
+             dispatch=True)
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=True, window_size=None):
     """Sparse-mask attention (reference: flash_attention.py:1098).
 
     startend_row_indices: [B, H_mask, S, 1] (causal LT mask) or richer forms;
     row r of the mask column j means keys j are masked for queries >= r.
-    Composed as an additive mask over the reference kernel."""
+    Composed as an additive mask over the reference kernel; on TPU the
+    registry routes the O(S) row-indices straight into the Pallas kernel
+    (no dense mask is ever built) — see _flashmask_pallas."""
     B, S = query.shape[0], query.shape[1]
     Sk = key.shape[1]
     mask = None

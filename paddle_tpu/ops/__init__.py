@@ -1,5 +1,6 @@
 from .registry import (
     OpSchema,
+    dispatch_stats,
     get_op,
     infer_meta,
     list_ops,
@@ -8,5 +9,6 @@ from .registry import (
 )
 
 __all__ = [
-    "OpSchema", "get_op", "infer_meta", "list_ops", "register_op", "register_pallas_impl",
+    "OpSchema", "dispatch_stats", "get_op", "infer_meta", "list_ops",
+    "register_op", "register_pallas_impl",
 ]

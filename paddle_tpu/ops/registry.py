@@ -42,17 +42,34 @@ class OpSchema:
     tags: List[str] = field(default_factory=list)
 
     def dispatch(self, *args, **kwargs):
+        stats = DISPATCH_STATS.setdefault(self.name,
+                                          {"pallas": 0, "reference": 0})
         if (
             self.pallas_impl is not None
             and flag("enable_pallas_kernels")
             and _on_tpu()
             and (self.pallas_supported is None or self.pallas_supported(*args, **kwargs))
         ):
+            stats["pallas"] += 1
             return self.pallas_impl(*args, **kwargs)
+        stats["reference"] += 1
         return self.fn(*args, **kwargs)
 
 
 _OPS: Dict[str, OpSchema] = {}
+
+# Per-op fast-path hit counters (VERDICT r1: make fallback visible). Counts
+# are per *trace*, not per executed step — a jit-cached program counts once;
+# a model that retraces per shape counts per shape. reset=True starts a
+# fresh window around a run under test.
+DISPATCH_STATS: Dict[str, Dict[str, int]] = {}
+
+
+def dispatch_stats(reset: bool = False) -> Dict[str, Dict[str, int]]:
+    out = {k: dict(v) for k, v in DISPATCH_STATS.items()}
+    if reset:
+        DISPATCH_STATS.clear()
+    return out
 
 
 @functools.lru_cache(maxsize=None)
