@@ -293,9 +293,16 @@ def _fwd_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
     @pl.when(j == j_last)
     def _finalize():
         l = l_sc[:, 0]
-        inv = jnp.where(l == 0.0, 0.0, 1.0 / l)
+        # a row is dead if no block ran for it (l == 0) OR every score was
+        # elementwise-masked to _NEG_INF (m never rose above it; p = exp(0)
+        # makes l = block_k there, so l alone can't detect it) — emit zeros,
+        # matching the composed path's fully-masked-row contract
+        dead = (l == 0.0) | (m_sc[:, 0] <= _NEG_INF * 0.5)
+        inv = jnp.where(dead, 0.0, 1.0 / jnp.maximum(l, 1e-37))
         o_ref[0] = (acc_sc[:] * inv[:, None]).astype(o_ref.dtype)
         if lse_ref is not None:
+            # dead rows keep lse ~ _NEG_INF so the backward can re-detect
+            # them (p must be zero there, not exp(0))
             lse = m_sc[:, 0] + jnp.log(jnp.maximum(l, 1e-37))
             lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
@@ -319,7 +326,7 @@ def _bias_index(fwd_grid, bias_shape, h, h_kv, g, nq):
 
 
 def _build_specs(*, grid_kind, h, h_kv, g, nq, block_q, block_k, d,
-                 bias_shape, has_seg, has_fm, dropout_p):
+                 bias_shape, has_seg, has_fm, dropout_p, fm_mh=None):
     """in_specs tail (bias/segments/flashmask) shared by fwd/dq/dkv, plus
     the optional SMEM seed spec at the head."""
     fwd_grid = grid_kind in ("fwd", "dq")
@@ -344,19 +351,42 @@ def _build_specs(*, grid_kind, h, h_kv, g, nq, block_q, block_k, d,
         tail.append(pl.BlockSpec((1, 1, block_k), kidx))
     if has_fm:
         # flashmask arrays ride flattened as [B*Hm, 1, Sk] (same tiling rule)
-        def fm_idx_factory(mh):
-            if fwd_grid:
-                def idx(b, i, j):
-                    return (b // h * mh + (b % h if mh > 1 else 0), 0, j)
-            else:
-                def idx(bkv, j, t):
-                    hi = ((bkv % h_kv) * g + t // nq) if mh > 1 else 0
-                    return (bkv // h_kv * mh + hi, 0, j)
-            return idx
-        tail.append(None)  # placeholder; filled by caller with mh known
-        tail.append(None)
-        return head, tail, fm_idx_factory
-    return head, tail, None
+        mh = fm_mh
+        if fwd_grid:
+            def fm_idx(b, i, j):
+                return (b // h * mh + (b % h if mh > 1 else 0), 0, j)
+        else:
+            def fm_idx(bkv, j, t):
+                hi = ((bkv % h_kv) * g + t // nq) if mh > 1 else 0
+                return (bkv // h_kv * mh + hi, 0, j)
+        tail.append(pl.BlockSpec((1, 1, block_k), fm_idx))
+        tail.append(pl.BlockSpec((1, 1, block_k), fm_idx))
+    return head, tail
+
+
+def _prep_mask_operands(qseg, kseg, fm_start, fm_end):
+    """Reshape mask operands to their kernel ride layouts ([B,1,S] segments,
+    [B*Hm,1,Sk] flashmask) — shared by _fwd and _bwd_impl."""
+    fm_mh = None
+    if qseg is not None:
+        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
+    if fm_start is not None:
+        fm_mh = fm_start.shape[1]
+        fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
+        fm_end = fm_end.reshape(-1, 1, fm_end.shape[-1])
+    return qseg, kseg, fm_start, fm_end, fm_mh
+
+
+def _mask_input_list(bias, qseg, kseg, fm_start, fm_end):
+    """Input-list tail matching _build_specs' tail ordering exactly."""
+    inputs = []
+    if bias is not None:
+        inputs.append(bias)
+    if qseg is not None:
+        inputs += [qseg, kseg]
+    if fm_start is not None:
+        inputs += [fm_start, fm_end]
+    return inputs
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
@@ -369,13 +399,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
     grid = (bh, nq, nk)
-    fm_mh = None
-    if qseg is not None:
-        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
-    if fm_start is not None:
-        fm_mh = fm_start.shape[1]
-        fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
-        fm_end = fm_end.reshape(-1, 1, fm_end.shape[-1])
+    qseg, kseg, fm_start, fm_end, fm_mh = _prep_mask_operands(
+        qseg, kseg, fm_start, fm_end)
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
@@ -389,26 +414,15 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
         pl.BlockSpec((1, block_k, d), kv_idx),
         pl.BlockSpec((1, block_k, d), kv_idx),
     ]
-    head, tail, fm_idx_factory = _build_specs(
+    head, tail = _build_specs(
         grid_kind="fwd", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=None if bias is None else bias.shape,
         has_seg=qseg is not None, has_fm=fm_start is not None,
-        dropout_p=dropout_p)
-    if fm_idx_factory is not None:
-        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
-        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        dropout_p=dropout_p, fm_mh=fm_mh)
     in_specs = head + in_specs + tail
 
-    inputs = []
-    if dropout_p:
-        inputs.append(seed)
-    inputs += [q, k, v]
-    if bias is not None:
-        inputs.append(bias)
-    if qseg is not None:
-        inputs += [qseg, kseg]
-    if fm_start is not None:
-        inputs += [fm_start, fm_end]
+    inputs = ([seed] if dropout_p else []) + [q, k, v] + _mask_input_list(
+        bias, qseg, kseg, fm_start, fm_end)
 
     ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     lspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
@@ -480,6 +494,9 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
             s, i, j, block_q=block_q, block_k=block_k, causal=causal,
             offset=offset, window=window, **_mask_ref_args(masks))
         p = jnp.exp(s - lse[:, None])  # [bq, bk], undropped softmax
+        # fully-masked (dead) rows carry lse ~ _NEG_INF: exp(s - lse) would
+        # be exp(0) = 1 there — zero them so no gradient leaks through
+        p = jnp.where((lse <= _NEG_INF * 0.5)[:, None], 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -541,6 +558,7 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
             s, i, j, block_q=block_q, block_k=block_k, causal=causal,
             offset=offset, window=window, **_mask_ref_args(masks))
         p = jnp.exp(s - lse[:, None])
+        p = jnp.where((lse <= _NEG_INF * 0.5)[:, None], 0.0, p)  # dead rows
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -574,25 +592,12 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
     lse_r = jnp.broadcast_to(lse[:, :, None], (bh, sq, _LANES))
     delta_r = jnp.broadcast_to(delta[:, :, None], (bh, sq, _LANES))
 
-    fm_mh = None
-    if qseg is not None:
-        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
-    if fm_start is not None:
-        fm_mh = fm_start.shape[1]
-        fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
-        fm_end = fm_end.reshape(-1, 1, fm_end.shape[-1])
-
+    qseg, kseg, fm_start, fm_end, fm_mh = _prep_mask_operands(
+        qseg, kseg, fm_start, fm_end)
     bias_shape = None if bias is None else bias.shape
     has_seg = qseg is not None
     has_fm = fm_start is not None
-
-    extra_inputs = []
-    if bias is not None:
-        extra_inputs.append(bias)
-    if has_seg:
-        extra_inputs += [qseg, kseg]
-    if has_fm:
-        extra_inputs += [fm_start, fm_end]
+    extra_inputs = _mask_input_list(bias, qseg, kseg, fm_start, fm_end)
     seed_inputs = [seed] if dropout_p else []
 
     # ---- dk/dv: grid (B*H_kv, k blocks, group*q blocks) — the q-head
@@ -608,13 +613,10 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
         (1, block_q, _LANES),
         lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
                            t % nq, 0))
-    head, tail, fm_idx_factory = _build_specs(
+    head, tail = _build_specs(
         grid_kind="dkv", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
-        has_fm=has_fm, dropout_p=dropout_p)
-    if fm_idx_factory is not None:
-        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
-        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        has_fm=has_fm, dropout_p=dropout_p, fm_mh=fm_mh)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
@@ -643,13 +645,10 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
     qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec2 = pl.BlockSpec((1, block_k, d), kv_idx)
     rspec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
-    head, tail, fm_idx_factory = _build_specs(
+    head, tail = _build_specs(
         grid_kind="dq", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
-        has_fm=has_fm, dropout_p=dropout_p)
-    if fm_idx_factory is not None:
-        tail[-2] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
-        tail[-1] = pl.BlockSpec((1, 1, block_k), fm_idx_factory(fm_mh))
+        has_fm=has_fm, dropout_p=dropout_p, fm_mh=fm_mh)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal, offset=offset,

@@ -34,10 +34,24 @@ def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
 
 
 def _dropout_seed(p, training):
+    """Draw the kernel dropout seed from the framework RNG. Inside a jitted
+    step the caller must be under ``paddle_tpu.random.rng_guard(step_key)``
+    so the seed is a *traced* value that changes per step; a concrete key
+    during tracing would bake ONE mask into the compiled program."""
     if not (p and training):
         return 0.0, None
+    import warnings
+
     from ...random import next_key
-    return float(p), jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
+    from jax._src.core import trace_state_clean
+    key = next_key()
+    if not isinstance(key, jax.core.Tracer) and not trace_state_clean():
+        warnings.warn(
+            "attention dropout seed drawn while tracing without an active "
+            "rng_guard: the dropout mask will be IDENTICAL every step of "
+            "the compiled program. Wrap the jitted step body in "
+            "paddle_tpu.random.rng_guard(step_key).")
+    return float(p), jax.random.randint(key, (1,), 0, 2 ** 31 - 1,
                                         dtype=jnp.int32)
 
 

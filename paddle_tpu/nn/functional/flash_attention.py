@@ -174,7 +174,9 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         w = window_size if isinstance(window_size, int) else window_size[0]
         rows = jnp.arange(S)[:, None]
         cols = jnp.arange(Sk)[None, :]
-        wm = (cols >= rows - w)[None, None]
+        # bottom-right aligned like the causal tril above (and the Pallas
+        # fast path): key col k visible iff k >= q + (Sk - S) - w
+        wm = (cols >= rows + (Sk - S) - w)[None, None]
         mask = wm if mask is None else (mask & wm)
     out = get_op("scaled_dot_product_attention").dispatch(
         query, key, value, mask, dropout, False, True)

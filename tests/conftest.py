@@ -1,15 +1,30 @@
 """Test config: force an 8-device virtual CPU mesh (the reference's
 subprocess-spawn distributed test pattern, SURVEY §4, maps to
-xla_force_host_platform_device_count on TPU-less CI)."""
+xla_force_host_platform_device_count on TPU-less CI).
 
-from paddle_tpu.device import force_virtual_cpu_devices
+Set PADDLE_TPU_TESTS=1 to run on the real TPU backend instead — enables
+the @pytest.mark.tpu tests (compiled-only paths like the in-kernel
+dropout PRNG that have no CPU/interpret lowering)."""
 
-# jax may already be imported (pytest plugins) with JAX_PLATFORMS=axon baked
-# in; force the CPU backend before any computation initializes it.
-force_virtual_cpu_devices(8)
+import os
+
+if os.environ.get("PADDLE_TPU_TESTS") != "1":
+    from paddle_tpu.device import force_virtual_cpu_devices
+
+    # jax may already be imported (pytest plugins) with JAX_PLATFORMS=axon
+    # baked in; force the CPU backend before any computation initializes it.
+    force_virtual_cpu_devices(8)
 
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs the real TPU backend (PADDLE_TPU_TESTS=1)")
+    config.addinivalue_line(
+        "markers", "slow: heavy hybrid-engine compiles; excluded from the "
+        "fast tier (pytest -m 'not slow')")
 
 
 @pytest.fixture(autouse=True)

@@ -426,3 +426,72 @@ def test_mask_is_constant_no_grad_flow():
     g = jax.grad(lambda b: jnp.sum(
         _sdpa_reference(q, q, q, attn_mask=b) ** 2))(bias)
     assert float(jnp.abs(g).max()) == 0.0
+
+
+def test_segment_fully_masked_rows_zero():
+    """Rows whose segment matches NO kv position must emit zeros (and zero
+    grads), matching the composed path — regression: finite _NEG_INF made
+    p=exp(0) and the kernel returned a uniform average of V."""
+    b, s, h, d = 1, 256, 2, 64
+    q = _rand(b, s, h, d, seed=70) * 0.3
+    k = _rand(b, s, h, d, seed=71) * 0.3
+    v = _rand(b, s, h, d, seed=72)
+    qseg = jnp.asarray(np.r_[np.zeros(128), np.full(128, 7)][None], jnp.int32)
+    kseg = jnp.zeros((1, s), jnp.int32)  # segment 7 matches nothing
+    out = flash_attention(q, k, v, False, None, 128, 128,
+                          q_segment_ids=qseg, kv_segment_ids=kseg)
+    assert float(jnp.abs(out[:, 128:]).max()) == 0.0
+    mask = (qseg[0][:, None] == kseg[0][None, :])[None, None]
+    ref = _sdpa_reference(q, k, v, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+    # gradients of dead rows must not leak into k/v
+    gp = jax.grad(lambda k, v: jnp.sum(flash_attention(
+        q, k, v, False, None, 128, 128, q_segment_ids=qseg,
+        kv_segment_ids=kseg) ** 2), argnums=(0, 1))(k, v)
+    gr = jax.grad(lambda k, v: jnp.sum(_sdpa_reference(
+        q, k, v, attn_mask=mask) ** 2), argnums=(0, 1))(k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_flashmask_window_rectangular_alignment():
+    """Composed flashmask window must be bottom-right aligned like the
+    kernel when Sq != Sk (regression: top-left aligned wm)."""
+    from paddle_tpu.ops import get_op
+    b, h, d = 1, 2, 64
+    sq, sk, w = 128, 256, 32
+    q = _rand(b, sq, h, d, seed=73) * 0.3
+    kv = _rand(b, sk, h, d, seed=74) * 0.3
+    out, _ = get_op("flashmask_attention").fn(q, kv, kv, None, causal=True,
+                                              window_size=w)
+    rows = jnp.arange(sq)[:, None]
+    cols = jnp.arange(sk)[None, :]
+    off = sk - sq
+    m = ((cols <= rows + off) & (cols >= rows + off - w))[None, None]
+    ref = _sdpa_reference(q, kv, kv, attn_mask=m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="in-kernel PRNG has no CPU lowering "
+                           "(run with PADDLE_TPU_TESTS=1 on a TPU)")
+def test_flash_dropout_bwd_mask_consistency_tpu():
+    """Compiled-only: the backward re-derives the forward's keep mask.
+    With a fixed seed, out is linear in v; d/dv of sum(out) recovers the
+    column-sums of the dropped probability matrix, so sum(out(v=1)) must
+    equal <grad_v, 1> exactly."""
+    b, s, h, d = 1, 256, 2, 64
+    q = _rand(b, s, h, d, seed=75) * 0.3
+    k = _rand(b, s, h, d, seed=76) * 0.3
+    seed = jnp.asarray([77], jnp.int32)
+    f = lambda v: jnp.sum(flash_attention(
+        q, k, v, False, None, 128, 128, dropout_p=0.5,
+        dropout_seed=seed).astype(jnp.float32))
+    ones = jnp.ones((b, s, h, d), jnp.float32)
+    gv = jax.grad(f)(ones)
+    np.testing.assert_allclose(float(f(ones)), float(jnp.sum(gv)),
+                               rtol=1e-3)
