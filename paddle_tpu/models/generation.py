@@ -313,7 +313,9 @@ class PagedKVCache:
     """Block pool + per-sequence block tables (reference:
     block_multi_head_attention_kernel.cu paged KV).
 
-    k_pool/v_pool: [num_blocks, block_size, h_kv, D]
+    k_pool/v_pool: [h_kv, num_blocks, block_size, D] — head-major so the
+                   decode kernel's (head, block) tile is one contiguous
+                   [block_size, D] VMEM block
     block_tables:  [B, max_blocks_per_seq] int32 indices into the pool
     seq_lens:      [B] valid token counts
     """
@@ -328,45 +330,91 @@ class PagedKVCache:
     def create(cls, num_blocks, block_size, num_kv_heads, head_dim, batch,
                max_blocks_per_seq, dtype=jnp.bfloat16):
         return cls(
-            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim),
                       dtype),
-            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim),
                       dtype),
             jnp.zeros((batch, max_blocks_per_seq), jnp.int32),
             jnp.zeros((batch,), jnp.int32),
             block_size)
 
+    def _check_capacity(self, b: int, need: int):
+        import jax.core as _core
+        if isinstance(self.seq_lens, _core.Tracer):
+            raise TypeError(
+                "PagedKVCache.write/prefill are host-side cache-management "
+                "methods and cannot run under jit (they read concrete "
+                "seq_lens for the capacity check); call them outside the "
+                "jitted decode step — only the attention itself is jitted")
+        pos = int(self.seq_lens[b])
+        capacity = self.block_tables.shape[1] * self.block_size
+        if pos + need > capacity:
+            # JAX index clamping would silently overwrite the last slot
+            raise ValueError(
+                f"sequence {b} is full: {pos}+{need} tokens > capacity "
+                f"{capacity} (max_blocks_per_seq * block_size); allocate "
+                f"more blocks in its block table")
+        return pos
+
     def write(self, b: int, k, v):
         """Append one token's k/v ([h, D]) for sequence b (host-side cache
         management; the attention itself is jitted)."""
-        pos = int(self.seq_lens[b])
-        capacity = self.block_tables.shape[1] * self.block_size
-        if pos >= capacity:
-            # JAX index clamping would silently overwrite the last slot
-            raise ValueError(
-                f"sequence {b} is full: {pos} tokens >= capacity "
-                f"{capacity} (max_blocks_per_seq * block_size); allocate "
-                f"more blocks in its block table")
+        pos = self._check_capacity(b, 1)
         blk_idx = pos // self.block_size
         off = pos % self.block_size
         blk = int(self.block_tables[b, blk_idx])
-        self.k_pool = self.k_pool.at[blk, off].set(k.astype(
-            self.k_pool.dtype))
-        self.v_pool = self.v_pool.at[blk, off].set(v.astype(
-            self.v_pool.dtype))
+        self.k_pool = self.k_pool.at[:, blk, off].set(
+            k.astype(self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, blk, off].set(
+            v.astype(self.v_pool.dtype))
         self.seq_lens = self.seq_lens.at[b].add(1)
+        return self
+
+    def prefill(self, b: int, k_seq, v_seq):
+        """Append a whole prompt's k/v ([L, h, D]) for sequence b in one
+        vectorized scatter (prefill-into-paged-cache: reference
+        block_multi_head_attention prefill path)."""
+        L = k_seq.shape[0]
+        pos0 = self._check_capacity(b, L)
+        pos = pos0 + jnp.arange(L)
+        blks = jnp.take(self.block_tables[b], pos // self.block_size)
+        offs = pos % self.block_size
+        kq = jnp.moveaxis(k_seq.astype(self.k_pool.dtype), 1, 0)  # [h,L,D]
+        vq = jnp.moveaxis(v_seq.astype(self.v_pool.dtype), 1, 0)
+        self.k_pool = self.k_pool.at[:, blks, offs].set(kq)
+        self.v_pool = self.v_pool.at[:, blks, offs].set(vq)
+        self.seq_lens = self.seq_lens.at[b].add(L)
         return self
 
 
 def block_multihead_attention(q, cache: PagedKVCache):
     """Decode attention over a paged cache. q: [B, 1, hq, D] →
-    [B, 1, hq, D]. Gathers each sequence's blocks via its block table —
-    XLA fuses the gather into the attention contraction."""
+    [B, 1, hq, D].
+
+    The Pallas paged kernel streams ONLY the blocks each sequence
+    references (block tables dereferenced in the BlockSpec index maps via
+    scalar prefetch) — no [B, T, h, D] gather is ever materialized (the
+    round-1 gather read AND wrote the whole logical cache every step).
+    GQA native (hq a multiple of the pool's h_kv)."""
+    from ..kernels.pallas.paged_attention import paged_decode_attention
+    B, one, hq, D = q.shape
+    out = paged_decode_attention(
+        q.reshape(B, hq, D), cache.k_pool, cache.v_pool,
+        cache.block_tables, cache.seq_lens, 1.0 / (D ** 0.5))
+    return out.reshape(B, one, hq, D)
+
+
+def _paged_gather_reference(q, cache: PagedKVCache):
+    """XLA gather + masked attention — the O(max_len) reference the paged
+    kernel is tested against."""
     B, _, hq, D = q.shape
     bs = cache.block_size
     nb = cache.block_tables.shape[1]
-    hkv = cache.k_pool.shape[2]
-    # gather: [B, max_blocks, block, h, D] → [B, T, h, D]
-    k = cache.k_pool[cache.block_tables].reshape(B, nb * bs, hkv, D)
-    v = cache.v_pool[cache.block_tables].reshape(B, nb * bs, hkv, D)
+    hkv = cache.k_pool.shape[0]
+    # gather: [h, B, max_blocks, block, D] → [B, T, h, D]
+    k = jnp.moveaxis(cache.k_pool[:, cache.block_tables], 0, 3
+                     ).reshape(B, nb * bs, hkv, D)
+    v = jnp.moveaxis(cache.v_pool[:, cache.block_tables], 0, 3
+                     ).reshape(B, nb * bs, hkv, D)
+    # masked_multihead_attention handles GQA natively (hkv != hq)
     return masked_multihead_attention(q, k, v, cache.seq_lens)
