@@ -1,13 +1,18 @@
 """Distributed (sharding-aware) checkpoint with reshard-on-load
 (reference: python/paddle/distributed/checkpoint/ — SURVEY §2.9)."""
 
-from .load_state_dict import load_metadata, load_state_dict
+from .load_state_dict import (load_full_state_dict, load_metadata,
+                              load_state_dict)
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .pp_adaptor import pp_relayout_state_dict
 from .save_state_dict import save_state_dict, wait_async_save
 from .utils import flatten_state_dict, unflatten_state_dict
+from . import pp_adaptor
 
 __all__ = [
-    "save_state_dict", "load_state_dict", "wait_async_save", "load_metadata",
+    "save_state_dict", "load_state_dict", "load_full_state_dict",
+    "wait_async_save", "load_metadata",
     "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
     "flatten_state_dict", "unflatten_state_dict",
+    "pp_adaptor", "pp_relayout_state_dict",
 ]

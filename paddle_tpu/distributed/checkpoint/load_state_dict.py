@@ -22,7 +22,7 @@ from .metadata import LocalTensorIndex, Metadata
 from .utils import (chunk_name, chunk_overlap, flatten_state_dict,
                     index_to_offset_shape, unflatten_state_dict)
 
-__all__ = ["load_state_dict", "load_metadata"]
+__all__ = ["load_state_dict", "load_full_state_dict", "load_metadata"]
 
 
 def load_metadata(path: str) -> Metadata:
@@ -71,6 +71,29 @@ def _assemble_region(key: str, offset, shape, dtype, md: Metadata,
             f"checkpoint chunk coverage incomplete for '{key}': region "
             f"offset={offset} shape={shape} covered {covered}/{need} elements")
     return out
+
+
+def load_full_state_dict(path: str) -> Dict:
+    """Load the WHOLE checkpoint to host numpy without a template: each
+    tensor is assembled at its full global shape (the union of its chunks).
+    Used by offline tools (pp_adaptor.convert) and debugging."""
+    md = load_metadata(path)
+    files = _FileCache(path)
+    try:
+        flat: Dict[str, object] = {}
+        for key, chunks in md.state_dict_metadata.items():
+            rank = len(chunks[0].global_offset)
+            gshape = tuple(
+                max(c.global_offset[d] + c.local_shape[d] for c in chunks)
+                for d in range(rank))
+            flat[key] = _assemble_region(key, (0,) * rank, gshape,
+                                         np.dtype(chunks[0].dtype), md,
+                                         files)
+        for key, v in md.misc.items():
+            flat.setdefault(key, v)
+        return unflatten_state_dict(flat, md.flat_mapping)
+    finally:
+        files.close()
 
 
 def load_state_dict(state_dict: Dict, path: str,

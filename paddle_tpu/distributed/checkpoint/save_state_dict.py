@@ -72,16 +72,64 @@ def _gather_metadata_across_processes(local_meta):
     return out
 
 
+def _store_gather_commit(meta_store, tag, proc, nproc, coordinator_rank,
+                         local_meta, write_metadata_fn):
+    """Store-coordinated metadata exchange + commit barrier: pure TCP, safe
+    on a writer thread while the main thread keeps using the devices. The
+    coordinator writes 0.metadata only after seeing EVERY rank's chunk
+    metadata; other ranks return only after the commit marker exists, so a
+    checkpoint directory with 0.metadata is always complete."""
+    meta_store.set(f"{tag}/meta/{proc}", pickle.dumps(local_meta))
+    if proc == coordinator_rank:
+        all_meta = [pickle.loads(meta_store.get(f"{tag}/meta/{r}"))
+                    for r in range(nproc)]
+        write_metadata_fn(all_meta)
+        meta_store.set(f"{tag}/commit", b"1")
+    else:
+        meta_store.get(f"{tag}/commit")  # blocks until committed
+
+
+_SAVE_SEQ = [0]  # per-process save counter; equal across processes because
+#                  every process calls save_state_dict the same number of
+#                  times — used to namespace store keys per save
+
+
+def _store_from_env():
+    """The launcher's TCP KV store (PADDLE_MASTER), if this is a
+    multi-process job started through paddle_tpu.distributed.launch.
+    Always connects as a CLIENT — the launcher hosts the server (a second
+    listener on the same endpoint would fail or, worse, fork the ranks
+    onto two disjoint stores)."""
+    ep = os.environ.get("PADDLE_MASTER")
+    if not ep:
+        return None
+    from ..store import TCPStore
+    host, port = ep.rsplit(":", 1)
+    try:
+        return TCPStore(host, int(port), is_master=False)
+    except Exception:
+        return None
+
+
 def save_state_dict(state_dict: Dict, path: str,
                     process_mesh=None,  # accepted for API parity; unused —
                                         # shardings are carried by the arrays
                     coordinator_rank: int = 0,
-                    async_save: bool = False) -> None:
+                    async_save: bool = False,
+                    store=None) -> None:
     """Save a (possibly nested) state dict of sharded jax.Arrays.
 
     Every process writes only the shards it owns (replica 0), so the on-disk
     checkpoint is deduplicated; the metadata file records the global offset of
     each chunk so `load_state_dict` can reshard into ANY target sharding.
+
+    async_save: device→host snapshots happen synchronously; file IO and the
+    metadata commit run on a writer thread. Multi-process async needs a TCP
+    KV `store` (paddle_tpu.distributed.TCPStore; defaults to the launcher's
+    PADDLE_MASTER store) so the cross-process metadata exchange and commit
+    barrier stay OFF the jax device runtime (reference:
+    save_state_dict.py:291 async via side process) — with no store it falls
+    back to a synchronous save with a warning, never silently.
     """
     os.makedirs(path, exist_ok=True)
     flat, mapping = flatten_state_dict(state_dict)
@@ -112,33 +160,62 @@ def save_state_dict(state_dict: Dict, path: str,
             entries.append((offset, shape, str(host.dtype), data_file))
         local_meta[key] = entries
 
-    def write_files(chunks=chunks, local_meta=local_meta, misc=misc):
+    def _write_metadata(all_meta):
+        md = Metadata(flat_mapping=mapping, misc=misc)
+        for rank_meta in all_meta:
+            for key, entries in rank_meta.items():
+                lst = md.state_dict_metadata.setdefault(key, [])
+                for offset, shape, dtype, fname in entries:
+                    lst.append(LocalTensorMetadata(tuple(offset),
+                                                   tuple(shape), dtype))
+                    md.storage_metadata[
+                        LocalTensorIndex(key, tuple(offset))] = fname
+        with open(os.path.join(path, "0.metadata"), "wb") as f:
+            pickle.dump(md, f)
+
+    def write_files(chunks=chunks, local_meta=local_meta, misc=misc,
+                    meta_store=None, tag=None):
         with open(os.path.join(path, data_file), "wb") as f:
             np.savez(f, **chunks)  # file handle keeps our .distcp name
-        all_meta = _gather_metadata_across_processes(local_meta)
-        if proc == coordinator_rank:
-            md = Metadata(flat_mapping=mapping, misc=misc)
-            for rank_meta in all_meta:
-                for key, entries in rank_meta.items():
-                    lst = md.state_dict_metadata.setdefault(key, [])
-                    for offset, shape, dtype, fname in entries:
-                        lst.append(LocalTensorMetadata(tuple(offset),
-                                                       tuple(shape), dtype))
-                        md.storage_metadata[
-                            LocalTensorIndex(key, tuple(offset))] = fname
-            with open(os.path.join(path, "0.metadata"), "wb") as f:
-                pickle.dump(md, f)
+        if meta_store is not None:
+            _store_gather_commit(meta_store, tag, proc, jax.process_count(),
+                                 coordinator_rank, local_meta,
+                                 _write_metadata)
+        else:
+            all_meta = _gather_metadata_across_processes(local_meta)
+            if proc == coordinator_rank:
+                _write_metadata(all_meta)
 
-    if async_save and jax.process_count() == 1:
+    def run_async(**kw):
         def guarded():
             try:
-                write_files()
+                write_files(**kw)
             except BaseException as e:  # surfaced by wait_async_save
                 _ASYNC_ERRORS.append(e)
         t = threading.Thread(target=guarded, daemon=False)
         _PENDING.append(t)
         t.start()
+
+    _SAVE_SEQ[0] += 1
+    if async_save and jax.process_count() == 1:
+        run_async()
+    elif async_save:
+        st = store if store is not None else _store_from_env()
+        if st is None:
+            import warnings
+            warnings.warn(
+                "async_save on a multi-process job needs a TCP store for "
+                "the off-device metadata exchange (pass store=, or launch "
+                "via paddle_tpu.distributed.launch which sets "
+                "PADDLE_MASTER); falling back to a SYNCHRONOUS save")
+            write_files()
+        else:
+            # tag carries the elastic restart generation: the launcher's
+            # store outlives worker restarts, and a reset _SAVE_SEQ must
+            # not alias a previous incarnation's keys (stale metadata /
+            # commit markers would let the coordinator commit early)
+            gen = os.environ.get("PADDLE_RESTART_COUNT", "0")
+            run_async(meta_store=st,
+                      tag=f"ckpt/g{gen}/{_SAVE_SEQ[0]}/{path}")
     else:
-        # multi-host async would need the metadata gather off-thread on every
-        # process at once; keep it synchronous there for correctness.
         write_files()

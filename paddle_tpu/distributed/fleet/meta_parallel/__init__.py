@@ -3,6 +3,7 @@ from . import sharding  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention
 from .pp_utils.spmd_pipeline import (pipeline_last_stage_value, spmd_pipeline,
                                      spmd_pipeline_interleaved,
+                                     spmd_pipeline_zero_bubble,
                                      vpp_block_permutation, vpp_chunk_blocks,
                                      vpp_wrap_shard_params)
 from .segment_parallel import (SegmentParallel, sep_reduce_gradients,
@@ -11,7 +12,8 @@ from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
                        GroupShardedStage2, GroupShardedStage3)
 
 __all__ = ["pp_utils", "sharding", "spmd_pipeline",
-           "spmd_pipeline_interleaved", "pipeline_last_stage_value",
+           "spmd_pipeline_interleaved", "spmd_pipeline_zero_bubble",
+           "pipeline_last_stage_value",
            "vpp_block_permutation", "vpp_chunk_blocks", "vpp_wrap_shard_params",
            "DygraphShardingOptimizer",
            "GroupShardedOptimizerStage2", "GroupShardedStage2",

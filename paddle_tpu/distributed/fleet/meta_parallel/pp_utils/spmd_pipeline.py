@@ -27,8 +27,9 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
-           "pipeline_last_stage_value", "vpp_block_permutation",
-           "vpp_chunk_blocks", "vpp_wrap_shard_params"]
+           "spmd_pipeline_zero_bubble", "pipeline_last_stage_value",
+           "vpp_block_permutation", "vpp_chunk_blocks",
+           "vpp_wrap_shard_params"]
 
 
 def vpp_block_permutation(num_layers: int, pp: int, vpp: int):
@@ -132,8 +133,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
         outputs = lax.dynamic_update_index_in_dim(outputs, val, mc, axis=0)
         return (out, outputs), None
 
-    out0 = jnp.zeros_like(x_microbatches)
-    state0 = jnp.zeros_like(x_microbatches[0])
+    out0 = _zb_pvary(jnp.zeros_like(x_microbatches), axis)
+    state0 = _zb_pvary(jnp.zeros_like(x_microbatches[0]), axis)
     (_, outputs), _ = lax.scan(step, (state0, out0), jnp.arange(T))
     # replicate last-stage outputs to every rank (loss is computed SPMD)
     return _replicate_from_last(outputs, axis)
@@ -215,9 +216,9 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params_chunks,
         outputs = lax.dynamic_update_index_in_dim(outputs, val, moc, axis=0)
         return (out, wrap_buf, outputs), None
 
-    state0 = jnp.zeros_like(x_microbatches[0])
-    wrap0 = jnp.zeros_like(x_microbatches)
-    out0 = jnp.zeros_like(x_microbatches)
+    state0 = _zb_pvary(jnp.zeros_like(x_microbatches[0]), axis)
+    wrap0 = _zb_pvary(jnp.zeros_like(x_microbatches), axis)
+    out0 = _zb_pvary(jnp.zeros_like(x_microbatches), axis)
     (_, _, outputs), _ = lax.scan(step, (state0, wrap0, out0),
                                   jnp.arange(T))
     return _replicate_from_last(outputs, axis)
@@ -227,3 +228,174 @@ def pipeline_last_stage_value(value, axis: str = "pp"):
     """Broadcast a value computed on the last pp stage to all stages
     (reference: pipeline_parallel.py:1024 _broadcast_final_loss)."""
     return _replicate_from_last(value, axis)
+
+
+# ---------------------------------------------------------------------------
+# zero-bubble schedule (reference:
+# python/paddle/distributed/passes/pipeline_scheduler_pass/
+# pipeline_zero_bubble.py — ZB-H1: split the backward into activation-grad
+# and weight-grad, schedule weight-grads into the pipeline bubble)
+# ---------------------------------------------------------------------------
+
+def _zb_pvary(x, axis):
+    """Mark fresh constants device-varying over `axis` (shard_map vma).
+    Leaves that are already varying (e.g. zeros_like of a varying input)
+    pass through — pcast rejects varying→varying."""
+
+    def mark(a):
+        try:
+            if hasattr(lax, "pcast"):
+                return lax.pcast(a, (axis,), to="varying")
+            if hasattr(lax, "pvary"):
+                return lax.pvary(a, (axis,))
+        except ValueError as e:
+            # only the known benign case: the leaf is already varying
+            if "varying" not in str(e):
+                raise
+        return a
+
+    return jax.tree.map(mark, x)
+
+
+def spmd_pipeline_zero_bubble(stage_fn: Callable, stage_params,
+                              x_microbatches, axis: str = "pp"):
+    """1F1B-parity pipeline with a hand-scheduled zero-bubble backward.
+
+    The standard spmd_pipeline differentiates through the forward scan, so
+    every backward tick pays dgrad+wgrad together and the cooldown ticks of
+    early ranks idle. Here the backward is its own lockstep scan of
+    T_b = 2M + P - 1 ticks in which each rank runs at most ONE half-unit
+    per tick (lax.cond — devices genuinely branch under SPMD):
+
+      rank r: dgrad for microbatch m at tick  (P-1-r) + m
+              wgrad for microbatch m at tick  (P-1-r) + M + m
+
+    so activation cotangents stream upstream at full rate while weight
+    grads fill the ticks that were bubble in the fused schedule:
+    2M + P - 1 half-unit ticks vs (M + P - 1) full-unit ticks
+    (= 2M + 2P - 2 half-units) — the (P-1) backward bubble is gone.
+
+    Cost note: dgrad and wgrad each recompute the stage forward (the
+    forward saves only each microbatch's input), so the split trades one
+    extra forward per microbatch for the bubble — the same trade the
+    reference's ZB-H1 makes under recompute.
+    """
+    return _zb(stage_fn, axis, stage_params, x_microbatches)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _zb(stage_fn, axis, stage_params, x_microbatches):
+    out, _ = _zb_fwd(stage_fn, axis, stage_params, x_microbatches)
+    return out
+
+
+def _zb_fwd(stage_fn, axis, stage_params, x_microbatches):
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    M = x_microbatches.shape[0]
+    T = M + P - 1
+
+    def step(carry, t):
+        state, outputs, saved = carry
+        prev = lax.ppermute(state, axis, [(i, i + 1) for i in range(P - 1)])
+        inj = jnp.take(x_microbatches, jnp.clip(t, 0, M - 1), axis=0)
+        inj = jnp.where(t < M, inj, jnp.zeros_like(inj))
+        inp = jnp.where(idx == 0, inj, prev)
+        out = stage_fn(stage_params, inp)
+        # this rank runs microbatch m at tick t = m + idx: save its input
+        # (the only residual — dgrad/wgrad recompute the stage from it)
+        m_in = t - idx
+        mic = jnp.clip(m_in, 0, M - 1)
+        live_in = (m_in >= 0) & (m_in < M)
+        cur_s = lax.dynamic_index_in_dim(saved, mic, axis=0, keepdims=False)
+        saved = lax.dynamic_update_index_in_dim(
+            saved, jnp.where(live_in, inp, cur_s), mic, axis=0)
+        # last stage emits microbatch m = t - (P-1)
+        m = t - (P - 1)
+        mc = jnp.clip(m, 0, M - 1)
+        write = (m >= 0) & (idx == P - 1)
+        cur = lax.dynamic_index_in_dim(outputs, mc, axis=0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, cur), mc, axis=0)
+        return (out, outputs, saved), None
+
+    out0 = _zb_pvary(jnp.zeros_like(x_microbatches), axis)
+    state0 = _zb_pvary(jnp.zeros_like(x_microbatches[0]), axis)
+    (_, outputs, saved), _ = lax.scan(step, (state0, out0, out0),
+                                      jnp.arange(T))
+    outputs = _replicate_from_last(outputs, axis)
+    return outputs, (stage_params, saved)
+
+
+def _zb_bwd(stage_fn, axis, res, g):
+    stage_params, saved = res
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    M = saved.shape[0]
+    T_b = 2 * M + P - 1
+    start = P - 1 - idx  # this rank's first dgrad tick
+
+    def dgrad(x, ct):
+        _, vjp_x = jax.vjp(lambda xx: stage_fn(stage_params, xx), x)
+        return vjp_x(ct)[0]
+
+    def wgrad(x, ct):
+        _, vjp_p = jax.vjp(lambda pp: stage_fn(pp, x), stage_params)
+        return vjp_p(ct)[0]
+
+    wacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         stage_params)
+
+    def step(carry, u):
+        dx_prev, ct_buf, wacc, dx_inputs = carry
+        # activation cotangents flow upstream (rank r+1 -> r); the last
+        # rank injects the loss cotangent for its current microbatch
+        ring = lax.ppermute(dx_prev, axis,
+                            [(i, i - 1) for i in range(1, P)])
+        m_d = u - start
+        mdc = jnp.clip(m_d, 0, M - 1)
+        live_d = (m_d >= 0) & (m_d < M)
+        g_inj = jnp.take(g, mdc, axis=0)
+        ct_in = jnp.where(idx == P - 1, g_inj, ring)
+        x_d = lax.dynamic_index_in_dim(saved, mdc, axis=0, keepdims=False)
+        dx = lax.cond(live_d, lambda: dgrad(x_d, ct_in),
+                      lambda: jnp.zeros_like(dx_prev))
+        # stash the cotangent for this microbatch's deferred wgrad
+        cur_ct = lax.dynamic_index_in_dim(ct_buf, mdc, axis=0,
+                                          keepdims=False)
+        ct_buf = lax.dynamic_update_index_in_dim(
+            ct_buf, jnp.where(live_d, ct_in, cur_ct), mdc, axis=0)
+        # deferred wgrad fills the former bubble ticks
+        m_w = u - start - M
+        mwc = jnp.clip(m_w, 0, M - 1)
+        live_w = (m_w >= 0) & (m_w < M)
+        x_w = lax.dynamic_index_in_dim(saved, mwc, axis=0, keepdims=False)
+        ct_w = lax.dynamic_index_in_dim(ct_buf, mwc, axis=0,
+                                        keepdims=False)
+        wacc = lax.cond(
+            live_w,
+            lambda w: jax.tree.map(
+                lambda a, d: a + d.astype(a.dtype), w, wgrad(x_w, ct_w)),
+            lambda w: w, wacc)
+        # rank 0's dx is the cotangent of x_microbatches[m]
+        cur_dx = lax.dynamic_index_in_dim(dx_inputs, mdc, axis=0,
+                                          keepdims=False)
+        dx_inputs = lax.dynamic_update_index_in_dim(
+            dx_inputs, jnp.where(live_d & (idx == 0), dx, cur_dx), mdc,
+            axis=0)
+        return (dx, ct_buf, wacc, dx_inputs), None
+
+    zeros_m = _zb_pvary(jnp.zeros_like(saved), axis)
+    dx0 = _zb_pvary(jnp.zeros_like(saved[0]), axis)
+    wacc0 = _zb_pvary(wacc0, axis)
+    (_, _, wacc, dx_inputs), _ = lax.scan(
+        step, (dx0, zeros_m, wacc0, zeros_m), jnp.arange(T_b))
+    # x_microbatches is replicated over pp; only rank 0 contributed — psum
+    # broadcasts its cotangent everywhere (zeros elsewhere)
+    dx_inputs = lax.psum(dx_inputs, axis)
+    dparams = jax.tree.map(lambda p, w: w.astype(p.dtype), stage_params,
+                           wacc)
+    return dparams, dx_inputs
+
+
+_zb.defvjp(_zb_fwd, _zb_bwd)

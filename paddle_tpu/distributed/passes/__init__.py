@@ -35,7 +35,7 @@ class TrainSpec:
     param_specs: Any = None              # PartitionSpec tree
     mesh: Any = None
     num_microbatches: int = 1
-    schedule: str = "1F1B"               # 1F1B | FThenB | VPP
+    schedule: str = "1F1B"               # 1F1B | FThenB | VPP | ZBH1
     virtual_pp: int = 1
     loss_fn_factory: Optional[Callable] = None
     applied: tuple = ()
@@ -265,9 +265,22 @@ class PipelineVPPPass(PassBase):
                                    virtual_pp=self.attrs.get("vpp_degree", 2))
 
 
+class PipelineZeroBubblePass(PassBase):
+    """reference: pipeline_scheduler_pass/pipeline_zero_bubble.py — ZB-H1:
+    the backward splits into activation-grad and weight-grad half-units
+    and weight-grads fill the bubble (spmd_pipeline_zero_bubble's
+    hand-scheduled custom_vjp)."""
+
+    name = "pipeline_scheduler_ZBH1"
+
+    def _apply_impl(self, spec):
+        return dataclasses.replace(spec, schedule="ZBH1", virtual_pp=1)
+
+
 _PASSES = {p.name: p for p in
            (AMPPass, RecomputePass, GradientMergePass, ShardingPass,
-            Pipeline1F1BPass, PipelineFThenBPass, PipelineVPPPass)}
+            Pipeline1F1BPass, PipelineFThenBPass, PipelineVPPPass,
+            PipelineZeroBubblePass)}
 
 
 def new_pass(name: str, attrs: Optional[Dict] = None) -> PassBase:

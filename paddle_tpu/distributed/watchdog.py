@@ -69,8 +69,13 @@ class CommWatchdog:
 
     # -- spans ---------------------------------------------------------------
     @contextlib.contextmanager
-    def watch(self, tag: str, timeout: float):
-        """Track one host-side operation; fires on_timeout if it overruns."""
+    def watch(self, tag: str, timeout: float = None):
+        """Track one host-side operation; fires on_timeout if it overruns.
+        Default timeout comes from FLAGS_comm_timeout_s (reference:
+        FLAGS_nccl_blocking_wait / comm watchdog timeouts)."""
+        if timeout is None:
+            from ..flags import flag
+            timeout = float(flag("comm_timeout_s"))
         now = time.monotonic()
         span = _Span(tag, now, now + timeout, threading.get_ident())
         with self._lock:

@@ -23,16 +23,21 @@ _LOCK = threading.RLock()
 
 
 class _Flag:
-    __slots__ = ("name", "type", "default", "value", "help", "env_name")
+    __slots__ = ("name", "type", "default", "value", "help", "env_name",
+                 "on_set")
 
-    def __init__(self, name: str, type_: type, default: Any, help_: str):
+    def __init__(self, name: str, type_: type, default: Any, help_: str,
+                 on_set=None):
         self.name = name
         self.type = type_
         self.default = default
         self.help = help_
+        self.on_set = on_set  # callback(value): bind the flag to behavior
         self.env_name = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
         env = os.environ.get(self.env_name)
         self.value = self._coerce(env) if env is not None else default
+        if self.on_set is not None and env is not None:
+            self.on_set(self.value)
 
     def _coerce(self, raw: Any) -> Any:
         if raw is None or isinstance(raw, self.type):
@@ -45,19 +50,25 @@ class _Flag:
 
     def set(self, v: Any) -> None:
         self.value = self._coerce(v)
+        if self.on_set is not None:
+            self.on_set(self.value)
 
 
 def _canon(name: str) -> str:
     return name if name.startswith("FLAGS_") else f"FLAGS_{name}"
 
 
-def define_flag(name: str, default: Any, help_: str = "", type_: Optional[type] = None) -> None:
-    """Register a flag. Env var FLAGS_<name> overrides the default."""
+def define_flag(name: str, default: Any, help_: str = "",
+                type_: Optional[type] = None, on_set=None) -> None:
+    """Register a flag. Env var FLAGS_<name> overrides the default.
+    `on_set(value)` binds the flag to framework behavior — it fires on
+    every set_flags() call and once at import if the env var is set."""
     with _LOCK:
         name = _canon(name)
         if name in _REGISTRY:
             return
-        _REGISTRY[name] = _Flag(name, type_ or type(default), default, help_)
+        _REGISTRY[name] = _Flag(name, type_ or type(default), default,
+                                help_, on_set)
 
 
 def flag(name: str) -> Any:
@@ -97,10 +108,35 @@ define_flag("default_dtype", "float32", "Default floating point dtype.")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation inputs on TPU.")
 define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA owns TPU HBM).")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "Compat flag; maps to XLA memory fraction.")
-define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+def _bind_matmul_precision(v):
+    import jax
+    jax.config.update("jax_default_matmul_precision",
+                      None if v == "default" else v)
+
+
+def _bind_log_level(v):
+    import logging
+    logging.getLogger("paddle_tpu").setLevel(
+        getattr(logging, str(v).upper(), logging.WARNING))
+
+
+define_flag("tpu_matmul_precision", "default",
+            "jax matmul precision: default|high|highest (bound to "
+            "jax_default_matmul_precision).", on_set=_bind_matmul_precision)
 define_flag("enable_pallas_kernels", True, "Use Pallas fused kernels where available.")
-define_flag("log_level", "WARNING", "Framework log level.")
+define_flag("log_level", "WARNING", "Framework log level (bound to the "
+            "paddle_tpu logger).", on_set=_bind_log_level)
 define_flag("comm_timeout_s", 600, "Collective watchdog timeout in seconds.")
 define_flag("embedding_deterministic", False, "Deterministic (slower) embedding grad.")
 define_flag("cudnn_deterministic", False, "Compat: deterministic ops.")
 define_flag("low_precision_op_list", 0, "Collect AMP op statistics.")
+define_flag("flash_attn_block_q", 0, "Flash attention q tile (0 = auto; "
+            "consumed by the Pallas dispatch).")
+define_flag("flash_attn_block_k", 0, "Flash attention k tile (0 = auto).")
+define_flag("use_autotune", False, "Compat (FLAGS_use_autotune): kernel "
+            "autotuning; TPU tiles are set by the measured defaults "
+            "above.")
+define_flag("sync_nccl_allreduce", True, "Compat: XLA collectives are "
+            "always in-program (no async NCCL stream to sync).")
+define_flag("max_inplace_grad_add", 0, "Compat: XLA fuses gradient "
+            "accumulation; no manual inplace-add threshold.")

@@ -29,8 +29,11 @@ def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
         else:
             bias = attn_mask
     p, seed = _dropout_seed(dropout_p, training)
-    return fa.flash_attention(query, key, value, is_causal, bias=bias,
-                              dropout_p=p, dropout_seed=seed)
+    from ...flags import flag
+    bq = flag("flash_attn_block_q") or None  # 0 = auto-pick
+    bk = flag("flash_attn_block_k") or None
+    return fa.flash_attention(query, key, value, is_causal, None, bq, bk,
+                              bias=bias, dropout_p=p, dropout_seed=seed)
 
 
 def _dropout_seed(p, training):

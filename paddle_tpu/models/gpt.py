@@ -31,8 +31,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import nn
 from ..nn import functional as F
 from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
-    spmd_pipeline, spmd_pipeline_interleaved, vpp_block_permutation,
-    vpp_chunk_blocks, vpp_wrap_shard_params)
+    spmd_pipeline, spmd_pipeline_interleaved, spmd_pipeline_zero_bubble,
+    vpp_block_permutation, vpp_chunk_blocks, vpp_wrap_shard_params)
 
 __all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
            "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
@@ -334,12 +334,15 @@ def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True):
 
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp", virtual_pp: int = 1):
+                   mp_axis="mp", virtual_pp: int = 1,
+                   schedule: str = "1F1B"):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
     the interleaved schedule (blocks must be stacked in
     vpp_block_permutation order — build_hybrid_train_step does this).
+    schedule="ZBH1" selects the zero-bubble pipeline
+    (PipelineZeroBubblePass / spmd_pipeline_zero_bubble).
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -359,6 +362,9 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         out = spmd_pipeline_interleaved(
             stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
             axis=pp_axis)
+    elif schedule == "ZBH1":
+        out = spmd_pipeline_zero_bubble(stage_fn, params["blocks"], x_mb,
+                                        axis=pp_axis)
     else:
         out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
     out = out.reshape(b_local, S, cfg.hidden_size)
@@ -375,7 +381,7 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
 def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
-                            virtual_pp: int = 1):
+                            virtual_pp: int = 1, schedule: str = "1F1B"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -383,14 +389,15 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     virtual_pp > 1 selects the interleaved schedule; shard_params then
     reorders the stacked blocks into the chunk-major layout (checkpoints
     saved from these sharded params are in that layout — reload through
-    the same shard_params).
+    the same shard_params). schedule="ZBH1" selects the zero-bubble
+    pipeline (what PipelineZeroBubblePass sets on a TrainSpec).
     """
     from .hybrid_engine import build_train_step
 
     def loss_fn(p, tokens, labels):
         return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                               dp_axis, pp_axis, mp_axis,
-                              virtual_pp=virtual_pp)
+                              virtual_pp=virtual_pp, schedule=schedule)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))

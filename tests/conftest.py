@@ -27,6 +27,50 @@ def pytest_configure(config):
         "fast tier (pytest -m 'not slow')")
 
 
+# Slow tier (VERDICT r1 #9): tests measured >= 10 s on the 8-device CPU
+# mesh — almost all dominated by repeated hybrid-engine / interpret-mode
+# compiles, not by the assertions. `pytest -m "not slow"` is the fast CI
+# tier (< 5 min); the full suite is the nightly run (see README).
+# Measured via `pytest --durations` (round 2); update when tests move.
+_SLOW_TESTS = {
+    "test_hybrid_curve_aligns_with_dense", "test_vpp_curve_aligns_with_dense",
+    "test_zero_sharded_curve_aligns", "test_tuner_end_to_end_tiny_gpt",
+    "test_fused_multi_transformer_dropout_active_in_train",
+    "test_fused_multi_transformer_jits_and_grads",
+    "test_fused_multi_transformer_prefill_decode_parity",
+    "test_ring_attention_impls_agree", "test_ring_attention_long_context_4k",
+    "test_ulysses_grad_parity", "test_gpt_generate_matches_full_reforward",
+    "test_llama_generate_matches_full_reforward",
+    "test_hybrid_grads_match_dense", "test_hybrid_train_step_loss_decreases",
+    "test_hybrid_vpp_matches_dense", "test_resnet18_fake_data_one_step",
+    "test_finished_rank_not_judged_hung", "test_restart_count_env_increments",
+    "test_hybrid_loss_matches_dense", "test_hybrid_vpp_train_step",
+    "test_moe_ep_parity_auto_vs_shard_map",
+    "test_store_barrier_cross_process", "test_vision_model_zoo_forward",
+    "test_flash_attention_bias_mask", "test_flash_attention_segment_ids",
+    "test_unpadded_and_flashmask_dispatch",
+    "test_interleaved_pipeline_matches_sequential",
+    "test_feature_layer_reference_defaults", "test_rpc_many_async",
+    "test_zero_bubble_pipeline_matches_dense",
+    "test_bert_pretraining_loss_decreases", "test_flash_attention_gqa",
+    "test_eager_forward_shape_and_loss",
+    "test_hung_worker_detected_via_heartbeat",
+    "test_feature_layers_pipeline", "test_elastic_restart_recovers",
+    "test_vocab_parallel_embedding", "test_hybrid_parallel_inference_helper",
+    "test_flash_attention_window", "test_flash_attention_grads",
+    "test_fused_multi_transformer_prefill_into_cache_then_decode",
+    "test_moe_layer_dense_math", "test_ring_attention_grad_parity",
+    "test_eager_gpt_forward_and_fit", "test_dense_forward_matches_eager_math",
+    "test_launch_two_workers_env", "test_fused_moe_matches_einsum_moe",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as paddle
