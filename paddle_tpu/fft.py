@@ -1,0 +1,115 @@
+"""paddle.fft namespace (reference: python/paddle/fft.py — c2c/r2c/c2r
+FFT surface over paddle/phi/kernels/fft_kernel). On TPU everything lowers
+to XLA's FFT HLO; norm semantics ("backward"|"ortho"|"forward") match
+numpy's, which is what the reference implements."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(range(-jnp.asarray(x).ndim, 0)) if axes is None else axes
+    out = x
+    for i, ax in enumerate(axes[:-1]):
+        out = jnp.fft.fft(out, n=None if s is None else s[i], axis=ax,
+                          norm=norm)
+    return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(range(-jnp.asarray(x).ndim, 0)) if axes is None else axes
+    out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=norm)
+    for i, ax in enumerate(axes[:-1]):
+        out = jnp.fft.ifft(out, n=None if s is None else s[i], axis=ax,
+                           norm=norm)
+    return out
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)

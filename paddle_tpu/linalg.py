@@ -1,0 +1,153 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — re-exports
+of tensor/linalg.py plus decomposition ops backed by
+paddle/phi/kernels/*/svd_kernel, qr_kernel, eigh_kernel, lu_kernel, ...).
+
+On TPU these lower to XLA's decomposition ops (jnp.linalg / jax.scipy);
+several (eig, lu with pivoting) fall back to CPU inside XLA where the TPU
+has no native lowering — same functional surface either way."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import (cholesky, cond, corrcoef, cov, det, eig, eigh,
+                     inverse, lstsq, matrix_power, matrix_rank, multi_dot,
+                     norm, pinv, qr, slogdet, solve, svd,
+                     triangular_solve)
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "householder_product", "inv", "inverse",
+    "lstsq", "lu", "lu_unpack", "matrix_exp", "matrix_power", "matrix_rank",
+    "matrix_transpose", "multi_dot", "norm", "ormqr", "pca_lowrank", "pinv",
+    "qr", "slogdet", "solve", "svd", "svdvals", "triangular_solve",
+    "vector_norm",
+]
+
+inv = inverse
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def svdvals(x, name=None):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference: paddle.linalg.lu — returns packed LU,
+    pivots, and optionally an info tensor)."""
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(x)
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_, piv.astype(jnp.int32), info
+    return lu_, piv.astype(jnp.int32)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack paddle.linalg.lu results into (P, L, U). Batched inputs
+    supported (leading dims broadcast through the pivot loop)."""
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = (jnp.tril(lu_data, -1)
+         + jnp.eye(m, n, dtype=lu_data.dtype))[..., :, :k]
+    U = jnp.triu(lu_data)[..., :k, :]
+    # pivots (LAPACK ipiv, 0-based here) -> permutation matrix; the swap
+    # loop is static over k but each swap is batched over leading dims
+    batch = lu_data.shape[:-2]
+    perm = jnp.broadcast_to(jnp.arange(m), batch + (m,))
+    for i in range(lu_pivots.shape[-1]):
+        j = lu_pivots[..., i]
+        pi = perm[..., i]
+        pj = jnp.take_along_axis(perm, j[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+        perm = perm.at[..., i].set(pj)
+        perm = jnp.where(
+            jnp.arange(m) == j[..., None], pi[..., None], perm)
+    # P[..., perm[r], r] = 1  (row-permutation matrix, P @ L @ U == A)
+    P = (perm[..., None, :] == jnp.arange(m)[:, None]).astype(lu_data.dtype)
+    out = []
+    if unpack_pivots:
+        out.append(P)
+    if unpack_ludata:
+        out.extend([L, U])
+    return tuple(out)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.cho_solve((y, not upper), x)
+
+
+def matrix_exp(x, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.expm(x)
+
+
+def matrix_transpose(x, name=None):
+    return jnp.swapaxes(x, -2, -1)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Flattened vector p-norm over `axis` (int, tuple/list, or None = all
+    dims) — always the VECTOR norm, never a matrix norm, matching the
+    reference's paddle.linalg.vector_norm."""
+    if axis is None:
+        axes = tuple(range(x.ndim))
+    elif isinstance(axis, (tuple, list)):
+        axes = tuple(a % x.ndim for a in axis)
+    else:
+        axes = (axis % x.ndim,)
+    ax = jnp.abs(x.astype(jnp.float32))
+    if p == float("inf"):
+        out = jnp.max(ax, axis=axes, keepdims=keepdim)
+    elif p == float("-inf"):
+        out = jnp.min(ax, axis=axes, keepdims=keepdim)
+    elif p == 0:
+        out = jnp.sum((ax != 0).astype(jnp.float32), axis=axes,
+                      keepdims=keepdim)
+    else:
+        out = jnp.sum(ax ** p, axis=axes, keepdims=keepdim) ** (1.0 / p)
+    return out.astype(x.dtype)
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (reference:
+    paddle.linalg.householder_product; LAPACK orgqr): columns of x hold
+    v_i (unit lower part), Q = H_0 H_1 ... H_{k-1}."""
+    m, k = x.shape[-2], tau.shape[-1]
+    Q = jnp.eye(m, dtype=x.dtype)
+    Q = jnp.broadcast_to(Q, x.shape[:-2] + (m, m)).copy() \
+        if x.ndim > 2 else Q
+    for i in range(k):
+        v = x[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[..., i].set(1.0) if hasattr(v, "at") else v
+        H = jnp.eye(m, dtype=x.dtype) - tau[..., i][..., None, None] * (
+            v[..., :, None] * jnp.conj(v[..., None, :]))
+        Q = Q @ H
+    return Q[..., :, :x.shape[-1]]
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    Q = householder_product(x, tau)
+    Qm = jnp.swapaxes(Q, -2, -1) if transpose else Q
+    return Qm @ other if left else other @ Qm
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference: paddle.linalg.pca_lowrank)."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(6, m, n) if q is None else q
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    U, S, Vh = jnp.linalg.svd(x, full_matrices=False)
+    return U[..., :q], S[..., :q], jnp.swapaxes(Vh, -2, -1)[..., :q]

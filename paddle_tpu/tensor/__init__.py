@@ -1151,6 +1151,8 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     return jnp.where(in_shard, x - lo, ignore_value)
 
 
+from ._round2 import *  # noqa: F401,F403  (round-2 op surface)
+
 _NON_API = {"jax", "jnp", "np", "lax", "builtins", "next_key",
             "List", "Optional", "Sequence", "Union", "annotations"}
 __all__ += [n for n in dir()

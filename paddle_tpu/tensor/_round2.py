@@ -1,0 +1,576 @@
+"""Round-2 tensor-op surface: the ~80 reference ops the round-1 survey
+left uncovered (reference: paddle/phi/ops/yaml/ops.yaml op schemas;
+python/paddle/tensor/{math,manipulation,linalg,search,logic}.py).
+
+Same design stance as tensor/__init__.py: the Python signature IS the op
+schema and jnp/lax/jax.scipy ARE the kernels — every function here lowers
+to XLA ops that fuse and shard like any other traced computation. Golden
+tests: tests/test_op_golden.py (round-2 section)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..random import next_key
+
+__all__: List[str] = []
+
+
+def _public(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ------------------------------------------------------------- matmul family
+
+@_public
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * (x @ y)
+
+
+@_public
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@_public
+def multi_dot(tensors, name=None):
+    return jnp.linalg.multi_dot(tensors)
+
+
+@_public
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@_public
+def vdot(x, y, name=None):
+    return jnp.vdot(x, y)
+
+
+# ---------------------------------------------------------------- elementwise
+
+@_public
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@_public
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@_public
+def floor_mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+@_public
+def frexp(x, name=None):
+    return jnp.frexp(x)
+
+
+@_public
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@_public
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+@_public
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@_public
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@_public
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@_public
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y)
+
+
+@_public
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@_public
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+@_public
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@_public
+def sgn(x, name=None):
+    """sign for real; x/|x| (or 0) for complex (reference paddle.sgn)."""
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@_public
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+@_public
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@_public
+def gammainc(x, y, name=None):
+    return jax.scipy.special.gammainc(x, y)
+
+
+@_public
+def gammaincc(x, y, name=None):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@_public
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@_public
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@_public
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+@_public
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@_public
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@_public
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@_public
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+# ------------------------------------------------------------- complex views
+
+@_public
+def complex(real, imag, name=None):
+    return lax.complex(jnp.asarray(real), jnp.asarray(imag))
+
+
+@_public
+def polar(abs, angle, name=None):
+    return lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+@_public
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+@_public
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+@_public
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+@_public
+def isreal(x, name=None):
+    if is_complex(x):
+        return jnp.imag(x) == 0
+    return jnp.ones(jnp.asarray(x).shape, bool)
+
+
+@_public
+def is_tensor(x):
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+# ------------------------------------------------------------ predicates etc.
+
+@_public
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+@_public
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+@_public
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+@_public
+def rank(x):
+    return jnp.asarray(jnp.asarray(x).ndim)
+
+
+# -------------------------------------------------------------- manipulation
+
+@_public
+def broadcast_tensors(inputs, name=None):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+@_public
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+@_public
+def fliplr(x, name=None):
+    return jnp.fliplr(x)
+
+
+@_public
+def flipud(x, name=None):
+    return jnp.flipud(x)
+
+
+@_public
+def hsplit(x, num_or_indices, name=None):
+    return jnp.hsplit(x, num_or_indices)
+
+
+@_public
+def vsplit(x, num_or_indices, name=None):
+    return jnp.vsplit(x, num_or_indices)
+
+
+@_public
+def dsplit(x, num_or_indices, name=None):
+    return jnp.dsplit(x, num_or_indices)
+
+
+@_public
+def hstack(x, name=None):
+    return jnp.hstack(x)
+
+
+@_public
+def vstack(x, name=None):
+    return jnp.vstack(x)
+
+
+@_public
+def dstack(x, name=None):
+    return jnp.dstack(x)
+
+
+@_public
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return jnp.array_split(x, num_or_indices, axis=axis)
+
+
+@_public
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    new = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new)
+
+
+@_public
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (reference paddle.unfold on tensors /
+    torch.Tensor.unfold): output gains a trailing window dim."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]  # [n, size]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    out = out.reshape(x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    # trailing window dim (torch/paddle convention)
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+@_public
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+@_public
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@_public
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(shape_or_dtype)
+    return lax.bitcast_convert_type(x, shape_or_dtype)
+
+
+@_public
+def view_as(x, other, name=None):
+    return x.reshape(other.shape)
+
+
+@_public
+def take(x, index, mode="raise", name=None):
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return jnp.take(x.reshape(-1), index, mode=jmode)
+
+
+@_public
+def combinations(x, r=2, with_replacement=False, name=None):
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(gen), dtype=np.int32).reshape(-1, r)
+    return x[idx]
+
+
+@_public
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.dtype(dtype) if dtype != "int64"
+                                    else jnp.int32)
+
+
+@_public
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.dtype(dtype) if dtype != "int64"
+                                    else jnp.int32)
+
+
+# --------------------------------------------------------- scatter-style ops
+
+@_public
+def index_fill(x, index, axis, value, name=None):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@_public
+def select_scatter(x, values, axis, index, name=None):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(values)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@_public
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+@_public
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the (offset, axis1, axis2) diagonal of x."""
+    a1, a2 = axis1 % x.ndim, axis2 % x.ndim
+    moved = jnp.moveaxis(x, (a1, a2), (-2, -1))
+    n, m = moved.shape[-2], moved.shape[-1]
+    if offset >= 0:
+        rows = jnp.arange(min(n, m - offset))
+        cols = rows + offset
+    else:
+        cols = jnp.arange(min(m, n + offset))
+        rows = cols - offset
+    moved = moved.at[..., rows, cols].set(y)
+    return jnp.moveaxis(moved, (-2, -1), (a1, a2))
+
+
+@_public
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    n = min(x.shape[-2], x.shape[-1] - offset) if offset >= 0 else \
+        min(x.shape[-1], x.shape[-2] + offset)
+    return diagonal_scatter(
+        x, jnp.full(x.shape[:-2] + (n,), value, x.dtype), offset,
+        x.ndim - 2, x.ndim - 1)
+
+
+@_public
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of mask with consecutive elements of value
+    (row-major), like the reference masked_scatter."""
+    mask = jnp.broadcast_to(mask, x.shape)
+    flat_m = mask.reshape(-1)
+    # position i takes value[#True before i]
+    src_idx = jnp.cumsum(flat_m) - 1
+    vals = jnp.take(value.reshape(-1), jnp.clip(src_idx, 0, None),
+                    mode="clip")
+    out = jnp.where(flat_m, vals.astype(x.dtype), x.reshape(-1))
+    return out.reshape(x.shape)
+
+
+# --------------------------------------------------------------- reductions
+
+@_public
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@_public
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+@_public
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@_public
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@_public
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@_public
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0,
+                         axis=axis)
+
+
+@_public
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoid rule along axis (reference:
+    paddle.cumulative_trapezoid)."""
+    y = jnp.asarray(y)
+    ym = jnp.moveaxis(y, axis, -1)
+    avg = (ym[..., 1:] + ym[..., :-1]) * 0.5
+    if x is not None:
+        xm = jnp.moveaxis(jnp.asarray(x), axis, -1) if jnp.asarray(x).ndim \
+            else jnp.asarray(x)
+        d = jnp.diff(xm, axis=-1) if jnp.asarray(x).ndim else x
+        avg = avg * d
+    else:
+        avg = avg * (1.0 if dx is None else dx)
+    return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+
+@_public
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along `axis` to p-norm <= max_norm."""
+    axis = axis % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=red, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+# ------------------------------------------------------------------ search
+
+@_public
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(sorted_sequence, x,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+@_public
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=rng)
+
+
+@_public
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+# ------------------------------------------------------------ random inplace
+# (the reference's *_ inplace random ops return the refilled tensor; under
+# a functional runtime "inplace" means "same shape/dtype, new value")
+
+@_public
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    return jax.random.uniform(next_key(), x.shape, x.dtype, min, max)
+
+
+@_public
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(next_key(), x.shape, jnp.float32, 1e-7, 1.0)
+    return (jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1).astype(x.dtype)
+
+
+@_public
+def zero_(x, name=None):
+    return jnp.zeros_like(x)
+
+
+@_public
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=dtype)
+
+
+@_public
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row vectors ([..., M, D] x [..., N, D]
+    -> [..., M, N]). For p=2 (unless compute_mode forbids it) the matmul
+    expansion ||x||^2 + ||y||^2 - 2 x@y^T keeps memory O(M*N) instead of
+    materializing the [M, N, D] difference tensor — and puts the FLOPs on
+    the MXU."""
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        x2 = jnp.sum(x * x, axis=-1)[..., :, None]
+        y2 = jnp.sum(y * y, axis=-1)[..., None, :]
+        xy = jnp.matmul(x, jnp.swapaxes(y, -2, -1))
+        return jnp.sqrt(jnp.maximum(x2 + y2 - 2.0 * xy, 0.0))
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
