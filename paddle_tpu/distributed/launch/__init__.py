@@ -1,15 +1,37 @@
 """Distributed launcher (reference: python/paddle/distributed/launch/ —
-`fleetrun` / `python -m paddle.distributed.launch`, entry launch/main.py:23).
+`fleetrun` / `python -m paddle.distributed.launch`, entry launch/main.py:23;
+auto-tuner mode: launch/main.py `--auto_tuner_json` trial loop).
 """
 
 from .context import Context
 from .controllers import (CollectiveController, ELASTIC_EXIT_CODE,
                           ELASTIC_AUTO_PARALLEL_EXIT_CODE)
 
-__all__ = ["Context", "CollectiveController", "launch", "ELASTIC_EXIT_CODE",
-           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+__all__ = ["Context", "CollectiveController", "launch", "scale_job",
+           "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+
+def scale_job(master: str, job_id: str, np: int) -> None:
+    """Request an elastic scale in/out of a running job: sets the desired
+    world size on the job's store; the controller's watch loop rebuilds
+    the pod at the new size (reference: changing PADDLE_ELASTIC_NP under
+    fleet/elastic/manager.py)."""
+    from ..store import TCPStore
+    from .elastic import ElasticManager
+    host, port = master.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=False)
+    try:
+        ElasticManager(store, job_id, np=np).set_desired_np(np)
+    finally:
+        store.close()
 
 
 def launch(argv=None) -> int:
     ctx = Context(argv)
+    if ctx.args.auto_tune:
+        from .auto_tune import run_auto_tune
+        best = run_auto_tune(ctx)
+        if best is not None:
+            # the real run sees the winning candidate the same way trials do
+            ctx.envs["PADDLE_AUTO_TUNER_CANDIDATE"] = best
     return CollectiveController(ctx).run()

@@ -1,0 +1,132 @@
+"""Launcher-wired auto-tuner (reference: launch/main.py auto-tuner mode —
+`--auto_tuner_json` drives subprocess trials of the user's own training
+script over dp×mp×pp×sharding×micro_batches, reading one metric back per
+trial, then launches the real job with the winner).
+
+Trial protocol (what the training script sees):
+  PADDLE_AUTO_TUNER_CANDIDATE = "dp,mp,pp,sharding,micro_batches"
+  PADDLE_AUTO_TUNER_TRIAL     = "1" (run a few steps, then exit 0)
+  PADDLE_AUTO_TUNER_METRIC_FILE = path — write ONE float (higher=better)
+
+Script-side helpers: `candidate_from_env()` parses the candidate into an
+auto_tuner.Candidate; `report_metric(value)` writes the metric file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+from ..auto_tuner.tuner import (AutoTuner, Candidate, generate_candidates,
+                                prune_candidates)
+
+__all__ = ["run_auto_tune", "candidate_from_env", "report_metric"]
+
+
+def candidate_from_env() -> Optional[Candidate]:
+    raw = os.environ.get("PADDLE_AUTO_TUNER_CANDIDATE")
+    if not raw:
+        return None
+    dp, mp, pp, sh, mb = (int(v) for v in raw.split(","))
+    return Candidate(dp=dp, mp=mp, pp=pp, sharding=sh, micro_batches=mb)
+
+
+def is_trial() -> bool:
+    return os.environ.get("PADDLE_AUTO_TUNER_TRIAL") == "1"
+
+
+def report_metric(value: float) -> None:
+    path = os.environ.get("PADDLE_AUTO_TUNER_METRIC_FILE")
+    if path:
+        with open(path, "w") as f:
+            f.write(repr(float(value)))
+
+
+def _candidate_env(cand: Candidate) -> str:
+    return (f"{cand.dp},{cand.mp},{cand.pp},{cand.sharding},"
+            f"{cand.micro_batches}")
+
+
+def run_auto_tune(ctx) -> Optional[str]:
+    """Run the candidate search with the user's own training script as the
+    trial body. Returns the winning candidate env string (or None)."""
+    from .controllers import CollectiveController
+
+    if ctx.args.nnodes != 1:
+        # per-node sweeps would race to different winners and hand ranks
+        # inconsistent meshes; a store-synchronized multi-node sweep is
+        # future work (the reference's auto-tuner is likewise driven from
+        # one launcher)
+        raise ValueError(
+            "--auto_tune currently supports single-node jobs only "
+            "(nnodes=1); run the sweep on one node and pass the winning "
+            "candidate to the multi-node job via "
+            "PADDLE_AUTO_TUNER_CANDIDATE")
+
+    cfg = {}
+    if ctx.args.auto_tuner_json:
+        with open(ctx.args.auto_tuner_json) as f:
+            cfg = json.load(f)
+    world = ctx.args.nnodes * ctx.nproc
+    cands = generate_candidates(
+        world,
+        micro_batch_options=tuple(cfg.get("micro_batch_options", (1, 2, 4))),
+        use_sharding=bool(cfg.get("use_sharding", True)))
+    if any(k in cfg for k in ("global_batch", "num_layers", "num_heads")):
+        cands = prune_candidates(
+            cands,
+            global_batch=cfg.get("global_batch", 8),
+            num_layers=cfg.get("num_layers", 1),
+            num_heads=cfg.get("num_heads", 1),
+            hidden_size=cfg.get("hidden_size", 64),
+            vocab_size=cfg.get("vocab_size", 64),
+            seq_len=cfg.get("seq_len", 128),
+            hbm_gb=cfg.get("hbm_gb"),
+            num_params=cfg.get("num_params"),
+            max_mp=cfg.get("max_mp"))
+
+    def run_trial(cand: Candidate) -> Optional[float]:
+        fd, metric_file = tempfile.mkstemp(prefix="autotune_")
+        os.close(fd)
+        try:
+            trial_ctx = _clone(ctx)
+            trial_ctx.envs.update({
+                "PADDLE_AUTO_TUNER_CANDIDATE": _candidate_env(cand),
+                "PADDLE_AUTO_TUNER_TRIAL": "1",
+                "PADDLE_AUTO_TUNER_METRIC_FILE": metric_file,
+            })
+            trial_ctx.args.job_id = f"{ctx.args.job_id}-tune-{cand}"
+            rc = CollectiveController(trial_ctx).run()
+            if rc != 0:
+                return None
+            with open(metric_file) as f:
+                raw = f.read().strip()
+            return float(raw) if raw else None
+        finally:
+            os.unlink(metric_file)
+
+    tuner = AutoTuner(run_trial,
+                      max_trials=cfg.get("max_trials"),
+                      max_time_s=cfg.get("max_time_s"))
+    best = tuner.tune(cands)
+    print(tuner.summary())
+    if best is None:
+        return None
+    print(f"auto-tuner winner: {best}")
+    return _candidate_env(best)
+
+
+def _clone(ctx):
+    """Fresh Context for a trial: same argv surface, isolated env/args so
+    trial job_ids and env markers don't leak into the real run."""
+    import argparse
+    import copy
+
+    new = object.__new__(type(ctx))
+    new.args = argparse.Namespace(**vars(ctx.args))
+    new.node = ctx.node
+    new.nproc = ctx.nproc
+    new.envs = dict(ctx.envs)
+    return new

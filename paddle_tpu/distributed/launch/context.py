@@ -40,6 +40,23 @@ def parse_args(argv: Optional[List[str]] = None):
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_MAX_RESTARTS", "3")))
     p.add_argument("--rdzv_timeout", type=float, default=120.0)
+    p.add_argument("--elastic_np", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_NP", "0")),
+                   help="initial desired world size for elastic scale "
+                        "in/out (0 = nnodes * nproc_per_node); the job "
+                        "rescales when scale_job() changes the desired "
+                        "size on the store (reference: PADDLE_ELASTIC_NP "
+                        "watch in fleet/elastic/manager.py)")
+    p.add_argument("--auto_tune", action="store_true",
+                   default=os.environ.get("PADDLE_AUTO_TUNE", "") == "1",
+                   help="search dp*mp*pp*sharding*micro_batches before the "
+                        "real run (reference: launch auto-tuner mode)")
+    p.add_argument("--auto_tuner_json", default=None,
+                   help="json with model dims for candidate pruning and "
+                        "trial limits (global_batch, num_layers, "
+                        "num_heads, hidden_size, vocab_size, seq_len, "
+                        "hbm_gb, num_params, micro_batch_options, "
+                        "max_trials, max_time_s)")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)

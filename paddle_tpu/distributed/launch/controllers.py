@@ -105,13 +105,20 @@ class CollectiveController:
         self.master = Master(ctx)
         self.containers: List[Container] = []
         self.restarts = 0
+        self.rescales = 0
         self.generation = 0
         self._elastic = None
         if ctx.args.elastic_level >= 1:
             from .elastic import ElasticManager
+            # --elastic_np shapes the FIRST pod directly (no wasted
+            # build-then-rescale cycle, no restart credit burned)
+            want = getattr(ctx.args, "elastic_np", 0)
+            if want and want % ctx.args.nnodes == 0:
+                ctx.nproc = want // ctx.args.nnodes
             world = ctx.args.nnodes * ctx.nproc
             self._elastic = ElasticManager(
                 self.master.store, ctx.args.job_id, np=world)
+            self._rescale_seen = self._elastic.rescale_seq()
 
     def _gen_key(self) -> str:
         return f"rdzv/{self.ctx.args.job_id}/generation"
@@ -136,7 +143,7 @@ class CollectiveController:
             "PADDLE_JOB_ID": ctx.args.job_id,
             # elastic: scripts check this to auto-resume from checkpoints
             # (reference: PADDLE_RESTART semantics in elastic manager)
-            "PADDLE_RESTART_COUNT": str(self.restarts),
+            "PADDLE_RESTART_COUNT": str(self.restarts + self.rescales),
             # workers may opt into heartbeats via launch.elastic
             "PADDLE_ELASTIC_STORE_ENDPOINT":
                 f"{self.master.store.host}:{self.master.store.port}",
@@ -203,6 +210,37 @@ class CollectiveController:
         self.restarts += 1
         self.build_pod(generation=new_gen)
 
+    def _adopt_np(self, new_np: int) -> bool:
+        """Adopt a new desired world size (shared by the driving node and
+        multi-node followers). Rejects non-divisible requests with a
+        warning — a bad external scale_job() must not kill a healthy
+        job."""
+        ctx = self.ctx
+        if new_np <= 0 or new_np % ctx.args.nnodes != 0:
+            print(f"elastic rescale rejected: desired np {new_np} not "
+                  f"divisible by nnodes {ctx.args.nnodes}", file=sys.stderr)
+            return False
+        ctx.nproc = new_np // ctx.args.nnodes
+        self._elastic.np = new_np
+        self._elastic.invalidate_cache()
+        return True
+
+    def _rescale_pod(self, new_np: int):
+        """Scale in/out (reference: fleet/elastic/manager.py watching
+        PADDLE_ELASTIC_NP): adopt the new world size, tear the pod down
+        and re-rendezvous at a bumped generation (multi-node followers
+        pick the change up through the generation counter)."""
+        if not self._adopt_np(new_np):
+            return
+        for c in self.containers:
+            c.terminate()
+        # a rescale is not a failure: it doesn't consume max_restarts
+        # budget, but workers still see a bumped PADDLE_RESTART_COUNT so
+        # checkpoint auto-resume kicks in
+        self.rescales += 1
+        new_gen = self.master.store.add(self._gen_key(), 1)
+        self.build_pod(generation=new_gen)
+
     def watch(self, poll_interval: float = 0.2) -> int:
         """Wait for the pod. On worker failure: tear down (level 0), or
         rebuild across all nodes up to max_restarts (level >= 1 for
@@ -215,12 +253,28 @@ class CollectiveController:
             if all(c == 0 for c in codes):
                 return 0
 
+            # scale in/out: someone bumped the rescale counter via
+            # scale_job(); node 0 drives, other nodes follow through the
+            # generation bump below. The counter poll is one cheap
+            # non-blocking add(key, 0) per tick (a desired_np get would
+            # block 50 ms per tick in the steady state).
+            if (self._elastic is not None and ctx.args.node_rank == 0
+                    and self._elastic.rescale_seq() > self._rescale_seen):
+                self._rescale_seen = self._elastic.rescale_seq()
+                if self._elastic.need_rescale():
+                    self._rescale_pod(self._elastic.desired_np())
+                    continue
+
             # another node already moved to a newer generation: follow it
+            # (adopting any rescaled world size first)
             if ctx.args.elastic_level >= 1 and ctx.is_multi_node:
                 cur = self._current_generation()
                 if cur > self.generation:
                     for c in self.containers:
                         c.terminate()
+                    if (self._elastic is not None
+                            and self._elastic.need_rescale()):
+                        self._adopt_np(self._elastic.desired_np())
                     self.restarts += 1
                     self.build_pod(generation=cur)
                     continue

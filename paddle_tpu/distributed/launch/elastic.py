@@ -130,6 +130,15 @@ class ElasticManager:
     # -- desired world size (scale in/out) -----------------------------------
     def set_desired_np(self, np: int):
         self.store.set(self._key("desired_np"), str(np))
+        # bump the cheap change counter LAST so a watcher that sees the
+        # bump always finds the new value
+        self.store.add(self._key("rescale_seq"), 1)
+
+    def rescale_seq(self) -> int:
+        """Non-blocking change counter: the watch loop polls this (one
+        cheap add(key, 0) RPC) instead of a blocking get on desired_np
+        every tick."""
+        return self.store.add(self._key("rescale_seq"), 0)
 
     def desired_np(self) -> int:
         try:
