@@ -7,6 +7,9 @@ Populated incrementally: layers/ (TP), utils/ (SP), recompute/, meta_parallel/
 """
 
 from . import layers, meta_optimizers, meta_parallel, recompute, utils  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import (DataGenerator, InMemoryDataset,  # noqa: F401
+                      MultiSlotDataGenerator, QueueDataset)
 from .distributed_strategy import DistributedStrategy
 from .fleet import (Fleet, collective_perf, distributed_model,
                     distributed_optimizer, fleet,
@@ -17,6 +20,8 @@ from .meta_optimizers import (HybridParallelClipGrad, HybridParallelGradScaler,
 # make `fleet.init(...)` work both as `from paddle_tpu.distributed import
 # fleet` (module with these names) and `fleet.fleet.init` (singleton).
 __all__ = ["layers", "meta_parallel", "meta_optimizers", "recompute", "utils",
+           "dataset", "DataGenerator", "MultiSlotDataGenerator",
+           "InMemoryDataset", "QueueDataset",
            "DistributedStrategy", "Fleet", "fleet", "init",
            "distributed_model", "distributed_optimizer",
            "get_hybrid_communicate_group", "collective_perf",

@@ -1,0 +1,245 @@
+"""PS-mode datasets (reference:
+python/paddle/distributed/fleet/dataset/dataset.py — DatasetBase :96,
+InMemoryDataset :410 with load_into_memory/local_shuffle/global_shuffle/
+release_memory, QueueDataset :1389; data generators:
+fleet/data_generator/data_generator.py — DataGenerator :25,
+MultiSlotDataGenerator line protocol).
+
+TPU shape: the reference backs these with a C++ MultiSlot feed and brpc
+shuffles; here files parse on the host through a DataGenerator into
+per-slot numpy columns, shuffles are host-side permutations
+(global_shuffle exchanges sample ranges through the job's TCP store), and
+batches come out as dicts of arrays ready for jnp.asarray — the natural
+feed for a jit'd PS/embedding step."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "DataGenerator", "MultiSlotDataGenerator"]
+
+
+class DataGenerator:
+    """Line → samples adaptor (reference DataGenerator): subclass and
+    implement generate_sample(line) returning an iterator that yields
+    [(slot_name, [values...]), ...] per sample. Override generate_batch
+    for batch-level rewrites (negative sampling etc.) — it is invoked on
+    every assembled batch's sample list."""
+
+    def set_batch(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator of "
+            "[(slot, values), ...]")
+
+    def generate_batch(self, samples):
+        """Batch-level hook (reference parity): default passthrough."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def run_from_stdin(self):  # pragma: no cover - CLI protocol
+        import sys
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                sys.stdout.write(self._gen_str(sample))
+
+    def _gen_str(self, sample) -> str:
+        """MultiSlot text protocol: `slot_count v1 v2 ...` per slot."""
+        out = []
+        for _, values in sample:
+            out.append(str(len(values)))
+            out.extend(str(v) for v in values)
+        return " ".join(out) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """(reference MultiSlotDataGenerator) validates the slot structure."""
+
+    def _gen_str(self, sample) -> str:
+        if not isinstance(sample, (list, tuple)):
+            raise ValueError("sample must be [(slot, values), ...]")
+        for slot, values in sample:
+            if not values:
+                raise ValueError(f"slot {slot!r} has no values")
+        return super()._gen_str(sample)
+
+
+class DatasetBase:
+    """(reference DatasetBase.init — batch_size/thread_num/use_var/pipe)"""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_var: Sequence[str] = []
+        self.filelist: List[str] = []
+        self.generator_factory: Optional[Callable[[], DataGenerator]] = None
+        self.pipe_command = ""
+
+    def init(self, batch_size: int = 1, thread_num: int = 1,
+             use_var: Sequence[str] = (), pipe_command: str = "",
+             fs_name: str = "", fs_ugi: str = "", **kwargs):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.use_var = list(use_var)
+        self.pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, use_var: Sequence[str]):
+        self.use_var = list(use_var)
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = thread_num
+
+    def set_generator(self, factory: Callable[[], DataGenerator]):
+        """TPU-native replacement for pipe_command subprocesses: a factory
+        returning the DataGenerator that parses each line."""
+        self.generator_factory = factory
+
+    # -- parsing -------------------------------------------------------------
+    def _parse_file(self, path: str) -> List[List]:
+        gen = self.generator_factory() if self.generator_factory else None
+        samples = []
+        with open(path) as f:
+            for line in f:
+                if gen is not None:
+                    for s in gen.generate_sample(line)():
+                        samples.append(s)
+                else:
+                    # raw MultiSlot text protocol with use_var slot names
+                    vals = line.split()
+                    i = 0
+                    sample = []
+                    for slot in self.use_var:
+                        n = int(vals[i]); i += 1
+                        xs = [float(v) if ("." in v or "e" in v) else int(v)
+                              for v in vals[i:i + n]]
+                        i += n
+                        sample.append((slot, xs))
+                    samples.append(sample)
+        return samples
+
+    def _batches(self, samples: List[List]) -> Iterator[Dict[str, object]]:
+        bs = self.batch_size
+        gen = self.generator_factory() if self.generator_factory else None
+        for i in range(0, len(samples) - bs + 1, bs):
+            chunk = samples[i:i + bs]
+            if gen is not None:  # batch-level hook (reference parity)
+                chunk = list(gen.generate_batch(chunk)())
+            out: Dict[str, object] = {}
+            for slot_idx, (slot, _) in enumerate(chunk[0]):
+                cols = [s[slot_idx][1] for s in chunk]
+                width = max(len(c) for c in cols)
+                # float if ANY value is float (a first-row int column must
+                # not truncate later float rows)
+                is_float = any(isinstance(v, float) for c in cols for v in c)
+                arr = np.zeros((len(chunk), width),
+                               np.float32 if is_float else np.int64)
+                lens = np.zeros((len(chunk),), np.int64)
+                for r, c in enumerate(cols):
+                    arr[r, :len(c)] = c
+                    lens[r] = len(c)
+                out[slot] = arr
+                out[slot + "@len"] = lens  # ragged lengths (LoD equivalent)
+            yield out
+
+
+class QueueDataset(DatasetBase):
+    """(reference QueueDataset) streaming: parse file-by-file, never hold
+    the whole corpus. Partial batches carry over across file boundaries —
+    only the corpus-final remainder (< batch_size) is dropped."""
+
+    def __iter__(self) -> Iterator[Dict[str, object]]:
+        pending: List[List] = []
+        for path in self.filelist:
+            pending.extend(self._parse_file(path))
+            n_full = (len(pending) // self.batch_size) * self.batch_size
+            if n_full:
+                yield from self._batches(pending[:n_full])
+                pending = pending[n_full:]
+
+
+class InMemoryDataset(DatasetBase):
+    """(reference InMemoryDataset) load once, shuffle in memory, iterate
+    many epochs."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List[List] = []
+        self._seed = 0
+
+    def load_into_memory(self, is_shuffle: bool = False):
+        self._memory = []
+        for path in self.filelist:
+            self._memory.extend(self._parse_file(path))
+        if is_shuffle:
+            self.local_shuffle()
+
+    def local_shuffle(self):
+        rng = random.Random(self._seed)
+        self._seed += 1
+        rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        """Exchange samples across ranks through the job's TCP store (the
+        reference shuffles through the PS): every rank publishes its
+        buffer, rank r keeps global samples with index % world == r.
+        Keys carry a per-call generation so repeated shuffles (one per
+        epoch) never merge a peer's stale previous-round buffer; every
+        rank must call this the same number of times with the same seed
+        history (both hold by construction — the call sites are SPMD)."""
+        del thread_num
+        import jax
+        world = jax.process_count()
+        if world == 1 or not os.environ.get("PADDLE_MASTER"):
+            self.local_shuffle()
+            return
+        from ..store import TCPStore
+        host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=False)
+        rank = jax.process_index()
+        gen = self._seed  # advances once per shuffle on every rank
+        try:
+            store.set(f"ds_shuffle/g{gen}/{rank}",
+                      pickle.dumps(self._memory))
+            merged: List[List] = []
+            for r in range(world):
+                merged.extend(pickle.loads(
+                    store.get(f"ds_shuffle/g{gen}/{r}")))
+            rng = random.Random(self._seed)
+            self._seed += 1
+            rng.shuffle(merged)
+            self._memory = merged[rank::world]
+            # free the previous round's payload (everyone has read it by
+            # the time this round's get()s completed)
+            if gen > 0:
+                store.delete_key(f"ds_shuffle/g{gen - 1}/{rank}")
+        finally:
+            store.close()
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def __iter__(self) -> Iterator[Dict[str, object]]:
+        yield from self._batches(self._memory)

@@ -23,6 +23,8 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
 
 
 class Distribution:
+    event_rank = 0  # trailing dims that form one event (1 for MVN etc.)
+
     def sample(self, shape=(), key=None):
         raise NotImplementedError
 
@@ -291,3 +293,10 @@ def _kl_unif_unif(p: Uniform, q: Uniform):
     inside = (p.low >= q.low) & (p.high <= q.high)
     kl = jnp.log((q.high - q.low) / (p.high - p.low))
     return jnp.where(inside, kl, jnp.inf)
+
+
+# round-2 surface: more distributions + the transform family (must come
+# last: _round2 imports the base classes from this module)
+from ._round2 import *  # noqa: E402,F401,F403
+from ._round2 import __all__ as _r2_all
+__all__ += list(_r2_all)

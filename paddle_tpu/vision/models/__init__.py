@@ -1,9 +1,35 @@
+from .alexnet import AlexNet, alexnet
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3
 from .lenet import LeNet
+from .mobilenetv1 import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,
+                          mobilenet_v3_large, mobilenet_v3_small)
 from .resnet import *  # noqa: F401,F403
 from .resnet import __all__ as _resnet_all
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_swish,
+                           shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+                           shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                           shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 
 __all__ = (list(_resnet_all)
            + ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
-              "MobileNetV2", "mobilenet_v2", "LeNet"])
+              "MobileNetV1", "mobilenet_v1",
+              "MobileNetV2", "mobilenet_v2",
+              "MobileNetV3Large", "MobileNetV3Small",
+              "mobilenet_v3_large", "mobilenet_v3_small",
+              "LeNet", "AlexNet", "alexnet",
+              "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+              "DenseNet", "densenet121", "densenet161", "densenet169",
+              "densenet201", "densenet264",
+              "GoogLeNet", "googlenet",
+              "InceptionV3", "inception_v3",
+              "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+              "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+              "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+              "shufflenet_v2_swish"])

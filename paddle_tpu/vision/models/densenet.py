@@ -1,0 +1,107 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(inp)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return jnp.concatenate([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, inp, out):
+        super().__init__(nn.BatchNorm2D(inp), nn.ReLU(),
+                         nn.Conv2D(inp, out, 1, bias_attr=False),
+                         nn.AvgPool2D(2, 2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers: int = 121, bn_size: int = 4,
+                 dropout: float = 0.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        assert layers in _CFG, f"layers must be one of {sorted(_CFG)}"
+        init_c, growth, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(),
+                 nn.MaxPool2D(3, 2, padding=1)]
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+def _make(layers, pretrained, **kw):
+    assert not pretrained, "pretrained weights are not bundled"
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _make(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _make(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _make(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _make(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _make(264, pretrained, **kw)

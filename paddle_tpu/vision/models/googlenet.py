@@ -1,0 +1,92 @@
+"""GoogLeNet / Inception v1 (reference:
+python/paddle/vision/models/googlenet.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvReLU(nn.Sequential):
+    def __init__(self, inp, out, kernel, stride=1, padding=0):
+        super().__init__(nn.Conv2D(inp, out, kernel, stride, padding),
+                         nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvReLU(inp, c1, 1)
+        self.b2 = nn.Sequential(_ConvReLU(inp, c3r, 1),
+                                _ConvReLU(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvReLU(inp, c5r, 1),
+                                _ConvReLU(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                _ConvReLU(inp, proj, 1))
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b2(x), self.b3(x),
+                                self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Returns (main, aux1, aux2) logits like the reference (aux heads are
+    train-time classifiers; both None when num_classes <= 0)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, 2, 3), nn.MaxPool2D(3, 2, padding=1),
+            _ConvReLU(64, 64, 1), _ConvReLU(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (reference keeps them on the forward signature)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Conv2D(512, 128, 1), nn.ReLU(),
+                nn.Flatten(), nn.Linear(128 * 16, 1024), nn.ReLU(),
+                nn.Dropout(0.7), nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Conv2D(528, 128, 1), nn.ReLU(),
+                nn.Flatten(), nn.Linear(128 * 16, 1024), nn.ReLU(),
+                nn.Dropout(0.7), nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.fc(self.dropout(x))
+        return x, a1, a2
+
+
+def googlenet(pretrained: bool = False, **kwargs) -> GoogLeNet:
+    assert not pretrained, "pretrained weights are not bundled"
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,60 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, inp, out, kernel=3, stride=1, groups=1):
+        pad = (kernel - 1) // 2
+        super().__init__(
+            nn.Conv2D(inp, out, kernel, stride, pad, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out), nn.ReLU())
+
+
+class _DepthwiseSeparable(nn.Sequential):
+    def __init__(self, inp, out, stride):
+        super().__init__(
+            _ConvBNReLU(inp, inp, 3, stride, groups=inp),
+            _ConvBNReLU(inp, out, 1))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, 2)]
+        c = s(32)
+        for out, stride in cfg:
+            layers.append(_DepthwiseSeparable(c, s(out), stride))
+            c = s(out)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+        self._out_c = c
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained: bool = False, scale: float = 1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV1(scale=scale, **kwargs)

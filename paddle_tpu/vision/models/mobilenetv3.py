@@ -1,0 +1,129 @@
+"""MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+class _SE(nn.Layer):
+    def __init__(self, c, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(c // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, inp, out, kernel=3, stride=1, groups=1, act=None):
+        pad = (kernel - 1) // 2
+        layers = [nn.Conv2D(inp, out, kernel, stride, pad, groups=groups,
+                            bias_attr=False), nn.BatchNorm2D(out)]
+        if act == "relu":
+            layers.append(nn.ReLU())
+        elif act == "hardswish":
+            layers.append(nn.Hardswish())
+        super().__init__(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, mid, out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if mid != inp:
+            layers.append(_ConvBNAct(inp, mid, 1, act=act))
+        layers.append(_ConvBNAct(mid, mid, kernel, stride, groups=mid,
+                                 act=act))
+        if use_se:
+            layers.append(_SE(mid))
+        layers.append(_ConvBNAct(mid, out, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_LARGE = [
+    # k, mid, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        sc = lambda c: _make_divisible(c * scale)
+        layers = [_ConvBNAct(3, sc(16), 3, 2, act="hardswish")]
+        c = sc(16)
+        for k, mid, out, se, act, stride in cfg:
+            layers.append(_InvertedResidual(c, sc(mid), sc(out), k, stride,
+                                            se, act))
+            c = sc(out)
+        lastconv = sc(cfg[-1][1])
+        layers.append(_ConvBNAct(c, lastconv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv, last_c), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV3Small(scale=scale, **kw)

@@ -1,0 +1,142 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups: int):
+    n, c, h, w = x.shape
+    x = x.reshape((n, groups, c // groups, h, w))
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape((n, c, h, w))
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, inp, out, kernel, stride, groups=1, act="relu",
+                 use_act=True):
+        pad = (kernel - 1) // 2
+        layers = [nn.Conv2D(inp, out, kernel, stride, pad, groups=groups,
+                            bias_attr=False), nn.BatchNorm2D(out)]
+        if use_act:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    """stride-1 unit: split, transform one half, concat + shuffle."""
+
+    def __init__(self, c, act):
+        super().__init__()
+        half = c // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(half, half, 1, 1, act=act),
+            _ConvBNAct(half, half, 3, 1, groups=half, use_act=False),
+            _ConvBNAct(half, half, 1, 1, act=act))
+
+    def forward(self, x):
+        x1 = x[:, :x.shape[1] // 2]
+        x2 = x[:, x.shape[1] // 2:]
+        out = jnp.concatenate([x1, self.branch(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class _DownsampleUnit(nn.Layer):
+    def __init__(self, inp, out, act):
+        super().__init__()
+        half = out // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(inp, inp, 3, 2, groups=inp, use_act=False),
+            _ConvBNAct(inp, half, 1, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(inp, half, 1, 1, act=act),
+            _ConvBNAct(half, half, 3, 2, groups=half, use_act=False),
+            _ConvBNAct(half, half, 1, 1, act=act))
+
+    def forward(self, x):
+        out = jnp.concatenate([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        assert scale in _STAGE_OUT, f"scale must be one of {sorted(_STAGE_OUT)}"
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _ConvBNAct(3, c0, 3, 2, act=act)
+        self.pool1 = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        c = c0
+        for out, repeats in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_DownsampleUnit(c, out, act))
+            stages.extend(_InvertedResidual(out, act)
+                          for _ in range(repeats - 1))
+            c = out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(c, c_last, 1, 1, act=act)
+        if with_pool:
+            self.pool2 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool2(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+def _make(scale, act, pretrained, **kw):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _make(0.25, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _make(0.33, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _make(0.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _make(1.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _make(1.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _make(2.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _make(1.0, "swish", pretrained, **kw)

@@ -58,6 +58,7 @@ _SLOW_TESTS = {
     "test_feature_layers_pipeline", "test_elastic_restart_recovers",
     "test_vocab_parallel_embedding", "test_hybrid_parallel_inference_helper",
     "test_flash_attention_window", "test_flash_attention_grads",
+    "test_vision_model_zoo_round2_forward", "test_vision_model_zoo_inception",
     "test_fused_multi_transformer_prefill_into_cache_then_decode",
     "test_moe_layer_dense_math", "test_ring_attention_grad_parity",
     "test_eager_gpt_forward_and_fit", "test_dense_forward_matches_eager_math",

@@ -1,0 +1,214 @@
+"""Round-2 distribution tests: log_prob/entropy/moments vs scipy.stats,
+sampling sanity, transforms (bijectivity + log-det), and KL registry
+entries (reference pattern: test/distribution/test_distribution_*.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from scipy import stats as st
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+
+
+def _close(a, b, tol=1e-4):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=tol,
+                               atol=tol)
+
+
+def test_gamma_beta_chi2_golden():
+    x = np.asarray([0.3, 1.2, 2.5], np.float32)
+    g = D.Gamma(2.0, 3.0)
+    _close(g.log_prob(jnp.asarray(x)), st.gamma.logpdf(x, 2.0, scale=1/3.0))
+    _close(g.entropy(), st.gamma.entropy(2.0, scale=1/3.0))
+    _close(g.mean, 2.0 / 3.0)
+    b = D.Beta(2.0, 5.0)
+    xb = np.asarray([0.1, 0.5, 0.9], np.float32)
+    _close(b.log_prob(jnp.asarray(xb)), st.beta.logpdf(xb, 2.0, 5.0))
+    _close(b.entropy(), st.beta.entropy(2.0, 5.0), tol=1e-3)
+    c = D.Chi2(4.0)
+    _close(c.log_prob(jnp.asarray(x)), st.chi2.logpdf(x, 4.0))
+
+
+def test_cauchy_poisson_geometric_binomial_golden():
+    x = np.asarray([-1.0, 0.5, 3.0], np.float32)
+    c = D.Cauchy(0.5, 2.0)
+    _close(c.log_prob(jnp.asarray(x)), st.cauchy.logpdf(x, 0.5, 2.0))
+    _close(c.cdf(jnp.asarray(x)), st.cauchy.cdf(x, 0.5, 2.0))
+    k = np.asarray([0.0, 2.0, 5.0], np.float32)
+    p = D.Poisson(3.0)
+    _close(p.log_prob(jnp.asarray(k)), st.poisson.logpmf(k, 3.0))
+    g = D.Geometric(0.3)
+    # scipy geom counts trials (k>=1); ours counts failures (k>=0)
+    _close(g.log_prob(jnp.asarray(k)), st.geom.logpmf(k + 1, 0.3))
+    _close(g.mean, (1 - 0.3) / 0.3)
+    bn = D.Binomial(10.0, 0.4)
+    _close(bn.log_prob(jnp.asarray(k)), st.binom.logpmf(k, 10, 0.4))
+
+
+def test_dirichlet_multinomial_golden():
+    conc = np.asarray([2.0, 3.0, 5.0], np.float32)
+    d = D.Dirichlet(jnp.asarray(conc))
+    v = np.asarray([0.2, 0.3, 0.5], np.float32)
+    _close(d.log_prob(jnp.asarray(v)), st.dirichlet.logpdf(v, conc))
+    _close(d.entropy(), st.dirichlet.entropy(conc), tol=1e-3)
+    _close(d.mean, conc / conc.sum())
+    m = D.Multinomial(6, jnp.asarray([0.2, 0.3, 0.5]))
+    counts = np.asarray([1.0, 2.0, 3.0], np.float32)
+    _close(m.log_prob(jnp.asarray(counts)),
+           st.multinomial.logpmf(counts, 6, [0.2, 0.3, 0.5]))
+    s = m.sample((100,), key=jax.random.PRNGKey(0))
+    assert s.shape == (100, 3)
+    np.testing.assert_array_equal(np.asarray(s.sum(-1)), 6.0)
+
+
+def test_mvn_studentt_golden():
+    mu = np.asarray([1.0, -1.0], np.float32)
+    cov = np.asarray([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(jnp.asarray(mu), jnp.asarray(cov))
+    x = np.asarray([[0.0, 0.0], [1.0, 2.0]], np.float32)
+    _close(mvn.log_prob(jnp.asarray(x)),
+           st.multivariate_normal.logpdf(x, mu, cov), tol=1e-3)
+    _close(mvn.entropy(), st.multivariate_normal.entropy(mu, cov),
+           tol=1e-3)
+    t = D.StudentT(5.0, 0.5, 2.0)
+    xt = np.asarray([-1.0, 0.5, 3.0], np.float32)
+    _close(t.log_prob(jnp.asarray(xt)),
+           st.t.logpdf(xt, 5.0, 0.5, 2.0), tol=1e-3)
+    _close(t.variance, st.t.var(5.0, 0.5, 2.0), tol=1e-3)
+
+
+def test_continuous_bernoulli():
+    cb = D.ContinuousBernoulli(0.3)
+    # density integrates to ~1 over [0, 1]
+    xs = jnp.linspace(1e-3, 1 - 1e-3, 2001)
+    integral = float(jnp.trapezoid(cb.prob(xs), xs))
+    assert abs(integral - 1.0) < 1e-2, integral
+    # near p=1/2 the Taylor branch must stay finite/smooth
+    cb2 = D.ContinuousBernoulli(0.5)
+    assert np.isfinite(float(cb2.log_prob(jnp.float32(0.4))))
+    s = cb.sample((2000,), key=jax.random.PRNGKey(1))
+    assert 0.0 <= float(s.min()) and float(s.max()) <= 1.0
+    _close(float(s.mean()), float(cb.mean), tol=5e-2)
+
+
+def test_independent_reinterprets_batch():
+    base = D.Normal(jnp.zeros((3, 4)), jnp.ones((3, 4)))
+    ind = D.Independent(base, 1)
+    x = jnp.ones((3, 4))
+    _close(ind.log_prob(x), base.log_prob(x).sum(-1))
+    assert ind.entropy().shape == (3,)
+
+
+@pytest.mark.parametrize("tname,make,x", [
+    ("affine", lambda: D.AffineTransform(2.0, 3.0), 0.7),
+    ("exp", lambda: D.ExpTransform(), 0.7),
+    ("power", lambda: D.PowerTransform(3.0), 0.7),
+    ("sigmoid", lambda: D.SigmoidTransform(), 0.7),
+    ("tanh", lambda: D.TanhTransform(), 0.7),
+])
+def test_transform_bijectivity_and_logdet(tname, make, x):
+    t = make()
+    xv = jnp.float32(x)
+    # inverse(forward(x)) == x
+    _close(t.inverse(t.forward(xv)), xv, tol=1e-5)
+    # log|det J| == log|f'(x)| via autodiff
+    ld = float(t.forward_log_det_jacobian(xv))
+    grad = float(jax.grad(lambda v: t.forward(v))(xv))
+    _close(ld, np.log(abs(grad)), tol=1e-4)
+
+
+def test_stickbreaking_transform():
+    t = D.StickBreakingTransform()
+    x = jnp.asarray([0.2, -0.5, 1.0], jnp.float32)
+    y = t.forward(x)
+    assert y.shape == (4,)
+    _close(float(y.sum()), 1.0, tol=1e-5)
+    _close(t.inverse(y), x, tol=1e-4)
+    assert np.isfinite(float(t.forward_log_det_jacobian(x)))
+
+
+def test_chain_reshape_stack_independent_transforms():
+    chain = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                              D.ExpTransform()])
+    x = jnp.float32(0.3)
+    _close(chain.forward(x), np.exp(1.0 + 2.0 * 0.3), tol=1e-5)
+    _close(chain.inverse(chain.forward(x)), x, tol=1e-5)
+    grad = float(jax.grad(lambda v: chain.forward(v))(x))
+    _close(float(chain.forward_log_det_jacobian(x)), np.log(abs(grad)),
+           tol=1e-4)
+    r = D.ReshapeTransform((2, 3), (6,))
+    xm = jnp.arange(6.0).reshape(2, 3)
+    assert r.forward(xm).shape == (6,)
+    _close(r.inverse(r.forward(xm)), xm)
+    st_ = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 2.0)],
+                           axis=0)
+    xs = jnp.asarray([[0.5], [0.5]])
+    out = st_.forward(xs)
+    _close(out[0], np.exp(0.5), tol=1e-5)
+    _close(out[1], 1.0, tol=1e-5)
+    it = D.IndependentTransform(D.ExpTransform(), 1)
+    xi = jnp.asarray([0.1, 0.2])
+    assert it.forward_log_det_jacobian(xi).shape == ()
+
+
+def test_transformed_distribution_lognormal_parity():
+    """exp(Normal) must match the closed-form LogNormal."""
+    td = D.TransformedDistribution(D.Normal(0.3, 0.8),
+                                   [D.ExpTransform()])
+    x = np.asarray([0.5, 1.0, 2.5], np.float32)
+    _close(td.log_prob(jnp.asarray(x)),
+           st.lognorm.logpdf(x, 0.8, scale=np.exp(0.3)), tol=1e-4)
+    s = td.sample((5,), key=jax.random.PRNGKey(2))
+    assert float(s.min()) > 0
+
+
+def test_kl_registry_round2():
+    _close(D.kl_divergence(D.Gamma(2.0, 3.0), D.Gamma(2.0, 3.0)), 0.0)
+    _close(D.kl_divergence(D.Beta(2.0, 3.0), D.Beta(2.0, 3.0)), 0.0)
+    kl = D.kl_divergence(D.Poisson(3.0), D.Poisson(4.0))
+    # mc check
+    assert float(kl) > 0
+    d1 = D.Dirichlet(jnp.asarray([2.0, 3.0]))
+    d2 = D.Dirichlet(jnp.asarray([3.0, 2.0]))
+    assert float(D.kl_divergence(d1, d2)) > 0
+    mu = jnp.asarray([0.0, 0.0]); cov = jnp.eye(2)
+    mvn1 = D.MultivariateNormal(mu, cov)
+    mvn2 = D.MultivariateNormal(mu + 1.0, cov * 2.0)
+    ref = 0.5 * (np.trace(np.linalg.inv(np.eye(2) * 2) @ np.eye(2))
+                 + np.asarray([1.0, 1.0]) @ np.linalg.inv(np.eye(2) * 2)
+                 @ np.asarray([1.0, 1.0]) - 2
+                 + np.log(np.linalg.det(np.eye(2) * 2)))
+    _close(D.kl_divergence(mvn1, mvn2), ref, tol=1e-4)
+
+
+def test_sampling_moments():
+    key = jax.random.PRNGKey(3)
+    for dist, mean, var in [
+        (D.Gamma(3.0, 2.0), 1.5, 0.75),
+        (D.Beta(2.0, 2.0), 0.5, 1 / 20),
+        (D.Poisson(4.0), 4.0, 4.0),
+        (D.Geometric(0.4), 1.5, 0.6 / 0.16),
+    ]:
+        s = dist.sample((20000,), key=key)
+        _close(float(s.mean()), mean, tol=7e-2)
+        _close(float(s.var()), var, tol=2e-1)
+
+
+def test_transformed_multivariate_event_dims():
+    """Review regression: elementwise transform over a multivariate base
+    must reduce the per-element log-det over the event dim."""
+    mvn = D.MultivariateNormal(jnp.zeros(2), jnp.eye(2))
+    td = D.TransformedDistribution(mvn, [D.ExpTransform()])
+    x = np.asarray([0.5, 2.0], np.float32)
+    lp = td.log_prob(jnp.asarray(x))
+    assert lp.shape == ()
+    # log N(log x; 0, I) - sum(log x)
+    ref = (st.multivariate_normal.logpdf(np.log(x), np.zeros(2), np.eye(2))
+           - np.log(x).sum())
+    _close(lp, ref, tol=1e-4)
+    # batched values keep the batch dim only
+    xb = np.abs(np.random.RandomState(0).randn(5, 2)).astype(np.float32) + 0.1
+    assert td.log_prob(jnp.asarray(xb)).shape == (5,)

@@ -262,3 +262,48 @@ def test_device_streams_shim():
     synchronize()
     with Stream() as st:
         st.record_event()
+
+
+def test_vision_model_zoo_round2_forward():
+    """Round-2 families (reference: python/paddle/vision/models/*):
+    AlexNet, SqueezeNet, DenseNet, GoogLeNet(+aux), InceptionV3,
+    MobileNetV1/V3, ShuffleNetV2 — forward shapes + one grad flow."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.vision import models as M
+    x64 = jnp.ones((1, 3, 64, 64))
+    for make in (lambda: M.densenet121(num_classes=5),
+                 lambda: M.mobilenet_v1(num_classes=5),
+                 lambda: M.mobilenet_v3_small(num_classes=5),
+                 lambda: M.shufflenet_v2_x0_25(num_classes=5)):
+        m = make(); m.eval()
+        assert m(x64).shape == (1, 5)
+    m = M.alexnet(num_classes=5); m.eval()
+    assert m(jnp.ones((1, 3, 224, 224))).shape == (1, 5)
+    m = M.squeezenet1_1(num_classes=5); m.eval()
+    assert m(jnp.ones((1, 3, 224, 224))).shape == (1, 5)
+    g = M.googlenet(num_classes=5); g.eval()
+    out, a1, a2 = g(jnp.ones((1, 3, 224, 224)))
+    assert out.shape == a1.shape == a2.shape == (1, 5)
+    # grad flows through one representative model (functional form)
+    from paddle_tpu.nn import functional_call, functional_train_graph
+    m = M.shufflenet_v2_x0_25(num_classes=3)
+    params, _, buffers = functional_train_graph(m)
+    # NOT constant input: train-mode BatchNorm maps a constant batch to
+    # exactly zero (zero variance), which legitimately zeroes every grad
+    xr = jnp.asarray(np.random.RandomState(0).randn(2, 3, 32, 32)
+                     .astype(np.float32))
+    def loss(p):
+        out, _ = functional_call(m, p, buffers, xr)
+        return jnp.sum(out ** 2)
+    grads = jax.grad(loss)(params)
+    total = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
+    assert np.isfinite(total) and total > 0
+
+
+def test_vision_model_zoo_inception():
+    import jax.numpy as jnp
+    from paddle_tpu.vision import models as M
+    m = M.inception_v3(num_classes=4); m.eval()
+    assert m(jnp.ones((1, 3, 299, 299))).shape == (1, 4)
