@@ -16,12 +16,13 @@ from typing import List, Optional, Union
 import jax
 
 __all__ = [
-    "Place", "CPUPlace", "TPUPlace", "XPUPlace", "CUDAPlace",
+    "Place", "CPUPlace", "TPUPlace", "XPUPlace", "CUDAPlace", "CustomPlace",
     "set_device", "get_device", "get_all_device_type", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_tpu",
     "get_default_device", "jax_device", "synchronize",
+    "register_custom_device", "get_all_custom_device_type",
+    "custom_device_count", "load_plugins",
 ]
-
 
 class Place:
     """Device identity: (device_type, device_id)."""
@@ -69,6 +70,11 @@ class CUDAPlace(Place):
     device_type = "gpu"
 
 
+# plugin imports AFTER Place: CustomPlace subclasses it
+from .plugin import (CustomPlace, custom_device_count,  # noqa: E402
+                     get_all_custom_device_type, load_plugins,
+                     register_custom_device)
+
 _TPU_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform name
 
 
@@ -106,9 +112,12 @@ def set_device(device: Union[str, Place]) -> Place:
         parts = device.split(":")
         dtype_, idx = parts[0], int(parts[1]) if len(parts) > 1 else 0
         cls = {"cpu": CPUPlace, "tpu": TPUPlace, "xpu": XPUPlace, "gpu": CUDAPlace}.get(dtype_)
-        if cls is None:
+        if cls is not None:
+            place = cls(idx)
+        elif dtype_ in get_all_custom_device_type():
+            place = CustomPlace(dtype_, idx)
+        else:
             raise ValueError(f"Unknown device type: {dtype_}")
-        place = cls(idx)
     _current_device[0] = f"{place.device_type}:{place.device_id}"
     return place
 
@@ -124,6 +133,8 @@ def get_device() -> str:
 def get_default_device() -> Place:
     name = get_device()
     parts = name.split(":")
+    if parts[0] in get_all_custom_device_type():
+        return CustomPlace(parts[0], int(parts[1]) if len(parts) > 1 else 0)
     cls = {"cpu": CPUPlace, "tpu": TPUPlace, "xpu": XPUPlace, "gpu": CUDAPlace}[parts[0]]
     return cls(int(parts[1]) if len(parts) > 1 else 0)
 

@@ -1,0 +1,113 @@
+"""Out-of-tree extension ABI (reference: paddle/phi/capi/ — the C ABI for
+registering kernels without forking; paddle/phi/backends/custom/ +
+python/paddle/device CustomPlace — pluggable device backends).
+
+TPU-native shape: the two extension points the C-API served are already
+first-class Python registries here —
+
+  * KERNELS: ``paddle_tpu.ops.register_op`` (a new op with an XLA-composed
+    reference implementation) and ``paddle_tpu.ops.register_pallas_impl``
+    (a fast-path kernel with a `supported()` gate). An out-of-tree package
+    imports these and registers at import time — no fork, no ABI pinning,
+    and the kernel is dispatchable exactly like in-tree ones.
+  * DEVICES: jax PJRT plugins own the hardware story; this module maps a
+    custom device *name* onto a jax platform so the reference surface
+    (``CustomPlace``, ``get_all_custom_device_type``,
+    ``set_device("mydev:0")``) works against any PJRT backend.
+
+``load_plugins()`` discovers installed extension packages through the
+``paddle_tpu.plugins`` entry-point group (the analogue of the reference's
+CustomDevice .so scan under CUSTOM_DEVICE_ROOT) and calls each entry
+point with no arguments; entries typically register ops/kernels/devices.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["CustomPlace", "register_custom_device",
+           "get_all_custom_device_type", "custom_device_count",
+           "load_plugins", "loaded_plugins"]
+
+# custom device name -> jax platform name it maps to
+_CUSTOM_DEVICES: Dict[str, str] = {}
+_LOADED: List[str] = []
+
+
+def _place_base():
+    from . import Place
+    return Place
+
+
+class CustomPlace(_place_base()):
+    """(reference: paddle.CustomPlace) — a named out-of-tree device. A
+    Place subclass: equality/hash and every isinstance(x, Place) site
+    work unchanged."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in _CUSTOM_DEVICES:
+            raise ValueError(
+                f"custom device {device_type!r} is not registered; call "
+                f"register_custom_device(name, jax_platform) first "
+                f"(registered: {sorted(_CUSTOM_DEVICES) or 'none'})")
+        self.device_type = device_type  # instance attr shadows class attr
+        super().__init__(device_id)
+
+    def __repr__(self):
+        return f"CustomPlace({self.device_type}:{self.device_id})"
+
+    def jax_device(self):
+        import jax
+        platform = _CUSTOM_DEVICES[self.device_type]
+        devs = [d for d in jax.devices()
+                if d.platform.lower() == platform.lower()]
+        if not devs:
+            raise RuntimeError(
+                f"no jax devices for platform {platform!r} backing custom "
+                f"device {self.device_type!r}")
+        return devs[self.device_id % len(devs)]
+
+
+def register_custom_device(name: str, jax_platform: str) -> None:
+    """Map a device name onto a jax/PJRT platform. After registration,
+    ``paddle.set_device(f"{name}:0")`` resolves through CustomPlace."""
+    _CUSTOM_DEVICES[name] = jax_platform
+
+
+def get_all_custom_device_type() -> List[str]:
+    """(reference: paddle.device.get_all_custom_device_type)"""
+    return sorted(_CUSTOM_DEVICES)
+
+
+def custom_device_count(name: str) -> int:
+    import jax
+    platform = _CUSTOM_DEVICES.get(name)
+    if platform is None:
+        return 0
+    return len([d for d in jax.devices()
+                if d.platform.lower() == platform.lower()])
+
+
+def load_plugins(group: str = "paddle_tpu.plugins") -> List[str]:
+    """Discover and initialize installed extension packages (entry-point
+    group scan — the CustomDevice .so directory scan, done the Python
+    way). Idempotent; returns the names loaded this call."""
+    from importlib import metadata
+    loaded = []
+    try:
+        eps = metadata.entry_points(group=group)
+    except TypeError:  # older importlib.metadata API
+        eps = metadata.entry_points().get(group, [])
+    for ep in eps:
+        if ep.name in _LOADED:
+            continue
+        init = ep.load()
+        if callable(init):
+            init()
+        _LOADED.append(ep.name)
+        loaded.append(ep.name)
+    return loaded
+
+
+def loaded_plugins() -> List[str]:
+    return list(_LOADED)

@@ -17,8 +17,14 @@ import numpy as np
 from jax.experimental import sparse as jsparse
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_sparse", "to_dense", "to_sparse_coo", "add", "matmul",
-           "masked_matmul", "nnz", "relu", "tanh"]
+           "is_sparse", "to_dense", "to_sparse_coo", "to_sparse_csr",
+           "add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "mv", "addmm", "nnz", "coalesce", "transpose",
+           "reshape", "sum", "softmax", "is_same_shape", "mask_as",
+           "relu", "relu6", "leaky_relu", "tanh", "sin", "sinh", "asin",
+           "asinh", "tan", "atan", "atanh", "sqrt", "square", "log1p",
+           "expm1", "abs", "neg", "pow", "cast", "deg2rad", "rad2deg",
+           "isnan", "nn"]
 
 SparseCooTensor = jsparse.BCOO
 
@@ -92,3 +98,150 @@ def _unary(fn):
 
 relu = _unary(jax.nn.relu)
 tanh = _unary(jnp.tanh)
+
+
+# ---------------------------------------------------------------------------
+# round-2 surface (reference: python/paddle/sparse/{unary,binary}.py —
+# values-only elementwise ops, CSR conversions, reductions, softmax)
+# ---------------------------------------------------------------------------
+
+def to_sparse_csr(x):
+    """CSR view of a 2-D sparse/dense tensor: (crows, cols, values) with
+    BCOO as the compute format (reference Tensor.to_sparse_csr)."""
+    coo = x if is_sparse(x) else to_sparse_coo(x)
+    coo = coalesce(coo)
+    idx = np.asarray(coo.indices)
+    order = np.lexsort((idx[:, 1], idx[:, 0]))
+    rows, cols = idx[order, 0], idx[order, 1]
+    crows = np.zeros(coo.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return (jnp.asarray(crows), jnp.asarray(cols),
+            jnp.asarray(np.asarray(coo.data)[order]))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference sparse.coalesce)."""
+    return jsparse.bcoo_sum_duplicates(x) if hasattr(
+        jsparse, "bcoo_sum_duplicates") else x.sum_duplicates()
+
+
+def transpose(x, perm, name=None):
+    if is_sparse(x):
+        return jsparse.bcoo_transpose(x, permutation=tuple(perm))
+    return jnp.transpose(x, perm)
+
+
+def reshape(x, shape, name=None):
+    if is_sparse(x):
+        return jsparse.bcoo_reshape(x, new_sizes=tuple(shape))
+    return jnp.reshape(x, shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reduction over a sparse tensor (dense result, reference
+    sparse.sum semantics)."""
+    d = to_dense(x)
+    out = jnp.sum(d, axis=axis, keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def softmax(x, axis=-1, name=None):
+    """Row softmax over the SPARSITY PATTERN (reference:
+    sparse/nn/functional/activation.py softmax — only stored values
+    participate; zeros stay zero). 2-D, last axis."""
+    assert axis in (-1, x.ndim - 1), "sparse softmax: last axis only"
+    assert x.ndim == 2, "sparse softmax supports 2-D tensors"
+    xc = coalesce(x) if is_sparse(x) else to_sparse_coo(x)
+    rows = xc.indices[:, 0]
+    vals = xc.data.astype(jnp.float32)
+    # segment softmax over rows
+    nrows = xc.shape[0]
+    row_max = jax.ops.segment_max(vals, rows, num_segments=nrows)
+    p = jnp.exp(vals - row_max[rows])
+    denom = jax.ops.segment_sum(p, rows, num_segments=nrows)
+    out = (p / denom[rows]).astype(xc.data.dtype)
+    return jsparse.BCOO((out, xc.indices), shape=xc.shape)
+
+
+def subtract(a, b, name=None):
+    return add(a, jsparse.BCOO((-b.data, b.indices), shape=b.shape)
+               if is_sparse(b) else -jnp.asarray(b))
+
+
+def multiply(a, b, name=None):
+    """Elementwise; sparse*sparse multiplies on the union pattern via the
+    dense fallback (XLA fuses), sparse*scalar scales values."""
+    if is_sparse(a) and jnp.isscalar(b):
+        return jsparse.BCOO((a.data * b, a.indices), shape=a.shape)
+    return to_sparse_coo(to_dense(a) * to_dense(b))
+
+
+def divide(a, b, name=None):
+    if is_sparse(a) and jnp.isscalar(b):
+        return jsparse.BCOO((a.data / b, a.indices), shape=a.shape)
+    return to_sparse_coo(to_dense(a) / to_dense(b))
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (reference sparse.mv)."""
+    return x @ vec
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference sparse.addmm)."""
+    return beta * to_dense(input) + alpha * (x @ y)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense x at mask's sparsity pattern (reference
+    sparse.mask_as)."""
+    xd = to_dense(x)
+    m = coalesce(mask) if is_sparse(mask) else to_sparse_coo(mask)
+    idx = m.indices
+    vals = xd[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    return jsparse.BCOO((vals, m.indices), shape=m.shape)
+
+
+# values-only elementwise surface (zero-preserving fns; reference unary.py)
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+tan = _unary(jnp.tan)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+relu6 = _unary(jax.nn.relu6)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if not is_sparse(x):
+        return jnp.asarray(x, value_dtype)
+    vals = x.data.astype(value_dtype) if value_dtype is not None else x.data
+    idx = x.indices.astype(index_dtype) if index_dtype is not None \
+        else x.indices
+    return jsparse.BCOO((vals, idx), shape=x.shape)
+
+
+from . import nn  # noqa: E402,F401  (sparse.nn layer shims)

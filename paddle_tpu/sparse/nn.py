@@ -1,0 +1,37 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn/ — activation
+layers over the sparse functional surface)."""
+
+from __future__ import annotations
+
+
+class ReLU:
+    def __call__(self, x):
+        from . import relu
+        return relu(x)
+
+
+class ReLU6:
+    def __call__(self, x):
+        from . import relu6
+        return relu6(x)
+
+
+class LeakyReLU:
+    def __init__(self, negative_slope: float = 0.01):
+        self.negative_slope = negative_slope
+
+    def __call__(self, x):
+        from . import leaky_relu
+        return leaky_relu(x, self.negative_slope)
+
+
+class Softmax:
+    def __init__(self, axis: int = -1):
+        self.axis = axis
+
+    def __call__(self, x):
+        from . import softmax
+        return softmax(x, self.axis)
+
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax"]

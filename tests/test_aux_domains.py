@@ -243,3 +243,124 @@ def test_viterbi_decoder_layer_and_lengths():
     s2, p2 = dec(em[1:2, :3])
     np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(paths[1, :3]))
     assert abs(float(s2[0]) - float(scores[1])) < 1e-4
+
+
+def test_sparse_round2_surface():
+    """Round-2 sparse ops (reference python/paddle/sparse/{unary,binary}):
+    CSR conversion, pattern softmax, binary ops, values-only unary."""
+    import paddle_tpu.sparse as sp
+    d = jnp.asarray(np.array([[1.0, 0, 2], [0, 0, 3], [4, 5, 0]],
+                             np.float32))
+    x = sp.to_sparse_coo(d)
+    crows, cols, vals = sp.to_sparse_csr(x)
+    np.testing.assert_array_equal(np.asarray(crows), [0, 2, 3, 5])
+    np.testing.assert_array_equal(np.asarray(cols), [0, 2, 2, 0, 1])
+    np.testing.assert_allclose(np.asarray(vals), [1, 2, 3, 4, 5])
+    # pattern softmax: zeros stay zero, stored entries softmax per row
+    sm = np.asarray(sp.to_dense(sp.softmax(x)))
+    r0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    np.testing.assert_allclose(sm[0, [0, 2]], r0, atol=1e-6)
+    assert sm[0, 1] == 0.0 and sm[1, 0] == 0.0
+    # binary + reductions + matmul family
+    np.testing.assert_allclose(np.asarray(sp.mv(x, jnp.ones(3))),
+                               [3, 3, 9])
+    np.testing.assert_allclose(
+        np.asarray(sp.addmm(jnp.ones((3, 2)), x, jnp.ones((3, 2)),
+                            beta=0.5, alpha=2.0)),
+        0.5 + 2.0 * np.asarray(d) @ np.ones((3, 2)), atol=1e-5)
+    assert float(sp.sum(x)) == 15.0
+    assert sp.nnz(sp.coalesce(sp.subtract(x, x))) == 0 or np.allclose(
+        np.asarray(sp.to_dense(sp.subtract(x, x))), 0)
+    prod = sp.multiply(x, 2.0)
+    np.testing.assert_allclose(np.asarray(sp.to_dense(prod)),
+                               np.asarray(d) * 2)
+    # values-only unary keeps the pattern
+    s = sp.sin(x)
+    assert sp.nnz(s) == sp.nnz(x)
+    np.testing.assert_allclose(np.asarray(sp.to_dense(sp.abs(sp.neg(x)))),
+                               np.asarray(d), atol=1e-6)
+    # transpose/reshape/mask_as/cast
+    t = sp.transpose(x, (1, 0))
+    np.testing.assert_allclose(np.asarray(sp.to_dense(t)),
+                               np.asarray(d).T)
+    m = sp.mask_as(d * 3, x)
+    np.testing.assert_allclose(np.asarray(sp.to_dense(m)),
+                               np.asarray(d) * 3)
+    c = sp.cast(x, value_dtype=jnp.float16)
+    assert c.data.dtype == jnp.float16
+    # nn layer shims
+    out = sp.nn.Softmax()(x)
+    np.testing.assert_allclose(np.asarray(sp.to_dense(out)), sm, atol=1e-6)
+    assert sp.is_same_shape(x, t)
+
+
+def test_extension_abi_custom_device_and_kernel():
+    """Out-of-tree extension ABI (reference phi/capi + backends/custom):
+    a 'plugin' registers a custom device name over an existing jax
+    platform AND an out-of-tree op with a fast-path override — both
+    through the same public registries in-tree code uses."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import device as dev
+    from paddle_tpu.ops import get_op, register_op, register_pallas_impl
+
+    # device plugin: map a custom name onto the cpu platform
+    dev.register_custom_device("mynpu", "cpu")
+    assert "mynpu" in dev.get_all_custom_device_type()
+    assert dev.custom_device_count("mynpu") >= 1
+    place = dev.set_device("mynpu:0")
+    assert repr(place) == "CustomPlace(mynpu:0)"
+    assert place.jax_device().platform == "cpu"
+    dev.set_device("cpu")
+
+    # kernel plugin: out-of-tree op + fast-path override
+    @register_op("thirdparty_scale", dispatch=True)
+    def thirdparty_scale(x, s=2.0):
+        return jnp.asarray(x) * s
+
+    calls = []
+
+    @register_pallas_impl("thirdparty_scale",
+                          supported=lambda x, s=2.0: True)
+    def _fast(x, s=2.0):
+        calls.append(1)
+        return jnp.asarray(x) * s
+
+    out = thirdparty_scale(jnp.ones(3), 3.0)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    # on CPU the dispatcher uses the reference path; force the TPU branch
+    import paddle_tpu.ops.registry as registry
+    orig = registry._on_tpu
+    registry._on_tpu = lambda: True
+    try:
+        out = get_op("thirdparty_scale").dispatch(jnp.ones(3), 4.0)
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+        assert calls, "fast-path override was not dispatched"
+    finally:
+        registry._on_tpu = orig
+
+
+def test_custom_device_is_place_and_default_roundtrip():
+    """Review regressions: CustomPlace equality (Place subclass) and
+    get_default_device after a custom set_device."""
+    from paddle_tpu import device as dev
+    dev.register_custom_device("mynpu2", "cpu")
+    a, b = dev.CustomPlace("mynpu2", 0), dev.CustomPlace("mynpu2", 0)
+    assert a == b and hash(a) == hash(b)
+    assert isinstance(a, dev.Place)
+    dev.set_device("mynpu2:0")
+    try:
+        d = dev.get_default_device()
+        assert isinstance(d, dev.CustomPlace) and d.device_type == "mynpu2"
+        assert d.jax_device().platform == "cpu"
+    finally:
+        dev.set_device("cpu")
+
+
+def test_sparse_softmax_dense_input_and_rank_guard():
+    import paddle_tpu.sparse as sp
+    out = sp.softmax(jnp.eye(3))  # dense input must work
+    np.testing.assert_allclose(np.asarray(sp.to_dense(out)), np.eye(3))
+    import pytest as _pytest
+    with _pytest.raises(AssertionError):
+        sp.softmax(sp.to_sparse_coo(jnp.ones((2, 2, 2))))
