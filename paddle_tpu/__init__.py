@@ -23,6 +23,7 @@ from .device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace,  # noqa: F401
                      is_compiled_with_tpu, is_compiled_with_xpu)
 from .random import get_rng_state, seed, set_rng_state, rng_guard  # noqa: F401
 from . import tensor  # noqa: F401
+from .framework.selected_rows import SelectedRows  # noqa: F401
 from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
 from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,68 @@
+"""SelectedRows — the sparse-gradient container (reference:
+paddle/phi/core/selected_rows.h; produced by embedding backward with
+sparse=True and consumed by LazyAdam/sparse optimizers).
+
+TPU shape: a pytree-registered (rows, value) pair. Dense math stays the
+default (XLA scatters are fast); SelectedRows exists for the optimizer
+fast path — Adam(lazy_mode=True) updates ONLY the touched rows' moments
+and parameters, which is the reference's LazyAdam contract for huge
+embedding tables."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: [n] int32 (may contain duplicates); value: [n, ...] the rows'
+    gradient slices; height: dim 0 of the dense tensor it abbreviates."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.value.dtype)
+        return out.at[self.rows].add(self.value)
+
+    @classmethod
+    def from_dense(cls, dense, rows):
+        rows = jnp.asarray(rows, jnp.int32)
+        return cls(rows, jnp.asarray(dense)[rows], dense.shape[0])
+
+    def coalesced(self) -> "SelectedRows":
+        """Merge duplicate rows (sum their slices) — host-side unique, so
+        call outside jit. REQUIRED before feeding the lazy optimizer
+        path: duplicate rows would collide in its row scatter."""
+        import numpy as np
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + tuple(self.value.shape[1:]),
+                           self.value.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.value)
+        return SelectedRows(jnp.asarray(uniq), merged, self.height)
+
+    def tree_flatten(self):
+        return (self.rows, self.value), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, value = children
+        return cls(rows, value, height)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"shape={self.shape}, dtype={self.dtype})")

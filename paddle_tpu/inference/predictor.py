@@ -78,7 +78,17 @@ class Config:
         self._switches["cpu_threads"] = n
 
     def enable_bf16(self):
+        """Real effect: the predictor casts floating inputs to bfloat16
+        before execution (MXU-native inference precision)."""
         self._precision = "bfloat16"
+
+    def enable_profile(self):
+        """Real effect: each run() executes inside a paddle_tpu.profiler
+        record scope; retrieve with paddle_tpu.profiler exports."""
+        self._switches["profile"] = True
+
+    def profile_enabled(self) -> bool:
+        return self._switches.get("profile", False)
 
     def precision(self) -> str:
         return self._precision
@@ -189,11 +199,34 @@ class Predictor:
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
         args = []
+        # live callables retrace freely; a jit.save artifact pins its input
+        # avals at export time, so casting would break the exported calling
+        # convention — re-export the model in bf16 to deploy bf16 there
+        cast = (jnp.bfloat16 if (self.config.precision() == "bfloat16"
+                                 and self._in_specs is None)
+                else None)
+        if (self.config.precision() == "bfloat16"
+                and self._in_specs is not None
+                and not getattr(self, "_warned_bf16", False)):
+            import warnings
+            warnings.warn(
+                "enable_bf16() has no effect on a jit.save artifact (its "
+                "input dtypes are pinned at export); re-export the model "
+                "with bfloat16 inputs to deploy bf16")
+            self._warned_bf16 = True
         for name, h in self._inputs.items():
             if h._value is None:
                 raise ValueError(f"input '{name}' not set")
-            args.append(h._value)
-        out = self._callable(*args)
+            v = h._value
+            if cast is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(cast)
+            args.append(v)
+        if self.config.profile_enabled():
+            from ..profiler import RecordEvent
+            with RecordEvent("predictor.run"):
+                out = self._callable(*args)
+        else:
+            out = self._callable(*args)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         self._outputs = {}
         results = []

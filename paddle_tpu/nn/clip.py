@@ -15,11 +15,19 @@ __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
            "clip_grad_norm_", "global_norm"]
 
 
+def _is_selected_rows(x) -> bool:
+    from ..framework.selected_rows import SelectedRows
+    return isinstance(x, SelectedRows)
+
+
 def global_norm(grads) -> jax.Array:
-    leaves = [g for g in jax.tree.leaves(grads) if g is not None]
-    if not leaves:
+    leaves = [g for g in jax.tree.leaves(grads, is_leaf=_is_selected_rows)
+              if g is not None]
+    vals = [g.value if _is_selected_rows(g) else g for g in leaves]
+    if not vals:
         return jnp.zeros(())
-    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in vals))
 
 
 class ClipGradByValue:
@@ -52,7 +60,18 @@ class ClipGradByGlobalNorm:
     def __call__(self, grads):
         n = global_norm(grads)
         scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
-        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+        def scale_one(g):
+            if _is_selected_rows(g):
+                # scale VALUES only — mapping over the node would also
+                # scale the integer row indices
+                from ..framework.selected_rows import SelectedRows
+                return SelectedRows(g.rows,
+                                    (g.value * scale).astype(g.value.dtype),
+                                    g.height)
+            return (g * scale).astype(g.dtype)
+
+        return jax.tree.map(scale_one, grads, is_leaf=_is_selected_rows)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):

@@ -323,6 +323,7 @@ class Adam(Optimizer):
                          name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._moment_dtype = moment_dtype
+        self._lazy_mode = lazy_mode
         # low-precision EMA stores need stochastic rounding (see _sr_to_bf16)
         self._needs_update_rng = (moment_dtype is not None
                                   and jnp.dtype(moment_dtype) != jnp.float32)
@@ -337,23 +338,29 @@ class Adam(Optimizer):
     def _decoupled_decay(self, p, lr):
         return 0.0
 
+    def _adam_core(self, pf, gf, m1_prev, m2_prev, lr, step):
+        """Shared EMA + bias-corrected update (dense and per-row sparse
+        paths both use this — one place for the Adam math)."""
+        m1 = self._beta1 * m1_prev + (1 - self._beta1) * gf
+        m2 = self._beta2 * m2_prev + (1 - self._beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        m1_hat = m1 / (1 - self._beta1 ** stepf)
+        m2_hat = m2 / (1 - self._beta2 ** stepf)
+        upd = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_pf = pf - lr * upd - self._decoupled_decay(pf, lr)
+        return new_pf, m1, m2
+
     def _update(self, p, g, slot, lr, step, rng=None):
+        from ..framework.selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            return self._update_sparse(p, g, slot, lr, step, rng)
         gf = g.astype(jnp.float32)
         master = slot.get("master", None)
         pf = master if master is not None else p.astype(jnp.float32)
         gf = self._apply_l2(gf, pf) if type(self) is Adam else gf
-        m1 = self._beta1 * slot["moment1"].astype(jnp.float32) \
-            + (1 - self._beta1) * gf
-        m2 = self._beta2 * slot["moment2"].astype(jnp.float32) \
-            + (1 - self._beta2) * jnp.square(gf)
-        stepf = step.astype(jnp.float32)
-        bc1 = 1 - self._beta1 ** stepf
-        bc2 = 1 - self._beta2 ** stepf
-        m1_hat = m1 / bc1
-        m2_hat = m2 / bc2
-        upd = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
-        wd = self._decoupled_decay(pf, lr)
-        new_pf = pf - lr * upd - wd
+        new_pf, m1, m2 = self._adam_core(
+            pf, gf, slot["moment1"].astype(jnp.float32),
+            slot["moment2"].astype(jnp.float32), lr, step)
         # only moment2 needs stochastic rounding: its per-step relative
         # update (1-beta2 ~ 1e-3) is below bf16 ulp, while moment1's
         # (1-beta1 ~ 0.1) is far above it — nearest rounding tracks fine
@@ -362,6 +369,32 @@ class Adam(Optimizer):
         if master is not None:
             out["master"] = new_pf
         return new_pf.astype(p.dtype), out
+
+
+    def _update_sparse(self, p, g, slot, lr, step, rng=None):
+        """LazyAdam row update (reference: lazy_mode in adam_op /
+        LazyAdam): only the touched rows' moments and parameters move —
+        the contract for huge embedding tables. Rows MUST be unique (call
+        SelectedRows.coalesced() outside jit — duplicate rows would
+        collide in the row scatter); bias correction uses the global
+        step, matching the reference."""
+        if not self._lazy_mode:
+            return self._update(p, g.to_dense(), slot, lr, step, rng)
+        if slot.get("master") is not None:
+            raise NotImplementedError(
+                "multi_precision with SelectedRows grads is not supported")
+        rows, gf = g.rows, g.value.astype(jnp.float32)
+        new_rows, m1, m2 = self._adam_core(
+            p[rows].astype(jnp.float32), gf,
+            slot["moment1"][rows].astype(jnp.float32),
+            slot["moment2"][rows].astype(jnp.float32), lr, step)
+        out = {
+            "moment1": slot["moment1"].at[rows].set(
+                m1.astype(slot["moment1"].dtype)),
+            "moment2": slot["moment2"].at[rows].set(
+                _store_moment(m2, slot["moment2"].dtype, rng)),
+        }
+        return p.at[rows].set(new_rows.astype(p.dtype)), out
 
 
 class AdamW(Adam):

@@ -89,3 +89,23 @@ def test_predictor_repeated_runs(artifact):
     for _ in range(3):
         (out,) = pred.run([x])
         np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+def test_config_bf16_and_profile_are_real():
+    """Round-2: enable_bf16 actually casts float inputs (MXU precision);
+    enable_profile wraps run in a profiler record scope."""
+    import jax.numpy as jnp
+    from paddle_tpu.inference import Config, Predictor
+    seen = {}
+
+    def fn(x):
+        seen["dtype"] = x.dtype
+        return x * 2
+    cfg = Config()
+    cfg.disable_gpu()
+    cfg.enable_bf16()
+    cfg.enable_profile()
+    p = Predictor(cfg, fn=fn)
+    p.run([np.ones((2, 2), np.float32)])
+    assert seen["dtype"] == jnp.bfloat16
+    out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out.astype(np.float32), 2.0)

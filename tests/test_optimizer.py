@@ -313,3 +313,56 @@ def test_bf16_moments_track_ema_via_stochastic_rounding():
     # (well under half the fp32 value); SR must keep it within 20%.
     assert m2_32 > 0
     assert abs(m2_16 - m2_32) / m2_32 < 0.2, (m2_16, m2_32)
+
+
+def test_selected_rows_lazy_adam():
+    """SelectedRows sparse grads + Adam(lazy_mode=True): only touched rows
+    move (reference: phi/core/selected_rows.h + LazyAdam)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import SelectedRows
+    table = jnp.ones((8, 4), jnp.float32)
+    params = {"emb": table}
+    opt = paddle.optimizer.AdamW(1e-2, lazy_mode=True, weight_decay=0.0)
+    state = opt.init_state(params)
+    g = SelectedRows(jnp.asarray([1, 5]), jnp.ones((2, 4)), 8)
+    grads = {"emb": g}
+    p2, s2 = jax.jit(opt.apply)(params, grads, state, 1e-2)
+    moved = np.where(np.abs(np.asarray(p2["emb"]) - 1.0).sum(-1) > 0)[0]
+    np.testing.assert_array_equal(moved, [1, 5])  # ONLY touched rows
+    m1 = np.asarray(s2["slots"]["emb"]["moment1"])
+    assert np.all(m1[[0, 2, 3, 4, 6, 7]] == 0) and np.all(m1[[1, 5]] != 0)
+    # dense fallback without lazy_mode: all rows get decoupled decay etc.
+    opt2 = paddle.optimizer.AdamW(1e-2, lazy_mode=False)
+    p3, _ = jax.jit(opt2.apply)(params, grads, opt2.init_state(params), 1e-2)
+    assert np.abs(np.asarray(p3["emb"]) - 1.0).sum() > 0
+    # round-trips: to_dense/from_dense/coalesced
+    np.testing.assert_allclose(np.asarray(g.to_dense()).sum(), 8.0)
+    sr2 = SelectedRows(jnp.asarray([1, 1]), jnp.ones((2, 4)), 8).coalesced()
+    np.testing.assert_array_equal(np.asarray(sr2.rows), [1])
+    np.testing.assert_allclose(np.asarray(sr2.value), 2.0)
+
+
+def test_selected_rows_clip_and_bf16_moments():
+    """Review regressions: global-norm clip scales VALUES not row indices;
+    bf16 moment2 stores keep stochastic rounding on the sparse path."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import SelectedRows
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm, global_norm
+    g = SelectedRows(jnp.asarray([1, 5]), jnp.full((2, 4), 10.0), 8)
+    clip = ClipGradByGlobalNorm(1.0)
+    out = clip({"emb": g})["emb"]
+    np.testing.assert_array_equal(np.asarray(out.rows), [1, 5])  # untouched
+    np.testing.assert_allclose(float(global_norm({"e": out})), 1.0,
+                               rtol=1e-5)
+    # lazy adam + clip end to end under jit
+    params = {"emb": jnp.ones((8, 4))}
+    opt = paddle.optimizer.AdamW(1e-2, lazy_mode=True,
+                                 grad_clip=ClipGradByGlobalNorm(1.0),
+                                 moment_dtype=jnp.bfloat16)
+    state = opt.init_state(params)
+    p2, s2 = jax.jit(opt.apply)(params, {"emb": g}, state, 1e-2)
+    moved = np.where(np.abs(np.asarray(p2["emb"]) - 1.0).sum(-1) > 0)[0]
+    np.testing.assert_array_equal(moved, [1, 5])
+    assert s2["slots"]["emb"]["moment2"].dtype == jnp.bfloat16
