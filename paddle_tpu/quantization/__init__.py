@@ -3,9 +3,14 @@ framework, PTQ observers; kernels paddle/phi/kernels/.../quantize_*).
 
 TPU design: fake-quant as straight-through-estimator ops (custom_vjp),
 QuantConfig + QAT wrapper inserting FakeQuant layers around Linear/Conv;
-PTQ observers collect absmax ranges. int8 execution itself is left to XLA
-(native int8 matmul on TPU via preferred_element_type) — the framework
-layer's job is producing the quantized weights + scales.
+PTQ observers collect absmax ranges. Round 2 adds REAL int8 execution
+(quantize_to_int8 / int8_matmul / qlinear / QuantizedLinear): int8×int8
+→int32 on the v5e MXU via preferred_element_type, measured 1.26× the
+bf16 rate at large shapes (BASELINE.md).
+
+Scale convention (ONE convention module-wide): scale = absmax, integer
+value q ≈ x·qmax/scale, dequant = q·scale/qmax — what absmax_scale /
+quantize_weights / dequantize and the int8 execution path all share.
 """
 
 from __future__ import annotations
@@ -188,3 +193,80 @@ class PTQ:
 
     def scales(self) -> Dict[str, float]:
         return {k: o.scale for k, o in self.observers.items()}
+
+
+# ---------------------------------------------------------------------------
+# real int8 execution (round 2) — reference: paddle/phi/kernels/fusion/gpu
+# quant_dequant + int8 matmul kernels (fused_multi_transformer_int8 etc.).
+# On v5e the MXU runs int8 x int8 -> int32 at 2x the bf16 rate; this is the
+# TPU-native int8 path, not a fake-quant simulation.
+# ---------------------------------------------------------------------------
+
+def quantize_to_int8(x, scale=None, axis=None):
+    """Symmetric int8 quantization in the module's absmax convention
+    (scale = absmax; dequant = q*scale/127 — interchangeable with
+    quantize_weights/dequantize/observer scales). Pass axis= for
+    per-channel scales computed here."""
+    if scale is None:
+        if axis is None:
+            scale = absmax_scale(x)
+        else:
+            red = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+            scale = jnp.maximum(
+                jnp.max(jnp.abs(x), axis=red, keepdims=True), 1e-8)
+    q = jnp.clip(jnp.round(x / scale * 127.0), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32):
+    """int8 @ int8 with int32 accumulation on the MXU, dequantized by the
+    product of scales. x_scale: scalar (per-tensor); w_scale: scalar or
+    per-output-channel (broadcasts on the last dim)."""
+    acc = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    ws = jnp.reshape(jnp.asarray(w_scale), (-1,))  # [out] or [1]
+    return (acc.astype(jnp.float32)
+            * (jnp.asarray(x_scale) / 127.0) * (ws / 127.0)
+            ).astype(out_dtype)
+
+
+def qlinear(x, w_q, w_scale, bias=None, out_dtype=None):
+    """Dynamic-activation-quant linear: quantize x per call (absmax),
+    run the int8 MXU matmul, dequantize (W8A8 dynamic — the
+    llm.int8-style serving path)."""
+    out_dtype = out_dtype or x.dtype
+    x_q, x_scale = quantize_to_int8(x)
+    out = int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(out_dtype)
+
+
+class QuantizedLinear(Layer):
+    """Weight-only-storage / W8A8-compute linear (reference:
+    fused int8 matmul kernels). Construct from a trained Linear via
+    from_linear(); weights live as int8 + per-output-channel scales."""
+
+    def __init__(self, w_q, w_scale, bias=None):
+        super().__init__()
+        self.register_buffer("w_q", w_q)
+        self.register_buffer("w_scale", jnp.reshape(w_scale, (-1,)))
+        if bias is not None:
+            self.register_buffer("bias", bias)
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_linear(cls, linear):
+        w = jnp.asarray(linear.weight.value)  # [in, out]
+        w_q, w_scale = quantize_to_int8(w, axis=1)
+        b = (jnp.asarray(linear.bias.value)
+             if getattr(linear, "bias", None) is not None else None)
+        return cls(w_q, w_scale, b)
+
+    def forward(self, x):
+        return qlinear(x, self.w_q, self.w_scale, self.bias)
+
+
+__all__ += ["quantize_to_int8", "int8_matmul", "qlinear", "QuantizedLinear"]

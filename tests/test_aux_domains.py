@@ -364,3 +364,58 @@ def test_sparse_softmax_dense_input_and_rank_guard():
     import pytest as _pytest
     with _pytest.raises(AssertionError):
         sp.softmax(sp.to_sparse_coo(jnp.ones((2, 2, 2))))
+
+
+def test_int8_quantized_matmul_and_layer():
+    """Real int8 execution (round 2): int8 x int8 -> int32 MXU matmul with
+    per-channel weight scales tracks the fp32 product within quant error;
+    QuantizedLinear.from_linear drop-in replaces a trained Linear."""
+    import jax
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import (QuantizedLinear, int8_matmul,
+                                         qlinear, quantize_to_int8)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    w_q, w_s = quantize_to_int8(w, axis=1)
+    assert w_q.dtype == jnp.int8 and w_s.shape == (1, 8)
+    x_q, x_s = quantize_to_int8(x)
+    out = int8_matmul(x_q, w_q, x_s, w_s)
+    ref = np.asarray(x) @ np.asarray(w)
+    # W8A8 error budget: ~1% of the output scale
+    err = np.abs(np.asarray(out) - ref).max()
+    assert err < 0.05 * np.abs(ref).max(), err
+    # dynamic-quant linear + layer surface
+    out2 = qlinear(x, w_q, w_s)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), rtol=1e-3,
+                               atol=1e-3)
+    lin = nn.Linear(32, 8)
+    qlin = QuantizedLinear.from_linear(lin)
+    dense_out = lin(x)
+    q_out = qlin(x)
+    rel = (np.abs(np.asarray(q_out) - np.asarray(dense_out)).max()
+           / (np.abs(np.asarray(dense_out)).max() + 1e-9))
+    assert rel < 0.05, rel
+    # jits cleanly
+    j = jax.jit(lambda x: qlinear(x, w_q, w_s))
+    np.testing.assert_allclose(np.asarray(j(x)), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_scale_convention_interops_with_ptq():
+    """One scale convention module-wide: quantize_to_int8 scales work with
+    dequantize, and quantize_weights output feeds int8_matmul."""
+    from paddle_tpu.quantization import (dequantize, int8_matmul,
+                                         quantize_to_int8, quantize_weights)
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    q, s = quantize_to_int8(w)
+    np.testing.assert_allclose(np.asarray(dequantize(q, s)), np.asarray(w),
+                               atol=float(s) / 100)
+    # quantize_weights scales are directly usable by int8_matmul
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    xq, xs = quantize_weights(x)
+    wq, ws = quantize_weights(w)
+    out = int8_matmul(xq, wq, xs, ws)
+    ref = np.asarray(x) @ np.asarray(w)
+    assert np.abs(np.asarray(out) - ref).max() < 0.05 * np.abs(ref).max()
