@@ -1,9 +1,10 @@
 from . import lr  # noqa: F401
 from .gradient_merge import GradientMergeOptimizer
 from .lbfgs import LBFGS, minimize_lbfgs
-from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars,
+                        Momentum,
                         NAdam, Optimizer, RAdam, RMSProp, SGD)
 
 __all__ = ["lr", "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta",
-           "RMSProp", "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam",
+           "RMSProp", "Adam", "AdamW", "Adamax", "Lamb", "Lars", "NAdam", "RAdam",
            "LBFGS", "minimize_lbfgs", "GradientMergeOptimizer"]

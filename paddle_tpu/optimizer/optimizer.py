@@ -22,7 +22,7 @@ from ..nn.layer.layers import Layer, Parameter
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
-           "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam"]
+           "Adam", "AdamW", "Adamax", "Lamb", "Lars", "NAdam", "RAdam"]
 
 
 def _tree_map(f, *trees):
@@ -456,6 +456,78 @@ class Adamax(Optimizer):
         lr_t = lr / (1 - self._beta1 ** stepf)
         return (p - lr_t * m / (u + self._epsilon)).astype(p.dtype), \
                {"moment": m, "inf_norm": u}
+
+
+class Lars(Optimizer):
+    """LARS — layer-wise adaptive rate scaling for large-batch SGD
+    (reference: fleet/meta_optimizers lars_optimizer + the
+    lars_momentum kernel). local_lr = lr * coeff * ||w|| /
+    (||g|| + lambda*||w||); momentum on the rescaled gradient."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, name=None,
+                 **kw):
+        if "weight_decay" in kw:
+            raise TypeError(
+                "Lars takes lars_weight_decay=, not weight_decay= — "
+                "refusing to silently ignore it")
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._epsilon = epsilon
+        # parameter-NAME substrings excluded from decay AND trust scaling
+        # (reference: fleet LarsOptimizer exclude_from_weight_decay —
+        # typically ["batch_norm", ".b_0"]); honored on the eager path
+        # where names exist, and via apply()'s dict keys functionally
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _is_excluded(self, name) -> bool:
+        return any(tok in name for tok in self._exclude) if name else False
+
+    def apply(self, params, grads, state, lr=None):
+        # thread dict-key names to _update so exclude_from_weight_decay
+        # works functionally. Only a FLAT dict of arrays gives reliable
+        # names (nested pytrees lose the key path; base apply also skips
+        # None-grad leaves, so those names must be skipped here too).
+        self._leaf_names = None
+        if self._exclude and isinstance(params, dict) and all(
+                not isinstance(v, (dict, list, tuple))
+                for v in params.values()):
+            self._leaf_names = [k for k in params.keys()
+                                if not (isinstance(grads, dict)
+                                        and grads.get(k) is None)]
+        try:
+            return super().apply(params, grads, state, lr)
+        finally:
+            self._leaf_names = None
+
+    def _update(self, p, g, slot, lr, step):
+        name = None
+        if getattr(self, "_leaf_names", None):
+            # base apply visits leaves in dict order; consume in step
+            name = self._leaf_names.pop(0)
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        if self._is_excluded(name):
+            # excluded params: plain momentum SGD, no decay, no trust ratio
+            v = self._momentum * slot["velocity"] + lr * gf
+            return (pf - v).astype(p.dtype), {"velocity": v}
+        wd = self._decay_coeff()
+        w_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(gf)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + wd * w_norm + self._epsilon),
+            1.0)
+        v = (self._momentum * slot["velocity"]
+             + lr * local_lr * (gf + wd * pf))
+        return (pf - v).astype(p.dtype), {"velocity": v}
 
 
 class Lamb(Optimizer):

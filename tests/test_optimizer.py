@@ -366,3 +366,51 @@ def test_selected_rows_clip_and_bf16_moments():
     moved = np.where(np.abs(np.asarray(p2["emb"]) - 1.0).sum(-1) > 0)[0]
     np.testing.assert_array_equal(moved, [1, 5])
     assert s2["slots"]["emb"]["moment2"].dtype == jnp.bfloat16
+
+
+def test_lars_optimizer():
+    """LARS layer-wise trust ratio (reference: lars_momentum kernel):
+    update magnitude scales with ||w||/||g|| per layer."""
+    import jax
+    import jax.numpy as jnp
+    params = {"big": jnp.ones((4, 4)) * 10.0, "small": jnp.ones((4, 4))}
+    grads = {"big": jnp.ones((4, 4)), "small": jnp.ones((4, 4))}
+    opt = paddle.optimizer.Lars(learning_rate=1.0, momentum=0.0,
+                                lars_coeff=0.001, lars_weight_decay=0.0)
+    state = opt.init_state(params)
+    p2, s2 = jax.jit(opt.apply)(params, grads, state, 1.0)
+    d_big = float(jnp.abs(p2["big"] - params["big"]).mean())
+    d_small = float(jnp.abs(p2["small"] - params["small"]).mean())
+    # trust ratio ∝ ||w||: the 10x-larger layer moves ~10x more
+    assert 8.0 < d_big / d_small < 12.0, (d_big, d_small)
+    # loss decreases on a quadratic
+    w = {"w": jnp.full((8,), 5.0)}
+    opt2 = paddle.optimizer.Lars(0.5, momentum=0.9)
+    st = opt2.init_state(w)
+    for _ in range(50):
+        g = {"w": 2 * w["w"]}
+        w, st = opt2.apply(w, g, st, 0.5)
+    assert float(jnp.abs(w["w"]).max()) < 5.0
+
+
+def test_lars_exclusions_and_kwarg_guard():
+    """Review regressions: exclude_from_weight_decay is honored (excluded
+    params get plain momentum, no trust scaling), and weight_decay= is
+    rejected instead of silently ignored."""
+    import jax
+    import jax.numpy as jnp
+    with pytest.raises(TypeError, match="lars_weight_decay"):
+        paddle.optimizer.Lars(0.1, weight_decay=1e-4)
+    params = {"conv_w": jnp.ones((4, 4)) * 10.0,
+              "batch_norm_scale": jnp.ones((4,)) * 10.0}
+    grads = {"conv_w": jnp.ones((4, 4)), "batch_norm_scale": jnp.ones((4,))}
+    opt = paddle.optimizer.Lars(1.0, momentum=0.0, lars_coeff=0.001,
+                                exclude_from_weight_decay=["batch_norm"])
+    p2, _ = jax.jit(opt.apply)(params, grads, opt.init_state(params), 1.0)
+    # excluded: plain momentum SGD step of lr*g = 1.0 exactly
+    np.testing.assert_allclose(
+        np.asarray(params["batch_norm_scale"] - p2["batch_norm_scale"]),
+        1.0, rtol=1e-6)
+    # included: trust-ratio-scaled (coeff * ||w||/||g|| ~ 0.01x)
+    d = float(jnp.abs(p2["conv_w"] - params["conv_w"]).mean())
+    assert d < 0.1
