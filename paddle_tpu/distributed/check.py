@@ -140,11 +140,15 @@ def nan_guard(x, op_name: str = "collective"):
 
 
 def static_check(tensor, nranks: int, op_name: str,
-                 scatter_dim: Optional[int] = None) -> None:
+                 scatter_dim: Optional[int] = None,
+                 expected_dim0: Optional[int] = None) -> None:
     """Entry point used by the eager collective tier when
-    ``FLAGS_enable_comm_static_check`` is on."""
+    ``FLAGS_enable_comm_static_check`` is on. `expected_dim0` overrides the
+    rank-major dim-0 expectation for multi-process runs, where each process
+    only feeds the rows its devices cover."""
     if not flag("enable_comm_static_check"):
         return
-    check_same_shape(tensor, nranks, op_name)
+    check_same_shape(tensor, expected_dim0 if expected_dim0 is not None
+                     else nranks, op_name)
     if scatter_dim is not None:
         check_scatter_like_shape(tensor, nranks, scatter_dim, op_name)

@@ -110,24 +110,63 @@ def _reduce_traced(x, op, axis):
     return _REDUCERS[op](x, axis)
 
 
+def _local_axis_positions(mesh: Mesh, axis: str) -> List[int]:
+    """The positions along `axis` covered by this process's devices — i.e.
+    which rank-major rows of an eager collective this process feeds and
+    receives (multi-process runs only own a slice of the group)."""
+    ai = mesh.axis_names.index(axis)
+    pid = jax.process_index()
+    return sorted({idx[ai] for idx, d in np.ndenumerate(mesh.devices)
+                   if d.process_index == pid})
+
+
 def _eager_collective(x, group, per_shard_fn, out_rank_major=True,
                       op_name="collective", scatter_dim=None):
     """Run `per_shard_fn(local)` under shard_map over the group axis, with
-    rank-major input (dim 0 = group)."""
-    x = jnp.asarray(x)
+    rank-major input (dim 0 = group).
+
+    Multi-process: each process passes only the rows for the group positions
+    its devices cover (`_local_axis_positions`, usually one row for a
+    cross-host axis, all rows for an intra-host axis) and gets those rows
+    back — the reference's per-rank eager semantics
+    (python/paddle/distributed/communication/all_reduce.py:29) without any
+    process owning the global array."""
     mesh = group.mesh if group is not None and group.mesh is not None else _world_mesh()
     axis = default_axis(group)
     n = mesh.shape[axis]
     from .check import nan_guard, static_check
+    in_spec = P(axis)
+    fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
+                   out_specs=in_spec if out_rank_major else P(),
+                   )
+    if jax.process_count() > 1:
+        xh = np.asarray(x)
+        positions = _local_axis_positions(mesh, axis)
+        assert xh.shape[0] == len(positions), (
+            f"multi-process eager collective: this process covers group "
+            f"positions {positions} of axis '{axis}' and must pass "
+            f"{len(positions)} rank-major rows, got shape {xh.shape}")
+        static_check(xh, n, op_name, scatter_dim=scatter_dim,
+                     expected_dim0=len(positions))
+        nan_guard(xh, op_name)
+        global_shape = (n,) + tuple(xh.shape[1:])
+        sharding = NamedSharding(mesh, in_spec)
+        garr = jax.make_array_from_process_local_data(sharding, xh,
+                                                      global_shape)
+        out = jax.jit(fn)(garr)
+        if not out_rank_major:
+            return jnp.asarray(np.asarray(out.addressable_shards[0].data))
+        rows = {}
+        for s in out.addressable_shards:
+            start = s.index[0].start or 0
+            rows[start] = np.asarray(s.data)
+        return jnp.concatenate([rows[i] for i in sorted(rows)], axis=0)
+    x = jnp.asarray(x)
     static_check(x, n, op_name, scatter_dim=scatter_dim)
     x = nan_guard(x, op_name)
     assert x.shape[0] == n, (
         f"eager collective expects rank-major input with dim0 == group size "
         f"{n}, got shape {x.shape}")
-    in_spec = P(axis)
-    fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
-                   out_specs=in_spec if out_rank_major else P(),
-                   )
     return jax.jit(fn)(x)
 
 
@@ -166,8 +205,9 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
         return _eager_collective(x, group, f)
     # list-output compat form: all_gather(out_list, tensor, group)
     out = all_gather(tensor, group=group)
-    n = out.shape[0]
-    tensor_or_list.extend([out[i, i] for i in range(n)])
+    # out is [k, n, *S] with every row block the identical gathered result
+    # (k = group size single-process, locally-covered positions otherwise)
+    tensor_or_list.extend([out[0, i] for i in range(out.shape[1])])
     return tensor_or_list
 
 

@@ -34,7 +34,7 @@ def get_rank() -> int:
     if _initialized[0]:
         return jax.process_index()
     return _env_int(["PADDLE_TRAINER_ID", "PADDLE_RANK_IN_NODE", "RANK",
-                     "JAX_PROCESS_INDEX"], 0)
+                     "JAX_PROCESS_ID", "JAX_PROCESS_INDEX"], 0)
 
 
 def get_world_size() -> int:
@@ -44,7 +44,8 @@ def get_world_size() -> int:
     eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
     if eps:
         return len(eps.split(","))
-    return _env_int(["PADDLE_TRAINERS_NUM", "WORLD_SIZE", "JAX_PROCESS_COUNT"], 1)
+    return _env_int(["PADDLE_TRAINERS_NUM", "WORLD_SIZE", "JAX_NUM_PROCESSES",
+                     "JAX_PROCESS_COUNT"], 1)
 
 
 def get_local_rank() -> int:
@@ -93,6 +94,14 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     import jax
     if _initialized[0]:
         return ParallelEnv()
+    # CI / reference-pattern tests (SURVEY §4: subprocess spawn + env
+    # rendezvous): each worker process emulates a host with N virtual CPU
+    # devices. Must happen before jax.distributed.initialize touches the
+    # backend.
+    n_virtual = _env_int(["PADDLE_VIRTUAL_DEVICES_PER_PROC"], 0)
+    if n_virtual > 0:
+        from ..device import force_virtual_cpu_devices
+        force_virtual_cpu_devices(n_virtual)
     # NOTE: PADDLE_MASTER is the launcher's KV-store endpoint (different
     # port/protocol) — the jax coordinator address is its own env var.
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS") \

@@ -1,0 +1,153 @@
+"""Two-process validation workload (`python -m
+paddle_tpu.distributed.mp_smoke`).
+
+The multi-host analogue of the driver's single-process dry run: spawned as 2
+jax processes x N/2 virtual CPU devices each (reference pattern:
+test/legacy_test/test_dist_base.py:1206 _run_cluster), it builds the hybrid
+ICI/DCN mesh (dp across processes, mp intra-process), runs a few hybrid
+dp x mp train steps, and prints the loss curve as JSON for the launcher to
+compare against the identical single-process run.
+
+`run_training(mesh, steps)` is imported by the parent for the golden run;
+`spawn_and_check(n_devices)` is the launcher half used by
+__graft_entry__.dryrun_multichip and by tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+__all__ = ["run_training", "spawn_cluster", "spawn_and_check", "main"]
+
+# env that would leak the parent's jax/launcher identity into workers
+_SCRUB_ENV = ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID", "JAX_COORDINATOR_ADDRESS",
+              "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+              "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT",
+              "PADDLE_LOCAL_RANK", "PADDLE_VIRTUAL_DEVICES_PER_PROC")
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def spawn_cluster(argv, nproc: int, devices_per_proc: int,
+                  sentinel: str, extra_env=None, timeout: float = 300.0):
+    """Spawn `nproc` jax worker processes of `argv` (2-process rendezvous on
+    a fresh port, `devices_per_proc` virtual CPU devices each), wait, and
+    return the JSON payload following `sentinel` on each worker's stdout —
+    the shared launcher half of the reference subprocess-spawn pattern
+    (test_dist_base.py:1206 _run_cluster)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    repo = _repo_root()
+    procs, outs = [], []
+    for pid in range(nproc):
+        env = {k: v for k, v in os.environ.items() if k not in _SCRUB_ENV}
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_VIRTUAL_DEVICES_PER_PROC": str(devices_per_proc),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(nproc),
+            "JAX_PROCESS_ID": str(pid),
+            "PADDLE_TRAINER_ID": str(pid),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            argv, env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(f"worker failed (rc={p.returncode}):\n"
+                               f"{out[-4000:]}")
+    results = []
+    for out in outs:
+        line = next(l for l in out.splitlines() if l.startswith(sentinel))
+        results.append(json.loads(line[len(sentinel):]))
+    return results
+
+
+def run_training(mesh, steps: int = 4):
+    """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
+    pp / mp); every process computes identical host inputs."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step, shard_params, init_state = G.build_hybrid_train_step(
+        cfg, mesh, opt, num_microbatches=1)
+    params = shard_params(params)
+    state = init_state(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, tokens, labels,
+                                   jnp.float32(1e-2))
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def main():
+    from . import env as dist_env
+    from .topology import build_mesh
+
+    dist_env.init_parallel_env()
+    import jax
+
+    n = len(jax.devices())
+    mesh = build_mesh({"dp": 2, "pp": 1, "mp": n // 2})
+    # hybrid-layout invariant: mp intra-process, dp across processes
+    assert len({d.process_index for d in mesh.devices[0, 0, :]}) == 1
+    assert (mesh.devices[0, 0, 0].process_index
+            != mesh.devices[1, 0, 0].process_index)
+    losses = run_training(mesh)
+    print("MPSMOKE " + json.dumps(
+        {"rank": jax.process_index(), "losses": losses}), flush=True)
+
+
+def spawn_and_check(n_devices: int, golden, timeout: float = 300.0) -> None:
+    """Spawn the 2-process cluster (n_devices/2 virtual CPU devices per
+    process) and assert its loss curve matches `golden` (the single-process
+    run of `run_training` on the same mesh shape)."""
+    assert n_devices % 2 == 0 and n_devices >= 4, n_devices
+    results = spawn_cluster(
+        [sys.executable, "-m", "paddle_tpu.distributed.mp_smoke"],
+        nproc=2, devices_per_proc=n_devices // 2, sentinel="MPSMOKE ",
+        timeout=timeout)
+    for res in results:
+        if not np.allclose(res["losses"], golden, rtol=0, atol=5e-5):
+            raise AssertionError(
+                f"2-process loss curve {res['losses']} != "
+                f"single-process {golden}")
+
+
+if __name__ == "__main__":
+    main()

@@ -121,16 +121,119 @@ class CommunicateTopology:
         return self._coord2rank[tuple(coord)]
 
 
+def _local_order_key(d):
+    """Stable intra-host device order: physical coords when the backend
+    exposes them (TPU: (x, y, z) + core), else the global id. Every host must
+    sort its local devices the same way or cross-host axes would twist."""
+    coords = getattr(d, "coords", None)
+    if coords is not None:
+        return (0, tuple(coords), getattr(d, "core_on_chip", 0))
+    return (1, d.id)
+
+
+def _split_ici_dcn(shape: Sequence[int], n_local: int):
+    """Factor an outer->inner axis-degree list at the per-process device
+    count. Returns (dcn_shape, ici_shape) aligned per axis (degree =
+    dcn*ici); axes fully across hosts get ici=1, fully intra-host dcn=1, and
+    at most one axis straddles the boundary with both factors > 1.
+
+    Raises if the boundary does not fall cleanly (e.g. an inner axis degree
+    that does not divide the local device count) — such a mesh would route an
+    inner (fast) axis over DCN, which is never what the caller wants."""
+    dcn, ici = [], []
+    rem = n_local
+    for deg in reversed(list(shape)):
+        if rem == 1:
+            dcn.insert(0, deg)
+            ici.insert(0, 1)
+        elif deg <= rem:
+            if rem % deg:
+                raise ValueError(
+                    f"axis degree {deg} does not divide the remaining "
+                    f"intra-host device block {rem} (shape={list(shape)}, "
+                    f"devices/process={n_local})")
+            ici.insert(0, deg)
+            dcn.insert(0, 1)
+            rem //= deg
+        else:
+            if deg % rem:
+                raise ValueError(
+                    f"axis degree {deg} cannot absorb the remaining "
+                    f"intra-host device block {rem} (shape={list(shape)}, "
+                    f"devices/process={n_local})")
+            ici.insert(0, rem)
+            dcn.insert(0, deg // rem)
+            rem = 1
+    return dcn, ici
+
+
+def _hybrid_device_array(shape: Sequence[int], devices: Sequence) -> np.ndarray:
+    """Arrange devices so inner mesh axes ride ICI (intra-process) and outer
+    axes cross hosts/DCN (the reference assumes a flat NCCL ring per group —
+    SURVEY §5 comm-backend note; on TPU the 2-level ICI+DCN layout is what
+    makes mp/sep collectives fast). Equivalent of
+    jax.experimental.mesh_utils.create_hybrid_device_mesh keyed off each
+    device's process_index."""
+    by_proc: Dict[int, list] = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    procs = sorted(by_proc)
+    locals_ = [sorted(by_proc[p], key=_local_order_key) for p in procs]
+    n_local = len(locals_[0])
+    if any(len(l) != n_local for l in locals_):
+        raise ValueError(
+            "uneven device count per process: "
+            + str({p: len(by_proc[p]) for p in procs}))
+    dcn_shape, ici_shape = _split_ici_dcn(shape, n_local)
+
+    if all(getattr(d, "platform", "") == "tpu" for d in devices):
+        # real TPU: let mesh_utils pick the ICI-optimal intra-slice order
+        # (ring/torus-aware); per-axis (ici, dcn) factors from the split.
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_shape), devices=devices)
+            return arr.reshape(tuple(shape))
+        except Exception:
+            pass  # fall through to the explicit construction
+
+    flat = np.empty(len(devices), dtype=object)
+    for i, ds in enumerate(locals_):
+        flat[i * n_local:(i + 1) * n_local] = ds
+    # host-major flat order: outer (DCN) axes stride across processes, inner
+    # (ICI) axes stay within one process; the straddling axis (if any) has
+    # its dcn factor adjacent-outer to its ici factor, so the direct reshape
+    # merges them in the right order.
+    return flat.reshape(tuple(shape))
+
+
 def build_mesh(dims: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
     """Build a Mesh with named axes from {axis: degree}. Degrees must multiply
     to the device count (axes of degree 1 are kept so shardings can name
-    them)."""
+    them).
+
+    Axis order is outer->inner: the LAST axes in `dims` (mp/sep in the
+    fleet order) land on the fastest links. Multi-process runs get the
+    2-level hybrid layout (inner axes intra-host on ICI, outer axes across
+    hosts on DCN); single-process real-TPU runs get mesh_utils' ICI-aware
+    device order; everything else is the flat reshape."""
     devices = list(devices if devices is not None else jax.devices())
     total = int(np.prod(list(dims.values())))
     assert total == len(devices), (
         f"product of parallel degrees {dims} = {total} != device count "
         f"{len(devices)}")
-    arr = np.array(devices).reshape(*dims.values())
+    shape = tuple(dims.values())
+    n_proc = len({d.process_index for d in devices})
+    if n_proc > 1:
+        arr = _hybrid_device_array(shape, devices)
+    elif all(getattr(d, "platform", "") == "tpu" for d in devices):
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            arr = np.array(devices).reshape(shape)
+    else:
+        arr = np.array(devices).reshape(shape)
     return Mesh(arr, tuple(dims.keys()))
 
 

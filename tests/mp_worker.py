@@ -1,0 +1,176 @@
+"""Reference-pattern distributed worker (reference:
+test/legacy_test/test_dist_base.py:954 TestDistBase worker half and
+test/legacy_test/test_collective_api_base.py:113 TestCollectiveAPIRunnerBase
+— a standalone script the launcher spawns per process; it runs the
+workload and prints JSON results on stdout for the parent to compare).
+
+This worker runs under jax.distributed with 2 processes x 4 virtual CPU
+devices (the TPU translation of SURVEY §4's subprocess-spawn + env
+rendezvous pattern): hybrid dp2 x mp4 GPT training, the eager collective
+suite, and a distributed save/load round trip. The parent
+(test_multiprocess.py) runs the identical single-process 8-device job and
+asserts loss parity.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_job():
+    """Model/config/data shared by the worker and the parent's golden run.
+    Everything is seed-deterministic so every process constructs identical
+    host values."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 16))
+    labels = rng.randint(0, cfg.vocab_size, (8, 16))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    return cfg, params, tokens, labels, opt
+
+
+def run_training(mesh, steps=5):
+    """The dp2 x mp4 hybrid train-loop; returns the per-step loss list."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt as G
+
+    cfg, params, tokens, labels, opt = build_job()
+    step, shard_params, init_state = G.build_hybrid_train_step(
+        cfg, mesh, opt, num_microbatches=1)
+    params = shard_params(params)
+    state = init_state(params)
+    tokens = jnp.asarray(tokens)
+    labels = jnp.asarray(labels)
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, tokens, labels,
+                                   jnp.float32(1e-2))
+        losses.append(float(jax.device_get(loss)))
+    return losses, params
+
+
+def run_collective_suite(mesh):
+    """Eager collectives over both the cross-host (dp) and intra-host (mp)
+    axes; returns a dict of result checksums the parent compares across
+    ranks (reference: collective_*.py worker scripts + golden numpy in
+    test_collective_api_base.py:392)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.collective import _local_axis_positions
+    from paddle_tpu.distributed.topology import Group
+
+    out = {}
+    nproc = jax.process_count()
+
+    for axis in ("dp", "mp"):
+        n = mesh.shape[axis]
+        grp = Group(0, -1, list(range(n)), axis_name=axis, mesh=mesh)
+        positions = (_local_axis_positions(mesh, axis) if nproc > 1
+                     else list(range(n)))
+        # each covered position contributes the row [pos, pos+1, ..., pos+3]
+        rows = np.stack([np.arange(4, dtype=np.float32) + p
+                         for p in positions])
+
+        r = np.asarray(dist.all_reduce(rows, group=grp))
+        # golden: sum_p (arange(4) + p) = n*arange(4) + n(n-1)/2
+        want = n * np.arange(4, dtype=np.float32) + n * (n - 1) / 2
+        assert np.allclose(r, want[None, :].repeat(len(positions), 0)), (
+            axis, r, want)
+        out[f"all_reduce_{axis}"] = float(r.sum())
+
+        g = np.asarray(dist.all_gather(rows, group=grp))
+        # rank-major out: [k, n, 4]; every row block is the full gather
+        full = np.stack([np.arange(4, dtype=np.float32) + p
+                         for p in range(n)])
+        assert g.shape == (len(positions), n, 4), g.shape
+        assert np.allclose(g[0], full), (axis, g[0], full)
+        out[f"all_gather_{axis}"] = float(g.sum())
+
+        # reduce_scatter: each rank contributes arange(n)+p; element [pos]
+        # of the sum lands on rank pos
+        rs_in = np.stack([(np.arange(n, dtype=np.float32) + p)
+                          for p in positions])
+        rs = np.asarray(dist.reduce_scatter(rs_in, group=grp))
+        want_full = n * np.arange(n, dtype=np.float32) + n * (n - 1) / 2
+        for i, p in enumerate(positions):
+            assert np.allclose(rs[i], want_full[p]), (axis, rs, want_full)
+        out[f"reduce_scatter_{axis}"] = float(rs.sum())
+
+        b_in = np.stack([(np.arange(4, dtype=np.float32) + 100 * (p == 1))
+                         for p in positions])
+        b = np.asarray(dist.broadcast(b_in, src=1, group=grp))
+        want_b = np.arange(4, dtype=np.float32) + 100
+        assert np.allclose(b, want_b[None].repeat(len(positions), 0)), (
+            axis, b)
+        out[f"broadcast_{axis}"] = float(b.sum())
+
+    return out
+
+
+def run_checkpoint_roundtrip(mesh, params, path):
+    """Distributed save (every process writes only the shards it owns) +
+    full-tensor reassembly verification (reference:
+    test/auto_parallel/hybrid_strategy/test_save_load_state_dict.py)."""
+    import jax
+    from jax.experimental import multihost_utils
+    from paddle_tpu.distributed.checkpoint import (load_full_state_dict,
+                                                   save_state_dict)
+
+    sd = {"params": params}
+    save_state_dict(sd, path)
+    # both processes must have flushed their .distcp files (and rank 0 the
+    # metadata) before anyone reads
+    multihost_utils.sync_global_devices("mp_worker_ckpt_saved")
+    full = load_full_state_dict(path)["params"]
+    flat_full = dict(jax.tree.leaves_with_path(full))
+    ok = True
+    for pth, v in jax.tree.leaves_with_path(sd["params"]):
+        whole = np.asarray(flat_full[pth])
+        for shard in v.addressable_shards:
+            if not np.array_equal(np.asarray(jax.device_get(shard.data)),
+                                  whole[shard.index]):
+                ok = False
+    return ok
+
+
+def main():
+    import paddle_tpu.distributed as dist
+
+    env = dist.init_parallel_env()
+    import jax
+
+    assert jax.process_count() == int(os.environ["JAX_NUM_PROCESSES"]), (
+        jax.process_count())
+    mesh = dist.build_mesh({"dp": 2, "pp": 1, "mp": 4})
+
+    # hybrid-layout invariant: the inner (mp) axis must be intra-process
+    # (ICI), the outer (dp) axis across processes (DCN)
+    mp_procs = {d.process_index
+                for d in mesh.devices[0, 0, :]}
+    dp_procs = [mesh.devices[i, 0, 0].process_index for i in range(2)]
+    assert len(mp_procs) == 1, f"mp axis crosses processes: {mp_procs}"
+    assert dp_procs == [0, 1], f"dp axis not across processes: {dp_procs}"
+
+    results = {"rank": env.rank, "world": env.world_size}
+    results["collectives"] = run_collective_suite(mesh)
+    losses, params = run_training(mesh)
+    results["losses"] = losses
+    ckpt_dir = os.environ.get("MP_TEST_CKPT_DIR")
+    if ckpt_dir:
+        results["ckpt_ok"] = run_checkpoint_roundtrip(mesh, params, ckpt_dir)
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
