@@ -58,12 +58,13 @@ def bench_resnet50(jax, jnp, paddle):
             "config": "CIFAR10 32x32, batch 256, Momentum, fp32"}
 
 
-def bench_bert_base(jax, jnp, paddle):
-    """Config 1: BERT-base pretraining (MLM+NSP) with padded batches —
-    the bool attention mask rides the Pallas kernel's in-kernel bias."""
-    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
-                                        bert_pretrain_loss)
-    from paddle_tpu.nn import functional_call, functional_train_graph
+def _bert_job(jax, jnp, paddle):
+    """Shared BERT-base setup: model, bf16 params/opt, ragged lengths.
+    Returns everything both the padded and packed variants need. MFU is
+    computed on USEFUL flops only (6*N_matmul*real_tokens + attention
+    sum(len_i^2) term) so the packed-vs-padded delta measures real work."""
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.nn import functional_train_graph
 
     cfg = BertConfig()
     model = BertForPretraining(cfg)
@@ -75,13 +76,42 @@ def bench_bert_base(jax, jnp, paddle):
     state = jax.jit(opt.init_state)(params)
     B, S = 16, 512
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
-    # ragged valid lengths -> bool padding mask [B, 1, S, S]
-    lens = rng.randint(S // 2, S + 1, (B,))
+    # pretraining-corpus raggedness: uniform [S/8, S] (round-2 used
+    # [S/2, S], under which no two sequences can share a 512 row and
+    # packing degenerates to padding)
+    lens = rng.randint(S // 8, S + 1, (B,))
+    seqs = [rng.randint(0, cfg.vocab_size, (l,)) for l in lens]
+    # matmul params: everything except the 3 embedding lookup tables
+    emb = (cfg.vocab_size + cfg.max_position_embeddings
+           + cfg.type_vocab_size) * cfg.hidden_size
+    n_matmul = sum(int(np.prod(v.shape))
+                   for v in jax.tree.leaves(params)) - emb
+    t_real = int(sum(lens))
+    # useful model flops per optimizer step (fwd+bwd):
+    # 6*N per real token + attention 12*L*H*len^2 per sequence
+    flops = (6.0 * n_matmul * t_real
+             + 12.0 * cfg.num_layers * cfg.hidden_size
+             * float(sum(int(l) ** 2 for l in lens)))
+    return (cfg, model, params, buffers, opt, state, rng, seqs, lens,
+            t_real, flops, B, S)
+
+
+def bench_bert_base(jax, jnp, paddle):
+    """Config 1 (padded): the bool padding mask rides the Pallas kernel's
+    in-kernel bias; pad positions are dead compute (~25% of the batch)."""
+    from paddle_tpu.models.bert import bert_pretrain_loss
+    from paddle_tpu.nn import functional_call
+
+    (cfg, model, params, buffers, opt, state, rng, seqs, lens, t_real,
+     flops, B, S) = _bert_job(jax, jnp, paddle)
+    ids_np = np.zeros((B, S), np.int32)
+    for i, s in enumerate(seqs):
+        ids_np[i, :len(s)] = s
+    ids = jnp.asarray(ids_np)
     valid = jnp.asarray(np.arange(S)[None, :] < lens[:, None])
     amask = (valid[:, None, None, :] & valid[:, None, :, None])
     mlm_labels = jnp.asarray(
-        np.where(rng.rand(B, S) < 0.15,
+        np.where((rng.rand(B, S) < 0.15) & np.asarray(valid),
                  rng.randint(0, cfg.vocab_size, (B, S)), -100))
     nsp_labels = jnp.asarray(rng.randint(0, 2, (B,)))
 
@@ -98,9 +128,51 @@ def bench_bert_base(jax, jnp, paddle):
     dt = _timed(step, (params, state),
                 (ids, amask, mlm_labels, nsp_labels), 12)
     return {"metric": "bert_base_tokens_per_sec_per_chip",
-            "value": round(B * S / dt, 1), "unit": "tokens/s",
+            "value": round(B * S / dt, 1), "unit": "tokens/s (padded)",
+            "real_tokens_per_sec": round(t_real / dt, 1),
+            "mfu_pct": round(flops / dt / 197e12 * 100, 1),
             "config": "BERT-base MLM+NSP, seq 512, batch 16, padded "
-                      "(bool mask in-kernel), bf16"}
+                      "(bool mask in-kernel), bf16; MFU on useful flops"}
+
+
+def bench_bert_packed(jax, jnp, paddle):
+    """Config 1 (packed): the same ragged corpus packed first-fit into
+    dense rows — in-kernel segment masking + restarting position ids, zero
+    pad compute (the reference's flash varlen path run TPU-style)."""
+    from paddle_tpu.models.bert import bert_pretrain_loss, pack_sequences
+    from paddle_tpu.nn import functional_call
+
+    (cfg, model, params, buffers, opt, state, rng, seqs, lens, t_real,
+     flops, B, S) = _bert_job(jax, jnp, paddle)
+    ids, seg, pos, _, _ = pack_sequences(seqs, S)
+    Bp = ids.shape[0]
+    real = seg >= 0
+    mlm_labels = jnp.asarray(
+        np.where((rng.rand(Bp, S) < 0.15) & real,
+                 rng.randint(0, cfg.vocab_size, (Bp, S)), -100))
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (Bp,)))
+    ids, seg, pos = jnp.asarray(ids), jnp.asarray(seg), jnp.asarray(pos)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, ids, seg, pos, mlm_labels, nsp_labels):
+        def loss_fn(p):
+            (mlm, nsp), _ = functional_call(
+                model, p, buffers, ids, pack_segment_ids=seg,
+                position_ids=pos)
+            return bert_pretrain_loss(mlm, nsp, mlm_labels, nsp_labels)
+        l, g = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.apply(params, g, state, 1e-4)
+        return params, state, l
+
+    dt = _timed(step, (params, state),
+                (ids, seg, pos, mlm_labels, nsp_labels), 12)
+    return {"metric": "bert_base_packed_tokens_per_sec_per_chip",
+            "value": round(t_real / dt, 1), "unit": "tokens/s (real)",
+            "packed_rows": int(Bp),
+            "mfu_pct": round(flops / dt / 197e12 * 100, 1),
+            "config": "BERT-base MLM+NSP, same corpus packed into "
+                      f"{Bp} rows of 512 (in-kernel segments), bf16; "
+                      "MFU on useful flops"}
 
 
 def bench_llama(jax, jnp, paddle):
@@ -150,7 +222,8 @@ def main():
     if not on_tpu:
         print(json.dumps({"error": "configs bench needs the TPU backend"}))
         return
-    for fn in (bench_resnet50, bench_bert_base, bench_llama):
+    for fn in (bench_resnet50, bench_bert_base, bench_bert_packed,
+               bench_llama):
         try:
             print(json.dumps(fn(jax, jnp, paddle)))
         except Exception as e:  # keep going; report the failure

@@ -108,6 +108,9 @@ def supported(query, key, value, attn_mask=None, dropout_p=0.0,
         mb, mh, msq, msk = attn_mask.shape
         if (msq, msk) != (sq, sk) or mb not in (1, b) or mh not in (1, h):
             return False
+    seg = kwargs.get("segment_ids")
+    if seg is not None and tuple(getattr(seg, "shape", ())) != (b, sq):
+        return False
     if dropout_p and not 0.0 <= float(dropout_p) < 1.0:
         return False
     return True
@@ -696,13 +699,14 @@ def _unprep(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-_STATIC = (7, 8, 9, 10, 11, 12)  # causal, sm_scale, block_q, block_k,
-#                                   window, dropout_p
+_STATIC = (7, 8, 9, 10, 11, 12, 13)  # causal, sm_scale, block_q, block_k,
+#                                       window, dropout_p, bias_grad
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=_STATIC)
 def _flash(query, key, value, bias, q_seg, kv_seg, seed,
-           causal, sm_scale, block_q, block_k, window, dropout_p):
+           causal, sm_scale, block_q, block_k, window, dropout_p,
+           bias_grad=False):
     out, _ = _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
                              causal, sm_scale, block_q, block_k, window,
                              dropout_p, save_lse=False)
@@ -726,7 +730,8 @@ def _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
 
 
 def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
-               causal, sm_scale, block_q, block_k, window, dropout_p):
+               causal, sm_scale, block_q, block_k, window, dropout_p,
+               bias_grad=False):
     out, res = _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
                                causal, sm_scale, block_q, block_k, window,
                                dropout_p, save_lse=True)
@@ -734,10 +739,11 @@ def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
-               res, g):
+               bias_grad, res, g):
     q, k, v, out, lse, b, h, h_kv, bias, q_seg, kv_seg, seed = res
     fm_start = fm_end = None
-    if bias is not None and isinstance(bias, tuple):
+    is_fm = bias is not None and isinstance(bias, tuple)
+    if is_fm:
         bias, fm_start, fm_end = bias
     do = _prep(g)
     dq, dk, dv = _bwd_impl(
@@ -746,11 +752,56 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
         fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed)
     dbias = None
     if bias is not None:
-        # the fast path treats the bias/mask as a constant (padding masks,
-        # flashmask rows); a *learned* bias needs the composed path
-        dbias = jax.tree_util.tree_map(jnp.zeros_like, bias)
+        if bias_grad:
+            db = _dbias_composed(q, k, v, out, lse, do, bias, sm_scale,
+                                 causal, h, h_kv, b)
+            dbias = (db, jnp.zeros_like(fm_start),
+                     jnp.zeros_like(fm_end)) if is_fm else db
+        else:
+            # constant-mask contract (padding masks, flashmask rows) — the
+            # reference flash kernels likewise emit no mask gradient. Pass
+            # bias_grad=True for a LEARNED bias (composed O(S^2) recompute).
+            dbias = jax.tree_util.tree_map(jnp.zeros_like,
+                                           (bias, fm_start, fm_end)
+                                           if is_fm else bias)
     return (_unprep(dq, b, h), _unprep(dk, b, h_kv), _unprep(dv, b, h_kv),
             dbias, None, None, None)
+
+
+def _dbias_composed(q, k, v, out, lse, do, bias, sm_scale, causal, h, h_kv,
+                    b):
+    """Additive-bias gradient, recomputed composed (one O(S^2) fp32 score
+    pass — the cost the in-kernel path avoids; only taken on request).
+    Restrictions: plain bias only, no dropout/segments (callers gate)."""
+    if h_kv != h:
+        batch = k.shape[0] // h_kv
+        g = h // h_kv
+        k = jnp.repeat(k.reshape(batch, h_kv, *k.shape[1:]), g,
+                       axis=1).reshape(batch * h, *k.shape[1:])
+        v = jnp.repeat(v.reshape(batch, h_kv, *v.shape[1:]), g,
+                       axis=1).reshape(batch * h, *v.shape[1:])
+    sq, sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = s + bias.astype(jnp.float32).reshape(-1, *bias.shape[-2:])         if bias.shape[0] * bias.shape[1] == s.shape[0] else         s + jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            (b, h, sq, sk)).reshape(b * h, sq, sk)
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        s = jnp.where(cm[None], s, -1e30)
+    p = jnp.exp(s - lse[..., None])
+    p = jnp.where((lse <= -1e29)[..., None], 0.0, p)
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])  # [b*h, sq, sk]
+    ds = ds.reshape(b, h, sq, sk)
+    # reduce to the (possibly broadcast) bias shape
+    if bias.shape[0] == 1:
+        ds = ds.sum(axis=0, keepdims=True)
+    if bias.shape[1] == 1:
+        ds = ds.sum(axis=1, keepdims=True)
+    return ds.astype(bias.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -760,7 +811,7 @@ def flash_attention(query, key, value, causal=False, sm_scale=None,
                     block_q=None, block_k=None, *, bias=None,
                     q_segment_ids=None, kv_segment_ids=None,
                     startend_row_indices=None, window=None,
-                    dropout_p=0.0, dropout_seed=None):
+                    dropout_p=0.0, dropout_seed=None, bias_grad=False):
     """Fused attention. query: [B, Sq, H, D]; key/value: [B, Sk, H_kv, D]
     with H % H_kv == 0 (GQA/MQA native — KV heads are indexed, not
     repeated) → [B, Sq, H, D].
@@ -808,6 +859,14 @@ def flash_attention(query, key, value, causal=False, sm_scale=None,
         fm_start, fm_end = startend_row_indices
         packed_bias = (bias, fm_start.astype(jnp.int32),
                        fm_end.astype(jnp.int32))
+    if bias_grad and (dropout_p > 0 or q_segment_ids is not None
+                      or window is not None
+                      or startend_row_indices is not None):
+        raise NotImplementedError(
+            "bias_grad=True (learned additive bias) supports only the "
+            "plain/causal bias form — the composed dbias recompute does "
+            "not model dropout, segments, windows or flashmask rows; "
+            "compose attention manually for those combinations")
     return _flash(query, key, value, packed_bias, q_segment_ids,
                   kv_segment_ids, dropout_seed, bool(causal), scale, bq, bk,
-                  window, float(dropout_p))
+                  window, float(dropout_p), bool(bias_grad))

@@ -18,7 +18,7 @@ import paddle_tpu.kernels.pallas.rms_norm as rn
 
 @register_pallas_impl("scaled_dot_product_attention", supported=fa.supported)
 def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
-                 is_causal=False, training=True, name=None):
+                 is_causal=False, training=True, name=None, segment_ids=None):
     del name
     bias = None
     if attn_mask is not None:
@@ -28,12 +28,17 @@ def _sdpa_pallas(query, key, value, attn_mask=None, dropout_p=0.0,
             bias = jnp.where(attn_mask, 0.0, -1e30).astype(query.dtype)
         else:
             bias = attn_mask
+    seg = None
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids).astype(jnp.int32)
     p, seed = _dropout_seed(dropout_p, training)
     from ...flags import flag
     bq = flag("flash_attn_block_q") or None  # 0 = auto-pick
     bk = flag("flash_attn_block_k") or None
     return fa.flash_attention(query, key, value, is_causal, None, bq, bk,
-                              bias=bias, dropout_p=p, dropout_seed=seed)
+                              bias=bias, q_segment_ids=seg,
+                              kv_segment_ids=seg, dropout_p=p,
+                              dropout_seed=seed)
 
 
 def _dropout_seed(p, training):
@@ -104,12 +109,17 @@ def _flash_attn_unpadded_pallas(query, key, value, cu_seqlens_q,
 
 
 def _flashmask_supported(query, key, value, startend_row_indices=None,
-                         dropout=0.0, causal=True, window_size=None):
+                         dropout=0.0, causal=False, window_size=None):
     if not fa.supported(query, key, value, dropout_p=dropout):
         return False
     if startend_row_indices is not None:
         idx = startend_row_indices
         if getattr(idx, "ndim", 0) != 4 or idx.shape[-1] not in (1, 2):
+            return False
+        if not causal:
+            # bidirectional forms mask TWO bands per column (triangle-
+            # scoped) — not expressible as the kernel's single start/end
+            # band; they ride the composed path's dense mask instead
             return False
         b, sq, h = query.shape[0], query.shape[1], query.shape[2]
         if idx.shape[0] != b or idx.shape[1] not in (1, h):
@@ -121,7 +131,7 @@ def _flashmask_supported(query, key, value, startend_row_indices=None,
 
 @register_pallas_impl("flashmask_attention", supported=_flashmask_supported)
 def _flashmask_pallas(query, key, value, startend_row_indices=None,
-                      dropout=0.0, causal=True, window_size=None):
+                      dropout=0.0, causal=False, window_size=None):
     fm = None
     if startend_row_indices is not None:
         idx = startend_row_indices

@@ -17,7 +17,7 @@ from .. import nn
 from ..nn import functional as F
 
 __all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
-           "bert_large", "bert_pretrain_loss"]
+           "bert_large", "bert_pretrain_loss", "pack_sequences"]
 
 
 @dataclasses.dataclass
@@ -54,9 +54,10 @@ class BertEmbeddings(nn.Layer):
                                        epsilon=cfg.layer_norm_eps)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
         B, S = input_ids.shape
-        pos = jnp.arange(S)[None, :]
+        pos = (jnp.arange(S)[None, :] if position_ids is None
+               else position_ids)
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = (self.word_embeddings(input_ids)
@@ -77,13 +78,21 @@ class BertModel(nn.Layer):
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        x = self.embeddings(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                pack_segment_ids=None, position_ids=None):
+        """pack_segment_ids: int32 [B, S] ids of PACKED sequences sharing a
+        row (zero-padding-free pretraining — the reference's flash varlen
+        path, flash_attention.py:242 cu_seqlens form). Distinct from BERT's
+        token_type_ids ("segment A/B" within ONE sequence). When packing,
+        pass position_ids that restart at each sequence start so learned
+        position embeddings match the unpacked layout."""
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
         if attention_mask is not None and attention_mask.ndim == 2:
             # [B, S] padding mask → additive [B, 1, 1, S]
             attention_mask = jnp.where(
                 attention_mask[:, None, None, :] > 0, 0.0, -1e30)
-        seq = self.encoder(x, src_mask=attention_mask)
+        seq = self.encoder(x, src_mask=attention_mask,
+                           segment_ids=pack_segment_ids)
         pooled = jnp.tanh(self.pooler(seq[:, 0]))
         return seq, pooled
 
@@ -99,10 +108,57 @@ class BertForPretraining(nn.Layer):
         self.mlm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
         self.nsp_head = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                pack_segment_ids=None, position_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                pack_segment_ids=pack_segment_ids,
+                                position_ids=position_ids)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq), approximate=True))
         return self.mlm_head(h), self.nsp_head(pooled)
+
+
+def pack_sequences(seqs, seq_len: int, pad_id: int = 0):
+    """Greedy first-fit packing of variable-length token sequences into
+    dense [rows, seq_len] batches with NO cross-sequence attention: returns
+    (input_ids, pack_segment_ids, position_ids, row_of_seq, offset_of_seq).
+
+    pack_segment_ids gives every sequence a distinct id within its row (pad
+    tail = -1 so it matches nothing); position_ids restart at 0 per
+    sequence. This is the zero-padding path the reference serves through
+    flash_attn varlen/cu_seqlens (python/paddle/nn/functional/
+    flash_attention.py:242); here the ids ride the Pallas kernel's
+    in-kernel segment masking."""
+    import numpy as np
+
+    rows, row_lens = [], []
+    row_of_seq, offset_of_seq = [], []
+    for s in seqs:
+        L = len(s)
+        assert L <= seq_len, f"sequence of {L} tokens exceeds row {seq_len}"
+        for r in range(len(rows)):
+            if row_lens[r] + L <= seq_len:
+                break
+        else:
+            rows.append([])
+            row_lens.append(0)
+            r = len(rows) - 1
+        row_of_seq.append(r)
+        offset_of_seq.append(row_lens[r])
+        rows[r].append(np.asarray(s))
+        row_lens[r] += L
+
+    B = len(rows)
+    ids = np.full((B, seq_len), pad_id, dtype=np.int32)
+    seg = np.full((B, seq_len), -1, dtype=np.int32)
+    pos = np.zeros((B, seq_len), dtype=np.int32)
+    for r, chunks in enumerate(rows):
+        off = 0
+        for i, c in enumerate(chunks):
+            ids[r, off:off + len(c)] = c
+            seg[r, off:off + len(c)] = i
+            pos[r, off:off + len(c)] = np.arange(len(c))
+            off += len(c)
+    return ids, seg, pos, row_of_seq, offset_of_seq
 
 
 def bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,

@@ -27,14 +27,26 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
 
 
 def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
-                    is_causal=False, scale=None, training=True):
+                    is_causal=False, scale=None, training=True,
+                    segment_ids=None):
     """XLA-composed attention. q: [B, S, H, D]; k/v may carry fewer (GQA)
     heads ([B, S, H_kv, D], H % H_kv == 0) — repeated on the fly.
+
+    segment_ids: int32 [B, S] packed-sequence ids — position i attends to j
+    only when segment_ids[b, i] == segment_ids[b, j] (the packed/varlen
+    layout; on TPU this rides the Pallas kernel's in-kernel segment masking
+    instead of an O(S^2) mask array).
 
     Contract shared with the Pallas fast path: attn_mask is a *constant*
     (no gradient flows into it — the reference's flash kernels likewise
     never produce a mask gradient), and fully-masked query rows produce
     zeros, not a uniform average."""
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids)
+        seg_mask = (seg[:, :, None] == seg[:, None, :])[:, None]  # [B,1,S,S]
+        attn_mask = (seg_mask if attn_mask is None
+                     else seg_mask & attn_mask if attn_mask.dtype == jnp.bool_
+                     else jnp.where(seg_mask, attn_mask, -1e30))
     if key.ndim == 4 and key.shape[2] != query.shape[2]:
         g = query.shape[2] // key.shape[2]
         key = jnp.repeat(key, g, axis=2)
@@ -76,14 +88,18 @@ def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
              dispatch=True)
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
-                                 name=None):
+                                 name=None, segment_ids=None):
     """attn_mask is a constant (bool keep-mask or additive float): no
     gradient flows into it on either the Pallas fast path or the composed
     fallback — matching the reference flash kernels, which never emit a
-    mask gradient. Compose attention manually for a *learned* bias."""
+    mask gradient. Compose attention manually for a *learned* bias.
+
+    segment_ids: optional int32 [B, S] for packed (varlen) batches — the
+    zero-padding-free path the reference serves via flash_attn varlen
+    (flash_attn_kernel.cu cu_seqlens)."""
     del name
     return _sdpa_reference(query, key, value, attn_mask, dropout_p, is_causal,
-                           training=training)
+                           training=training, segment_ids=segment_ids)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -143,30 +159,49 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 @register_op("flashmask_attention", tags=["attention", "fusion"],
              dispatch=True)
 def flashmask_attention(query, key, value, startend_row_indices=None,
-                        dropout=0.0, causal=True, window_size=None):
+                        dropout=0.0, causal=False, window_size=None):
     """Sparse-mask attention (reference: flash_attention.py:1098).
 
-    startend_row_indices: [B, H_mask, S, 1] (causal LT mask) or richer forms;
-    row r of the mask column j means keys j are masked for queries >= r.
-    Composed as an additive mask over the reference kernel; on TPU the
-    registry routes the O(S) row-indices straight into the Pallas kernel
+    startend_row_indices: [B, H_mask, S_k, C] per-key-column row indices:
+      C=1 causal:  LT rows >= r1 masked;
+      C=2 causal:  LT rows in [r1, r2) masked;
+      C=2 full:    LT rows >= r1 masked, UT rows < r2 masked;
+      C=4 full:    LT rows in [r1, r2) and UT rows in [r3, r4) masked
+    where LT/UT are the strict lower/upper triangles (reference doc,
+    flash_attention.py:1325-1332). Composed as an additive mask; on TPU the
+    registry routes the causal C=1/2 forms straight into the Pallas kernel
     (no dense mask is ever built) — see _flashmask_pallas."""
     B, S = query.shape[0], query.shape[1]
     Sk = key.shape[1]
     mask = None
     if startend_row_indices is not None:
         idx = startend_row_indices
-        rows = jnp.arange(S)[None, None, :, None]  # query positions
-        if idx.shape[-1] == 1:
-            # causal LT: key j masked for queries >= idx[..., j, 0]
-            start = jnp.swapaxes(idx, -2, -1)  # [B, H, 1, Sk]
-            mask = rows < start  # allowed where query_row < start
-        elif idx.shape[-1] == 2:
-            start = idx[..., 0][:, :, None, :]
-            end = idx[..., 1][:, :, None, :]
-            mask = (rows < start) | (rows >= end)
+        rows = jnp.arange(S)[None, None, :, None]   # query positions
+        cols = jnp.arange(Sk)[None, None, None, :]  # key positions
+        lt = rows > cols   # strict lower triangle
+        ut = rows < cols   # strict upper triangle
+
+        def col(c):
+            return idx[..., c][:, :, None, :]  # [B, H, 1, Sk]
+
+        C = idx.shape[-1]
+        # causal forms keep the kernel's per-column band contract
+        # (start <= q < end, un-scoped — the causal tril already owns the
+        # upper triangle); bidirectional forms scope each band to its
+        # triangle per the reference doc (flash_attention.py:1325-1332)
+        if C == 1:
+            banned = (rows >= col(0)) if causal else lt & (rows >= col(0))
+        elif C == 2 and causal:
+            banned = (rows >= col(0)) & (rows < col(1))
+        elif C == 2:
+            banned = (lt & (rows >= col(0))) | (ut & (rows < col(1)))
+        elif C == 4:
+            banned = ((lt & (rows >= col(0)) & (rows < col(1)))
+                      | (ut & (rows >= col(2)) & (rows < col(3))))
         else:
-            raise NotImplementedError("4-column flashmask not yet supported")
+            raise ValueError(
+                f"startend_row_indices last dim must be 1, 2 or 4, got {C}")
+        mask = ~banned
     if causal:
         cm = jnp.tril(jnp.ones((S, Sk), bool), Sk - S)[None, None]
         mask = cm if mask is None else (mask & cm)

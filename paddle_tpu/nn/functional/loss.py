@@ -37,10 +37,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     surface)."""
     del name
     logits = input.astype(jnp.float32)
-    if use_softmax:
-        logp = jax.nn.log_softmax(logits, axis=axis)
-    else:
-        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    logp = None  # soft/prob paths only: [. , V]-sized, materialized lazily
 
     n_classes = input.shape[axis]
     label_arr = jnp.asarray(label)
@@ -50,6 +47,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                   and label_arr.ndim == input.ndim
                   and label_arr.shape[axis] == n_classes)
     if soft_label or looks_soft:
+        logp = (jax.nn.log_softmax(logits, axis=axis) if use_softmax
+                else jnp.log(jnp.clip(logits, 1e-15, 1.0)))
         soft = jnp.asarray(label, dtype=jnp.float32)
         if label_smoothing > 0.0:
             soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
@@ -68,11 +67,25 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         label = label.astype(jnp.int32)
     valid = label != ignore_index
     safe_label = jnp.where(valid, label, 0)
-    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_label, axis), axis=axis)
-    loss = -jnp.squeeze(picked, axis=axis)
-    if label_smoothing > 0.0:
-        smooth_loss = -jnp.mean(logp, axis=axis)
-        loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+    idx = jnp.expand_dims(safe_label, axis)
+    if use_softmax:
+        # log-sum-exp + gather form: never materializes the [., V]
+        # log_softmax tensor (at LLM vocab sizes that intermediate is the
+        # single largest HBM write of the loss)
+        lse = jax.nn.logsumexp(logits, axis=axis)
+        picked = jnp.squeeze(jnp.take_along_axis(logits, idx, axis=axis),
+                             axis=axis)
+        loss = lse - picked
+        if label_smoothing > 0.0:
+            smooth_loss = lse - jnp.mean(logits, axis=axis)
+            loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        picked = jnp.take_along_axis(logp, idx, axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            smooth_loss = -jnp.mean(logp, axis=axis)
+            loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
     w_per = jnp.ones_like(loss)
     if weight is not None:
         w_per = jnp.take(jnp.asarray(weight, jnp.float32), safe_label)

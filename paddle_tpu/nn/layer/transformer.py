@@ -80,7 +80,8 @@ class MultiHeadAttention(Layer):
             return _Cache(empty, empty)
         return _Cache(key, value)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
+                segment_ids=None):
         key = query if key is None else key
         value = key if value is None else value
         q = self._shape(self.q_proj(query))
@@ -95,7 +96,8 @@ class MultiHeadAttention(Layer):
         mask = _convert_attn_mask(attn_mask, q.dtype)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=mask, dropout_p=self.dropout,
-            is_causal=False, training=self.training)
+            is_causal=False, training=self.training,
+            segment_ids=segment_ids)
         B, S = out.shape[0], out.shape[1]
         out = self.out_proj(out.reshape(B, S, self.embed_dim))
         if cache is not None:
@@ -124,12 +126,13 @@ class TransformerEncoderLayer(Layer):
         self.dropout2 = Dropout(dropout)
         self.activation = getattr(F, activation)
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, segment_ids=None):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
         if cache is None:
-            src = self.self_attn(src, src, src, src_mask)
+            src = self.self_attn(src, src, src, src_mask,
+                                 segment_ids=segment_ids)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
         src = residual + self.dropout1(src)
@@ -157,12 +160,12 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, segment_ids=None):
         output = src
         new_caches = []
         for i, mod in enumerate(self.layers):
             if cache is None:
-                output = mod(output, src_mask)
+                output = mod(output, src_mask, segment_ids=segment_ids)
             else:
                 output, c = mod(output, src_mask, cache[i])
                 new_caches.append(c)

@@ -202,3 +202,46 @@ def test_fused_multi_transformer_dropout_active_in_train():
     m.train()
     c, d = np.asarray(m(x)), np.asarray(m(x))
     assert not np.array_equal(c, d)  # train: dropout noise present
+
+
+def test_bert_packed_matches_unpacked():
+    """Two sequences packed into one row (in-kernel segment masking +
+    restarting position ids) must produce the same per-token encodings as
+    running each sequence in its own row."""
+    cfg = B.BertConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                       num_heads=4, intermediate_size=64,
+                       max_position_embeddings=16, hidden_dropout=0.0)
+    model = B.BertModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    seq_a = rng.randint(0, 97, (9,))
+    seq_b = rng.randint(0, 97, (5,))
+
+    ids, seg, pos, row_of, off_of = B.pack_sequences([seq_a, seq_b], 16)
+    assert ids.shape == (1, 16) and row_of == [0, 0] and off_of == [0, 9]
+    packed, _ = model(jnp.asarray(ids), pack_segment_ids=jnp.asarray(seg),
+                      position_ids=jnp.asarray(pos))
+
+    for s, off in ((seq_a, 0), (seq_b, 9)):
+        L = len(s)
+        solo_ids = np.zeros((1, 16), np.int32)
+        solo_ids[0, :L] = s
+        mask = np.zeros((1, 16), np.int64)
+        mask[0, :L] = 1
+        solo, _ = model(jnp.asarray(solo_ids),
+                        attention_mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(packed[0, off:off + L]),
+                                   np.asarray(solo[0, :L]),
+                                   atol=2e-5, rtol=1e-5)
+
+
+def test_pack_sequences_first_fit():
+    ids, seg, pos, row_of, off_of = B.pack_sequences(
+        [np.arange(1, 7), np.arange(1, 6), np.arange(1, 4)], 8, pad_id=0)
+    # 6+5 > 8 -> seq1 opens row 1; seq2 (len 3) fits after seq1 (5+3=8)
+    assert ids.shape == (2, 8)
+    assert row_of == [0, 1, 1] and off_of == [0, 0, 5]
+    assert list(seg[0][:6]) == [0] * 6 and list(seg[0][6:]) == [-1] * 2
+    assert list(pos[0][:6]) == [0, 1, 2, 3, 4, 5]
+    assert list(seg[1]) == [0] * 5 + [1] * 3
+    assert list(pos[1]) == [0, 1, 2, 3, 4, 0, 1, 2]
