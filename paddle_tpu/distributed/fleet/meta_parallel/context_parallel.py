@@ -66,18 +66,32 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
     the shape: D <= 256, and S_local % 128 == 0 on real TPU).
     """
     B, S, H, D = q.shape
+    H_kv = k.shape[2]
     if impl == "auto":
         lanes_ok = S % 128 == 0 or jax.default_backend() == "cpu"
-        impl = "tiled" if (D <= 256 and lanes_ok
-                           and k.shape[2] == H) else "einsum"
-    if impl == "tiled":
-        if k.shape[2] != H:
+        tiled_ok = D <= 256 and lanes_ok and H % max(H_kv, 1) == 0
+        if not tiled_ok and H_kv != H:
             raise ValueError(
-                f"tiled ring attention needs matching head counts (got q "
-                f"{H}, kv {k.shape[2]}); repeat KV heads upstream or use "
-                f"impl='einsum'")
+                f"GQA ring attention needs the tiled kernel but the shape "
+                f"can't take it (D={D} <= 256? S_local={S} % 128 == 0? "
+                f"H={H} % H_kv={H_kv} == 0?). Pad S_local to a 128 "
+                f"multiple, or repeat KV heads upstream and use "
+                f"impl='einsum'.")
+        impl = "tiled" if tiled_ok else "einsum"
+    if impl == "tiled":
+        if H % max(H_kv, 1) != 0:
+            raise ValueError(
+                f"ring attention GQA needs q heads divisible by kv heads "
+                f"(got q {H}, kv {H_kv})")
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
         return _ring_tiled(q, k, v, axis, bool(causal), float(scale))
+    if H_kv != H:
+        # einsum tier materializes [B,H,S,S] scores anyway; GQA rides the
+        # tiled tier — requiring an upstream repeat here would silently
+        # reintroduce the memory the ring exists to avoid
+        raise ValueError(
+            "einsum ring attention does not support GQA (q heads "
+            f"{H} != kv heads {H_kv}); use impl='tiled'")
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
@@ -144,16 +158,37 @@ def _tile_modes(rank, t, n):
     return jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
 
 
-def _tile_fwd(q3, k3, v3, causal, scale, h, vma):
+def _expand_kv(x3, h, h_kv):
+    """[B*H_kv, S, D] -> [B*H, S, D] by repeating each kv head over its
+    query group (composed fallback only — the kernel indexes instead)."""
+    b = x3.shape[0] // h_kv
+    g = h // h_kv
+    return jnp.repeat(x3.reshape(b, h_kv, *x3.shape[1:]), g,
+                      axis=1).reshape(b * h, *x3.shape[1:])
+
+
+def _reduce_kv_grad(g3, h, h_kv):
+    """[B*H, S, D] per-query-head kv grads -> [B*H_kv, S, D] group sums."""
+    b = g3.shape[0] // h
+    g = h // h_kv
+    return g3.reshape(b, h_kv, g, *g3.shape[1:]).sum(axis=2).reshape(
+        b * h_kv, *g3.shape[1:])
+
+
+def _tile_fwd(q3, k3, v3, causal, scale, h, h_kv, vma):
     """One (q-shard × kv-block) tile: (o f32, lse f32). Pallas flash kernel
-    compiled; a composed per-tile reference on CPU (pallas interpret mode
-    can't run under shard_map's varying-axis checking)."""
+    compiled (GQA native via kv index maps); a composed per-tile reference
+    on CPU (pallas interpret mode can't run under shard_map's varying-axis
+    checking)."""
     from ....kernels.pallas import flash_attention as _fa
     if not _fa._interpret():
         blk = _fa._pick_block(q3.shape[1])
-        o, lse = _fa._fwd(q3, k3, v3, scale, causal, blk, blk, h=h, h_kv=h,
-                          save_lse=True, vma=vma)
+        o, lse = _fa._fwd(q3, k3, v3, scale, causal, blk, blk, h=h,
+                          h_kv=h_kv, save_lse=True, vma=vma)
         return o.astype(jnp.float32), lse
+    if h_kv != h:
+        k3 = _expand_kv(k3, h, h_kv)
+        v3 = _expand_kv(v3, h, h_kv)
     s = jnp.einsum("bqd,bkd->bqk", q3, k3,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -171,14 +206,18 @@ def _tile_fwd(q3, k3, v3, causal, scale, h, vma):
     return o, lse
 
 
-def _tile_bwd(q3, k3, v3, out3, lse, do3, causal, scale, h, vma):
+def _tile_bwd(q3, k3, v3, out3, lse, do3, causal, scale, h, h_kv, vma):
     """Per-tile (dq, dk, dv) with the GLOBAL lse (p = exp(s - lse_global)
     is the globally-normalized tile probability)."""
     from ....kernels.pallas import flash_attention as _fa
     if not _fa._interpret():
         blk = _fa._pick_block(q3.shape[1])
         return _fa._bwd_impl(q3, k3, v3, out3, lse, do3, scale, causal,
-                             blk, blk, h=h, h_kv=h, vma=vma)
+                             blk, blk, h=h, h_kv=h_kv, vma=vma)
+    kv_shape = k3.shape
+    if h_kv != h:
+        k3 = _expand_kv(k3, h, h_kv)
+        v3 = _expand_kv(v3, h, h_kv)
     s = jnp.einsum("bqd,bkd->bqk", q3, k3,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -194,20 +233,24 @@ def _tile_bwd(q3, k3, v3, out3, lse, do3, causal, scale, h, vma):
     ds = p * (dp - delta[..., None]) * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, k3.astype(jnp.float32))
     dk = jnp.einsum("bqk,bqd->bkd", ds, q3.astype(jnp.float32))
+    if h_kv != h:
+        dk = _reduce_kv_grad(dk, h, h_kv)
+        dv = _reduce_kv_grad(dv, h, h_kv)
+        assert dk.shape == kv_shape, (dk.shape, kv_shape)
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
-def _ring_fwd_step(q3, k3, v3, mode, scale, h, axis):
+def _ring_fwd_step(q3, k3, v3, mode, scale, h, h_kv, axis):
     """One visiting block, switched on the block's causal mode."""
     bh, s, d = q3.shape
     vma = (axis,)
 
     def full(args):
-        o, lse = _tile_fwd(*args, False, scale, h, vma)
+        o, lse = _tile_fwd(*args, False, scale, h, h_kv, vma)
         return o, lse
 
     def diag(args):
-        o, lse = _tile_fwd(*args, True, scale, h, vma)
+        o, lse = _tile_fwd(*args, True, scale, h, h_kv, vma)
         return o, lse
 
     def skip(args):
@@ -220,15 +263,15 @@ def _ring_fwd_step(q3, k3, v3, mode, scale, h, axis):
     return lax.switch(mode, [full, diag, skip], (q3, k3, v3))
 
 
-def _ring_bwd_step(q3, k3, v3, out3, lse, do3, mode, scale, h, axis):
+def _ring_bwd_step(q3, k3, v3, out3, lse, do3, mode, scale, h, h_kv, axis):
     """One visiting block of the reverse ring."""
     vma = (axis,)
 
     def full(args):
-        return _tile_bwd(*args, False, scale, h, vma)
+        return _tile_bwd(*args, False, scale, h, h_kv, vma)
 
     def diag(args):
-        return _tile_bwd(*args, True, scale, h, vma)
+        return _tile_bwd(*args, True, scale, h, h_kv, vma)
 
     def skip(args):
         q3, k3, v3, _, _, _ = args
@@ -261,13 +304,14 @@ def _ring_tiled_fwd(q, k, v, axis, causal, scale):
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     B, S, H, D = q.shape
+    H_kv = k.shape[2]
     q3, k3, v3 = _prep(q), _prep(k), _prep(v)
 
     def body(carry, t):
         k_blk, v_blk, acc, lse = carry
         mode = _tile_modes(rank, t, n) if causal else None
         o_b, lse_b = _ring_fwd_step(q3, k_blk, v_blk, mode, scale,
-                                    H, axis)
+                                    H, H_kv, axis)
         acc, lse = _merge_lse(acc, lse, o_b, lse_b)
         k_blk = lax.ppermute(k_blk, axis, _ring_perm(axis))
         v_blk = lax.ppermute(v_blk, axis, _ring_perm(axis))
@@ -278,12 +322,12 @@ def _ring_tiled_fwd(q, k, v, axis, causal, scale):
     (_, _, acc, lse), _ = lax.scan(body, (k3, v3, acc0, lse0),
                                    jnp.arange(n))
     out3 = acc.astype(q.dtype)
-    return _unprep(out3, B, H), (q3, k3, v3, out3, lse, B, H)
+    return _unprep(out3, B, H), (q3, k3, v3, out3, lse, B, H, H_kv)
 
 
 def _ring_tiled_bwd(axis, causal, scale, res, g):
     from ....kernels.pallas.flash_attention import _prep, _unprep
-    q3, k3, v3, out3, lse, B, H = res
+    q3, k3, v3, out3, lse, B, H, H_kv = res
     do3 = _prep(g)
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
@@ -292,7 +336,7 @@ def _ring_tiled_bwd(axis, causal, scale, res, g):
         k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
         mode = _tile_modes(rank, t, n) if causal else None
         dq_c, dk_c, dv_c = _ring_bwd_step(q3, k_blk, v_blk, out3, lse, do3,
-                                          mode, scale, H, axis)
+                                          mode, scale, H, H_kv, axis)
         dq_acc = dq_acc + dq_c.astype(jnp.float32)
         dk_blk = dk_blk + dk_c.astype(jnp.float32)
         dv_blk = dv_blk + dv_c.astype(jnp.float32)
@@ -310,8 +354,8 @@ def _ring_tiled_bwd(axis, causal, scale, res, g):
     (_, _, dk3, dv3, dq3), _ = lax.scan(
         body, (k3, v3, z, z, dq0), jnp.arange(n))
     return (_unprep(dq3.astype(q3.dtype), B, H),
-            _unprep(dk3.astype(k3.dtype), B, H),
-            _unprep(dv3.astype(v3.dtype), B, H))
+            _unprep(dk3.astype(k3.dtype), B, H_kv),
+            _unprep(dv3.astype(v3.dtype), B, H_kv))
 
 
 _ring_tiled.defvjp(_ring_tiled_fwd, _ring_tiled_bwd)

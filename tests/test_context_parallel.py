@@ -200,3 +200,58 @@ def test_ring_attention_long_context_4k(causal):
     gg = jax.grad(lambda v: jnp.sum(full_attention(q, k, v, causal) ** 2))(v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gg),
                                rtol=1e-3, atol=1e-3)
+
+
+def full_attention_gqa(q, k, v, causal):
+    """Golden with fewer kv heads: repeat kv over query groups."""
+    H, H_kv = q.shape[2], k.shape[2]
+    k = jnp.repeat(k, H // H_kv, axis=2)
+    v = jnp.repeat(v, H // H_kv, axis=2)
+    return full_attention(q, k, v, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gqa_grad_parity(causal):
+    """GQA tiled ring (kv heads indexed per group, VERDICT r2 #5): forward
+    and gradient parity vs the repeated-kv dense golden."""
+    mesh = sep_mesh(4)
+    rng = np.random.RandomState(7)
+    B, S, H, H_kv, D = 2, 32, 4, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, S, H_kv, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, S, H_kv, D).astype(np.float32)) * 0.5
+
+    spec = P(None, "sep")
+    ring = shard_map(
+        functools.partial(ring_attention, axis="sep", causal=causal,
+                          impl="tiled"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = jax.jit(ring)(q, k, v)
+    ref = full_attention_gqa(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jax.jit(ring)(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention_gqa(q, k, v, causal) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_ring_attention_einsum_rejects_gqa():
+    mesh = sep_mesh(4)
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 32, 2, 8).astype(np.float32))
+    spec = P(None, "sep")
+    f = shard_map(
+        functools.partial(ring_attention, axis="sep", impl="einsum"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    with pytest.raises(ValueError, match="GQA"):
+        jax.jit(f)(q, k, k)
