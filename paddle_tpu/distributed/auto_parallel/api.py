@@ -174,10 +174,11 @@ class _ShardedOptimizer:
     GSPMD does the partitioning from the sharding annotations alone).
     """
 
-    def __init__(self, optimizer, shard_cfg, mesh):
+    def __init__(self, optimizer, shard_cfg, mesh, offload=False):
         self._inner = optimizer
         self._cfg = shard_cfg
         self._mesh = to_jax_mesh(mesh) if mesh is not None else None
+        self._offload = offload
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -194,11 +195,21 @@ class _ShardedOptimizer:
             return names[0]
         return names[dim]
 
-    def _shard_leaf(self, leaf):
-        """Shard a state leaf along its largest dim divisible by the axis."""
+    def _state_sharding(self, leaf, memory_kind=None):
         from ..sharding.group_sharded import shard_spec_for
         spec = shard_spec_for(leaf, self._mesh, self._shard_axis_name())
-        return jax.device_put(leaf, NamedSharding(self._mesh, spec))
+        kw = {"memory_kind": memory_kind} if memory_kind else {}
+        return NamedSharding(self._mesh, spec, **kw)
+
+    def _shard_leaf(self, leaf):
+        """Shard a state leaf along its largest dim divisible by the axis;
+        offload mode parks it in host memory (the reference's stage-3
+        offload=True, group_sharded_stage3.py:85). Scalars stay on device
+        (nothing to save; XLA rejects host placement of unsharded
+        side-effect HLOs)."""
+        kind = ("pinned_host"
+                if self._offload and getattr(leaf, "ndim", 0) >= 1 else None)
+        return jax.device_put(leaf, self._state_sharding(leaf, kind))
 
     def init_state(self, params):
         state = self._inner.init_state(params)
@@ -206,6 +217,18 @@ class _ShardedOptimizer:
         return state
 
     def apply(self, params, grads, state, lr=None):
+        if self._offload:
+            # stream moments to HBM for the update, park the new ones back
+            # (memory_kind must be explicit: a kind-less sharding keeps the
+            # buffer wherever it already lives)
+            state = dict(state)
+            state["slots"] = jax.tree.map(
+                lambda s: jax.device_put(
+                    s, self._state_sharding(s, "device")),
+                state["slots"])
+            params, state = self._inner.apply(params, grads, state, lr)
+            state["slots"] = jax.tree.map(self._shard_leaf, state["slots"])
+            return params, state
         return self._inner.apply(params, grads, state, lr)
 
     def step(self):
@@ -215,18 +238,21 @@ class _ShardedOptimizer:
         return self._inner.clear_grad()
 
 
-def shard_optimizer(optimizer, shard_fn=None, mesh=None):
+def shard_optimizer(optimizer, shard_fn=None, mesh=None, offload=False):
     """(reference: api.py:1448). With a ShardingStage* shard_fn, optimizer
-    states are annotated sharded; stage 3 additionally shards parameters."""
+    states are annotated sharded; stage 3 additionally shards parameters.
+    offload=True parks the state in host memory between steps."""
     if shard_fn is None:
         shard_fn = ShardingStage1(mesh)
     use_mesh = mesh if mesh is not None else getattr(shard_fn, "_mesh", None)
     assert use_mesh is not None, "shard_optimizer needs a mesh"
-    wrapped = _ShardedOptimizer(optimizer, shard_fn, use_mesh)
+    wrapped = _ShardedOptimizer(optimizer, shard_fn, use_mesh,
+                                offload=offload)
     if getattr(shard_fn, "stage", 1) >= 3 and optimizer._parameter_list:
-        axis = wrapped._shard_axis_name()
         for p in optimizer._parameter_list:
             if p.trainable:
-                leaf = wrapped._shard_leaf(p.value)
-                p.value = leaf
+                # params stay in device memory — only the optimizer state
+                # is parked on the host in offload mode
+                p.value = jax.device_put(
+                    p.value, wrapped._state_sharding(p.value))
     return wrapped

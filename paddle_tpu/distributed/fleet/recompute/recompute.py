@@ -21,16 +21,25 @@ __all__ = ["recompute", "recompute_sequential"]
 
 def recompute(function: Callable, *args, preserve_rng_state: bool = True,
               use_reentrant: bool = True, policy=None, prevent_cse: bool = True,
-              **kwargs):
+              offload: bool = False, **kwargs):
     """Run `function(*args)` with rematerialization in the backward.
 
     Matches the reference call form recompute(fn, *args). The checkpointing
     applies to this call's trace, so use inside a jitted/grad-traced region.
     `policy` may be a jax.checkpoint_policies policy for selective remat
-    (e.g. dots_saveable to keep matmul outputs — the knob the reference
-    exposes as sr/offload variants).
+    (e.g. dots_saveable to keep matmul outputs).
+
+    offload=True saves matmul activations to HOST memory instead of either
+    rematerializing or keeping them in HBM (the reference's
+    recompute_hybrid.py offload variant): XLA streams them back during the
+    backward. Trades PCIe bandwidth for both HBM capacity and recompute
+    FLOPs.
     """
     del preserve_rng_state, use_reentrant
+    if offload:
+        assert policy is None, "pass either policy= or offload=True"
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     fn = jax.checkpoint(function, policy=policy, prevent_cse=prevent_cse)
     return fn(*args, **kwargs)
 

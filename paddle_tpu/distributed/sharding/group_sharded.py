@@ -42,6 +42,26 @@ LEVELS = ("os", "os_g", "p_g_os")
 _STAGE_OF = {"os": 1, "os_g": 2, "p_g_os": 3}
 
 
+def _leaf_streamable(optimizer) -> bool:
+    """True when the offload path may re-implement the optimizer's update
+    as a per-leaf _update loop (the base Optimizer.apply semantics: step+1,
+    per-leaf rng fold_in, no per-parameter-name context). Optimizers whose
+    apply() threads names (AdamW apply_decay_param_fun, Lars
+    exclude_from_weight_decay) or restructures state (GradientMerge) must
+    run their own apply."""
+    from ...optimizer.optimizer import AdamW, Optimizer
+
+    if not hasattr(optimizer, "_init_slot"):
+        return False
+    cls_apply = type(optimizer).apply
+    if cls_apply is Optimizer.apply:
+        return True
+    if (isinstance(optimizer, AdamW) and cls_apply is AdamW.apply
+            and getattr(optimizer, "_apply_decay_param_fun", None) is None):
+        return True  # AdamW.apply falls through to the base loop
+    return False
+
+
 def shard_spec_for(leaf, mesh: Mesh, axis: str) -> P:
     """Spec sharding `leaf` along its largest dim divisible by the axis
     size; replicated if none is."""
@@ -75,6 +95,7 @@ def build_sharded_train_step(
     loss_fn: Callable, optimizer, mesh: Mesh, level: str = "p_g_os",
     data_axes: Union[str, Sequence[str]] = ("dp", "sharding"),
     shard_axis: str = "sharding", donate: bool = True,
+    offload: bool = False,
 ):
     """Compile a ZeRO train step. `loss_fn(params, *batch) -> scalar` is
     written for GLOBAL arrays (GSPMD style — no collectives by hand; XLA
@@ -92,6 +113,13 @@ def build_sharded_train_step(
     sharding-as-extra-dp semantics: sharding ranks consume distinct data,
     dygraph_sharding_optimizer.py reduce-to-owner over the fused dp-sharding
     group).
+
+    offload=True keeps the (sharded) optimizer state resident in HOST
+    memory (`pinned_host` memory kind — the reference's stage-3 offload,
+    group_sharded_stage3.py:85): each step streams the moments HBM-ward
+    for the update and the new moments back out, freeing two
+    moment-buffers of HBM. On one 16GB v5e this is what lets a >2.7B bf16
+    config train (params + grads + activations only in HBM).
     """
     assert level in LEVELS, f"level must be one of {LEVELS}"
     stage = _STAGE_OF[level]
@@ -105,16 +133,57 @@ def build_sharded_train_step(
     def _named(spec):
         return NamedSharding(mesh, spec)
 
+    def _offloadable(leaf):
+        # scalars (step counters) stay in HBM: offloading them saves
+        # nothing, and XLA's SPMD partitioner rejects host-placement
+        # annotations on unsharded scalar HLOs
+        return offload and getattr(leaf, "ndim", 0) >= 1
+
+    def _state_sharding(leaf, kind="pinned_host"):
+        spec = shard_spec_for(leaf, mesh, shard_axis)
+        if _offloadable(leaf):
+            return NamedSharding(mesh, spec, memory_kind=kind)
+        return NamedSharding(mesh, spec)
+
+    def _park_state(state):
+        """Move the sizable state leaves to pinned_host (post-step / after
+        init). Runs eagerly — per-buffer DMA, no SPMD annotation issues."""
+        return jax.tree.map(
+            lambda s: jax.device_put(s, _state_sharding(s)), state)
+
     def place(params):
         p_specs = param_specs(params, mesh, shard_axis, stage)
         params = jax.tree.map(
             lambda v, s: jax.device_put(jnp.asarray(v), _named(s)),
             params, p_specs)
+        if offload and hasattr(optimizer, "_init_slot"):
+            # initialize slots PER LEAF, parking each on the host before
+            # the next materializes — a whole-tree init would hold every
+            # moment in HBM at once, the exact spike offload exists to
+            # avoid (bigger-than-HBM configs OOM right here otherwise)
+            def one_slot(p):
+                slot_shape = jax.eval_shape(optimizer._init_slot, p)
+                dev_sh = jax.tree.map(
+                    lambda l: _named(shard_spec_for(l, mesh, shard_axis)),
+                    slot_shape)
+                slot = jax.jit(optimizer._init_slot,
+                               out_shardings=dev_sh)(p)
+                return _park_state(slot)  # eager per-buffer DMA to host
+
+            state = {"step": jnp.zeros((), jnp.int32),
+                     "slots": jax.tree.map(one_slot, params)}
+            expect = jax.eval_shape(optimizer.init_state, params)
+            got = jax.eval_shape(lambda s: s, state)
+            if jax.tree.structure(expect) == jax.tree.structure(got):
+                return params, state
+            # optimizer with a custom state layout: whole-tree fallback
+            # (documented HBM spike)
         s_specs = _state_specs(optimizer, params, mesh, shard_axis)
         init = jax.jit(
             optimizer.init_state,
             out_shardings=jax.tree.map(_named, s_specs))
-        return params, init(params)
+        state = init(params)
+        return params, (_park_state(state) if offload else state)
 
     def step(params, opt_state, *batch_and_lr):
         *batch, lr = batch_and_lr
@@ -143,7 +212,106 @@ def build_sharded_train_step(
         )
         if donate:
             kwargs["donate_argnums"] = (0, 1)
-        return jax.jit(step, **kwargs), batch_spec
+        if not offload:
+            return jax.jit(step, **kwargs), batch_spec
+
+        # offload: two programs. (1) fwd/bwd (+clip) all-HBM; (2) the
+        # optimizer update streamed PER LEAF — fetch that leaf's moments
+        # host->HBM, update, park the new moments back. Peak HBM is params
+        # + grads + ONE leaf's moments, never the whole state (the
+        # reference's stage-3 offload memory profile,
+        # group_sharded_stage3.py). Mixed-memory-kind jit boundaries are
+        # avoided entirely (XLA's SPMD partitioner rejects the scalar
+        # annotations they produce).
+        def grad_fn(params, *batch_and_lr):
+            *batch, _lr = batch_and_lr
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            if optimizer._grad_clip is not None:
+                grads = optimizer._grad_clip(grads)
+            gspecs = jax.tree.map(
+                lambda g: shard_spec_for(g, mesh, shard_axis)
+                if stage >= 2 else P(), grads)
+            grads = jax.lax.with_sharding_constraint(
+                grads, jax.tree.map(_named, gspecs))
+            return loss, grads
+
+        jgrad = jax.jit(grad_fn)
+
+        if not _leaf_streamable(optimizer):
+            # optimizer threads per-parameter context through apply()
+            # (AdamW apply_decay_param_fun, Lars exclude lists) or uses a
+            # custom state layout (GradientMerge): per-leaf streaming
+            # would silently skip that logic, so go through the
+            # optimizer's OWN apply — state still lives on the host
+            # between steps, but the whole moment tree transits HBM at
+            # once during the update (documented spike).
+            jfull = jax.jit(step, out_shardings=(
+                p_specs, jax.tree.map(_named, _state_specs(
+                    optimizer, params, mesh, shard_axis)), _named(P())),
+                **({"donate_argnums": (0, 1)} if donate else {}))
+
+            def offload_step_full(params, opt_state, *batch_and_lr):
+                opt_state = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, _state_sharding(x, kind="device"))
+                    if _offloadable(x) else x, opt_state)
+                params, new_state, loss = jfull(params, opt_state,
+                                                *batch_and_lr)
+                return params, _park_state(new_state), loss
+
+            return offload_step_full, batch_spec
+
+        needs_rng = getattr(optimizer, "_needs_update_rng", False)
+        dn = {"donate_argnums": (0, 1, 2)} if donate else {}
+        if needs_rng:
+            upd = jax.jit(
+                lambda p, g, s, lr, step, rng: optimizer._update(
+                    p, g, s, lr, step, rng=rng), **dn)
+        else:
+            upd = jax.jit(
+                lambda p, g, s, lr, step: optimizer._update(p, g, s, lr,
+                                                            step), **dn)
+
+        def offload_step(params, opt_state, *batch_and_lr):
+            lr = batch_and_lr[-1]
+            loss, grads = jgrad(params, *batch_and_lr)
+            # park grads too (the reference offloads the g in "g_os"):
+            # without this the loop's peak is params + ALL grads + the
+            # largest leaf's moments — over a 16 GB v5e for a 2.7B model.
+            # With it HBM holds params + ONE leaf's (g, m1, m2) at a time.
+            grads = jax.tree.map(
+                lambda g: jax.device_put(g, _state_sharding(g))
+                if _offloadable(g) else g, grads)
+            step_no = opt_state["step"] + 1
+            rng_base = (jax.random.key(step_no.astype(jnp.uint32),
+                                       impl="rbg") if needs_rng else None)
+            leaves_p, treedef = jax.tree.flatten(params)
+            leaves_g = treedef.flatten_up_to(grads)
+            leaves_s = treedef.flatten_up_to(opt_state["slots"])
+            new_p, new_s = [], []
+            for i, (p, g, s) in enumerate(zip(leaves_p, leaves_g, leaves_s)):
+                if g is None:
+                    new_p.append(p)
+                    new_s.append(s)
+                    continue
+                s_dev = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, _state_sharding(x, kind="device")), s)
+                if _offloadable(g):
+                    g = jax.device_put(g, _state_sharding(g, kind="device"))
+                if needs_rng:
+                    np_, ns_ = upd(p, g, s_dev, lr, step_no,
+                                   jax.random.fold_in(rng_base, i))
+                else:
+                    np_, ns_ = upd(p, g, s_dev, lr, step_no)
+                new_p.append(np_)
+                new_s.append(jax.tree.map(
+                    lambda x: jax.device_put(x, _state_sharding(x)), ns_))
+            params = jax.tree.unflatten(treedef, new_p)
+            slots = jax.tree.unflatten(treedef, new_s)
+            return params, {"step": step_no, "slots": slots}, loss
+
+        return offload_step, batch_spec
 
     return step, place, compile_for
 
@@ -159,10 +327,13 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
     """Wrap (model, optimizer, scaler) for ZeRO training (reference
     signature). On TPU this annotates rather than rewires: stage-3 shards
     the Parameter values in place; the optimizer is wrapped so init_state
-    produces sharded slots. offload is accepted for API parity (HBM↔host
-    offload is an XLA memory-space concern, not implemented here)."""
+    produces sharded slots.
+
+    offload=True parks the optimizer state in host memory (pinned_host)
+    between steps — the reference's stage-3 offload
+    (group_sharded_stage3.py:85); each apply() streams it through HBM."""
     assert level in LEVELS, f"level must be one of {LEVELS}"
-    del offload, sync_buffers, unused
+    del sync_buffers, unused
     from ..auto_parallel.api import (shard_optimizer, ShardingStage1,
                                      ShardingStage2, ShardingStage3)
     if mesh is None and group is not None:
@@ -179,7 +350,8 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
                           else "dp")
     stage_cls = {1: ShardingStage1, 2: ShardingStage2, 3: ShardingStage3}[
         _STAGE_OF[level]]
-    opt = shard_optimizer(optimizer, stage_cls(mesh, shard_axis), mesh)
+    opt = shard_optimizer(optimizer, stage_cls(mesh, shard_axis), mesh,
+                          offload=offload)
     return model, opt, scaler
 
 

@@ -1,0 +1,166 @@
+"""Host-offload tests (reference: group_sharded_stage3.py:85 offload=True,
+recompute_hybrid.py offload variant): optimizer state parked in pinned_host
+memory between steps, activation offload via checkpoint policy. Numeric
+parity is exact — offload only moves bytes, never changes math."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.sharding.group_sharded import (
+    build_sharded_train_step, group_sharded_parallel)
+
+
+def _mlp_job():
+    rng = np.random.RandomState(0)
+    params = {"w1": rng.randn(16, 32).astype(np.float32) * .1,
+              "w2": rng.randn(32, 16).astype(np.float32) * .1}
+    xs = rng.randn(16, 16).astype(np.float32)
+    ys = rng.randn(16, 16).astype(np.float32)
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    return params, xs, ys, loss_fn
+
+
+def _run(level, offload, steps=3):
+    mesh = dist.build_mesh({"sharding": 8})
+    params, xs, ys, loss_fn = _mlp_job()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    _, place, compile_for = build_sharded_train_step(
+        loss_fn, opt, mesh, level=level, data_axes="sharding",
+        offload=offload)
+    p, s = place(params)
+    jstep, bspec = compile_for(p)
+    xb, yb = jax.device_put(xs, bspec), jax.device_put(ys, bspec)
+    losses = []
+    for _ in range(steps):
+        p, s, l = jstep(p, s, xb, yb, jnp.float32(1e-2))
+        losses.append(float(l))
+    return losses, s
+
+
+def test_sharded_offload_state_lives_on_host():
+    _, state = _run("p_g_os", offload=True, steps=1)
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(state)
+             if hasattr(leaf, "sharding")}
+    assert "pinned_host" in kinds, kinds
+
+
+@pytest.mark.parametrize("level", ["os_g", "p_g_os"])
+def test_sharded_offload_loss_parity(level):
+    base, _ = _run(level, offload=False)
+    off, _ = _run(level, offload=True)
+    np.testing.assert_allclose(base, off, rtol=0, atol=1e-6)
+
+
+def test_group_sharded_parallel_offload_eager():
+    from paddle_tpu import nn
+    from paddle_tpu.nn import functional_call, functional_train_graph
+
+    mesh = dist.build_mesh({"dp": 8})
+    grp = dist.topology.Group(0, -1, list(range(8)), axis_name="dp",
+                              mesh=mesh)
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os", group=grp,
+                                           offload=True)
+    params, _, buffers = functional_train_graph(model)
+    state = opt.init_state(params)
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(state["slots"])}
+    assert kinds == {"pinned_host"}, kinds
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 4, (8,)))
+
+    def loss_fn(p):
+        out, _ = functional_call(model, p, buffers, x)
+        return paddle.nn.functional.cross_entropy(out, y)
+
+    losses = []
+    for _ in range(5):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.apply(params, g, state, 1e-2)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(state["slots"])}
+    assert kinds == {"pinned_host"}, kinds
+
+
+def test_recompute_offload_grad_parity():
+    from paddle_tpu.distributed.fleet.recompute import recompute
+
+    rng = np.random.RandomState(2)
+    w = jnp.asarray(rng.randn(32, 32).astype(np.float32) * .1)
+    x = jnp.asarray(rng.randn(8, 32).astype(np.float32))
+
+    def seg(w, x):
+        return jnp.tanh(x @ w) @ w
+
+    def loss_plain(w):
+        return jnp.sum(seg(w, x) ** 2)
+
+    def loss_off(w):
+        return jnp.sum(recompute(seg, w, x, offload=True) ** 2)
+
+    g_plain = jax.jit(jax.grad(loss_plain))(w)
+    g_off = jax.jit(jax.grad(loss_off))(w)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_off),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_offload_fallback_for_name_aware_optimizers():
+    """Optimizers whose apply() threads per-parameter context (Lars
+    exclude_from_weight_decay) must NOT be leaf-streamed — the fallback
+    path runs their own apply with identical results."""
+    from paddle_tpu.distributed.sharding.group_sharded import (
+        _leaf_streamable)
+
+    mesh = dist.build_mesh({"sharding": 8})
+    params, xs, ys, loss_fn = _mlp_job()
+
+    def run(offload):
+        opt = paddle.optimizer.Lars(learning_rate=1e-2, momentum=0.9,
+                                    lars_weight_decay=1e-3,
+                                    exclude_from_weight_decay=["w2"])
+        assert not _leaf_streamable(opt)
+        _, place, compile_for = build_sharded_train_step(
+            loss_fn, opt, mesh, level="os_g", data_axes="sharding",
+            offload=offload)
+        p, st = place(params)
+        jstep, bspec = compile_for(p)
+        xb, yb = jax.device_put(xs, bspec), jax.device_put(ys, bspec)
+        losses = []
+        for _ in range(3):
+            p, st, l = jstep(p, st, xb, yb, jnp.float32(1e-2))
+            losses.append(float(l))
+        return losses
+
+    np.testing.assert_allclose(run(False), run(True), rtol=0, atol=1e-6)
+
+
+def test_leaf_streamable_gate():
+    from paddle_tpu.distributed.sharding.group_sharded import (
+        _leaf_streamable)
+    from paddle_tpu.optimizer import GradientMergeOptimizer
+
+    assert _leaf_streamable(paddle.optimizer.AdamW(1e-3))
+    assert _leaf_streamable(paddle.optimizer.SGD(1e-3))
+    assert _leaf_streamable(paddle.optimizer.Momentum(1e-3))
+    assert not _leaf_streamable(
+        paddle.optimizer.AdamW(1e-3, apply_decay_param_fun=lambda n: True))
+    assert not _leaf_streamable(
+        paddle.optimizer.Lars(1e-3, exclude_from_weight_decay=["bn"]))
+    assert not _leaf_streamable(
+        GradientMergeOptimizer(paddle.optimizer.AdamW(1e-3), k_steps=2))
