@@ -270,3 +270,29 @@ class QuantizedLinear(Layer):
 
 
 __all__ += ["quantize_to_int8", "int8_matmul", "qlinear", "QuantizedLinear"]
+
+
+def convert_to_int8(model, skip=()):
+    """Replace every nn.Linear in `model` (in place, recursively) with a
+    W8A8 QuantizedLinear built from its trained weights — the deploy-time
+    int8 path the reference reaches through fused int8 kernels +
+    config.enable_tensorrt_engine(precision_mode=Int8). `skip`: substring
+    names to leave in fp (e.g. ("head",) for a sensitive output layer).
+    Returns the model."""
+    from ..nn.layer.common import Linear
+
+    def walk(layer, prefix=""):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if any(s in full for s in skip):
+                continue
+            if isinstance(sub, Linear):
+                layer._sub_layers[name] = QuantizedLinear.from_linear(sub)
+            else:
+                walk(sub, full)
+
+    walk(model)
+    return model
+
+
+__all__ += ["convert_to_int8"]

@@ -109,3 +109,28 @@ def test_config_bf16_and_profile_are_real():
     assert seen["dtype"] == jnp.bfloat16
     out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out.astype(np.float32), 2.0)
+
+
+def test_predictor_int8_path():
+    """Config.enable_int8 converts a live Layer's Linears to W8A8
+    QuantizedLinear (VERDICT r2 #4: wire W8A8 into the Predictor path)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.quantization import QuantizedLinear
+
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    model.eval()
+    x = np.random.RandomState(0).randn(3, 16).astype(np.float32)
+    ref = np.asarray(model(jnp.asarray(x)))
+
+    cfg = Config()
+    cfg.disable_gpu()
+    cfg.enable_int8()
+    pred = Predictor(cfg, fn=model, num_inputs=1)
+    subs = list(model._sub_layers.values())
+    assert any(isinstance(s, QuantizedLinear) for s in subs), subs
+    out = pred.run([x])[0]
+    # int8 quantization error is bounded, not zero
+    assert np.allclose(out, ref, atol=0.15, rtol=0.1), (out, ref)
