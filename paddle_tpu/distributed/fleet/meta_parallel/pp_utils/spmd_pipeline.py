@@ -278,9 +278,37 @@ def spmd_pipeline_zero_bubble(stage_fn: Callable, stage_params,
     Cost note: dgrad and wgrad each recompute the stage forward (the
     forward saves only each microbatch's input), so the split trades one
     extra forward per microbatch for the bubble — the same trade the
-    reference's ZB-H1 makes under recompute.
+    reference's ZB-H1 makes under recompute. Use `zbh1_speedup(pp, M)` for
+    the break-even estimate before choosing the schedule.
     """
     return _zb(stage_fn, axis, stage_params, x_microbatches)
+
+
+def zbh1_speedup(pp: int, num_microbatches: int,
+                 fwd_fraction: float = 1 / 3) -> float:
+    """Model-based ZB-H1 vs 1F1B step-time ratio (>1 = ZB-H1 wins).
+
+    Under full remat a 1F1B tick costs 1 fwd + 1 (fwd+bwd) unit and idles
+    (pp-1) ticks of bubble; ZB-H1 removes the backward bubble but re-runs
+    the stage forward once more per microbatch (dgrad and wgrad each replay
+    it). With f = fwd_fraction of a fused fwd+bwd unit (1/3 for the classic
+    1:2 fwd:bwd split):
+
+      t_1f1b  ~ (M + pp - 1) * (1 + f)           # fused units incl. bubble
+      t_zbh1  ~ (M + pp - 1) * f                 # forward scan unchanged
+               + (2M + pp - 1) * (1 + f) / 2     # half-unit backward ticks
+                                                 #  (each replays a fwd)
+
+    The crossover cannot be measured on this box (one chip; the CPU mesh
+    timing does not model ICI), so the dryrun asserts parity and THIS
+    estimate guides schedule choice: ZB-H1 pays off for small M/pp ratios
+    (deep pipelines, few microbatches) and loses once M >> pp.
+    """
+    M, P = num_microbatches, pp
+    f = fwd_fraction
+    t_1f1b = (M + P - 1) * (1 + f)
+    t_zb = (M + P - 1) * f + (2 * M + P - 1) * (1 + f) / 2
+    return t_1f1b / t_zb
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))

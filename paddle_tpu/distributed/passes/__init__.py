@@ -17,7 +17,7 @@ import dataclasses
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TrainSpec", "PassBase", "PassContext", "new_pass",
-           "apply_passes", "list_passes"]
+           "apply_passes", "list_passes", "build_train_step"]
 
 
 @dataclasses.dataclass
@@ -304,3 +304,32 @@ def apply_passes(spec: TrainSpec, passes, context: Optional[PassContext] = None
             p = new_pass(p[0], p[1] if len(p) > 1 else None)
         spec = p.apply(spec, context)
     return spec
+
+
+def build_train_step(spec: TrainSpec, vpp_layers: Optional[int] = None):
+    """Compile a TrainSpec into an executable hybrid train step — the piece
+    that makes with/without-pass parity testable the reference way
+    (test/distributed_passes/dist_pass_test_base.py runs the program both
+    ways and compares outputs).
+
+    Returns (step, shard_params, init_state) from
+    models.hybrid_engine.build_train_step. `vpp_layers` (total block count)
+    re-layouts stacked block params chunk-major when the spec's schedule is
+    VPP with virtual_pp > 1.
+    """
+    import jax
+
+    from ...models.hybrid_engine import build_train_step as _build
+    from ..fleet.meta_parallel.pp_utils.spmd_pipeline import (
+        vpp_wrap_shard_params)
+
+    assert spec.mesh is not None and spec.optimizer is not None, (
+        "TrainSpec needs mesh and optimizer to build a train step")
+    loss_fn = spec.resolved_loss_fn()
+    step, shard_params, init_state = _build(
+        loss_fn, spec.param_specs, spec.mesh, spec.optimizer)
+    if spec.virtual_pp > 1 and vpp_layers is not None:
+        pp = spec.mesh.shape.get("pp", 1)
+        shard_params = vpp_wrap_shard_params(shard_params, vpp_layers, pp,
+                                             spec.virtual_pp)
+    return step, shard_params, init_state
