@@ -5,10 +5,14 @@ paddle/phi/core/device_context.h).
 TPU design: XLA owns scheduling — a compiled program's internal
 parallelism, collective overlap and transfer pipelining replace
 hand-managed streams (there is exactly one logical stream per core).
-These classes keep stream-shaped reference code running: recording an
-Event snapshots a token you can synchronize on (block_until_ready of the
-arrays dispatched so far), Stream context managers are no-ops, and
-`synchronize()` drains the device.
+These classes keep stream-shaped reference code running. What is REAL:
+Event.record(tokens=...)/synchronize/query (block_until_ready over the
+recorded arrays), Event.elapsed_time (host clock), and synchronize()
+(drains the device). What is intentionally a NO-OP because the concept
+does not exist on TPU: Stream identity/priority, stream_guard, wait_stream
+ordering (XLA already orders the one logical stream). Nothing here
+schedules anything — do not port stream-overlap optimizations through this
+API; express overlap with sharding/donation and let XLA schedule.
 """
 
 from __future__ import annotations

@@ -88,9 +88,12 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
     return results
 
 
-def run_training(mesh, steps: int = 4):
+def run_training(mesh, steps: int = 4, return_params: bool = False):
     """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
-    pp / mp); every process computes identical host inputs."""
+    pp / mp); every process computes identical host inputs. The ONE copy of
+    the parity workload — the launcher golden, the spawned workers and the
+    reference-pattern tests (tests/mp_worker.py) all import it, so they can
+    never drift apart."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -112,7 +115,7 @@ def run_training(mesh, steps: int = 4):
         params, state, loss = step(params, state, tokens, labels,
                                    jnp.float32(1e-2))
         losses.append(float(jax.device_get(loss)))
-    return losses
+    return (losses, params) if return_params else losses
 
 
 def main():

@@ -19,44 +19,13 @@ import sys
 import numpy as np
 
 
-def build_job():
-    """Model/config/data shared by the worker and the parent's golden run.
-    Everything is seed-deterministic so every process constructs identical
-    host values."""
-    import jax
-    import jax.numpy as jnp
-    import paddle_tpu as paddle
-    from paddle_tpu.models import gpt as G
-
-    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
-    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, cfg.vocab_size, (8, 16))
-    labels = rng.randint(0, cfg.vocab_size, (8, 16))
-    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
-    return cfg, params, tokens, labels, opt
-
-
 def run_training(mesh, steps=5):
-    """The dp2 x mp4 hybrid train-loop; returns the per-step loss list."""
-    import jax
-    import jax.numpy as jnp
-    from paddle_tpu.models import gpt as G
+    """The dp2 x mp4 hybrid train-loop — the SHARED workload from
+    paddle_tpu.distributed.mp_smoke (one copy, no drift); returns
+    (losses, params)."""
+    from paddle_tpu.distributed.mp_smoke import run_training as _rt
 
-    cfg, params, tokens, labels, opt = build_job()
-    step, shard_params, init_state = G.build_hybrid_train_step(
-        cfg, mesh, opt, num_microbatches=1)
-    params = shard_params(params)
-    state = init_state(params)
-    tokens = jnp.asarray(tokens)
-    labels = jnp.asarray(labels)
-    losses = []
-    for _ in range(steps):
-        params, state, loss = step(params, state, tokens, labels,
-                                   jnp.float32(1e-2))
-        losses.append(float(jax.device_get(loss)))
-    return losses, params
+    return _rt(mesh, steps=steps, return_params=True)
 
 
 def run_collective_suite(mesh):
