@@ -119,28 +119,62 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     return out.astype(x.dtype)
 
 
-def householder_product(x, tau, name=None):
-    """Q from Householder reflectors (reference:
-    paddle.linalg.householder_product; LAPACK orgqr): columns of x hold
-    v_i (unit lower part), Q = H_0 H_1 ... H_{k-1}."""
+def _reflectors(x, tau):
+    """Yield the (v_i, tau_i) Householder pairs of geqrf layout (unit
+    lower part, zeros above the diagonal)."""
     m, k = x.shape[-2], tau.shape[-1]
-    Q = jnp.eye(m, dtype=x.dtype)
-    Q = jnp.broadcast_to(Q, x.shape[:-2] + (m, m)).copy() \
-        if x.ndim > 2 else Q
     for i in range(k):
         v = x[..., :, i]
         v = jnp.where(jnp.arange(m) < i, 0.0, v)
-        v = v.at[..., i].set(1.0) if hasattr(v, "at") else v
-        H = jnp.eye(m, dtype=x.dtype) - tau[..., i][..., None, None] * (
-            v[..., :, None] * jnp.conj(v[..., None, :]))
-        Q = Q @ H
-    return Q[..., :, :x.shape[-1]]
+        v = v.at[..., i].set(1.0)
+        yield v, tau[..., i]
+
+
+def _householder_full(x, tau):
+    """The FULL m x m product Q = H_0 H_1 ... H_{k-1}."""
+    m = x.shape[-2]
+    Q = jnp.eye(m, dtype=x.dtype)
+    Q = jnp.broadcast_to(Q, x.shape[:-2] + (m, m)).copy() \
+        if x.ndim > 2 else Q
+    for v, t in _reflectors(x, tau):
+        # Q (I - t v v^H) applied from the right, rank-1 update form
+        Qv = Q @ v[..., :, None]
+        Q = Q - t[..., None, None] * (Qv * jnp.conj(v[..., None, :]))
+    return Q
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (reference:
+    paddle.linalg.householder_product; LAPACK orgqr): columns of x hold
+    v_i (unit lower part) — the thin m x n slice of the full product."""
+    del name
+    return _householder_full(jnp.asarray(x),
+                             jnp.asarray(tau))[..., :, :x.shape[-1]]
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):
-    Q = householder_product(x, tau)
-    Qm = jnp.swapaxes(Q, -2, -1) if transpose else Q
-    return Qm @ other if left else other @ Qm
+    """Apply the implicit FULL Q of the reflectors to `other` (LAPACK
+    ormqr semantics), reflector by reflector — O(k m n), never forming
+    the m x m Q."""
+    del name
+    x, tau = jnp.asarray(x), jnp.asarray(tau)
+    y = jnp.asarray(other)
+    refl = list(_reflectors(x, tau))
+    # Q = H_0 H_1 ... H_{k-1}: Q y applies reflectors last-first, Q^T y
+    # first-last (H_i is Hermitian)
+    if left:
+        # y <- H y for each reflector, composing to Q y (or Q^T y)
+        seq = refl[::-1] if not transpose else refl
+        for v, t in seq:
+            vy = jnp.conj(v)[..., None, :] @ y          # [. , 1, n]
+            y = y - t[..., None, None] * (v[..., :, None] * vy)
+        return y
+    # right: y <- y H, composing to y Q (or y Q^T)
+    seq = refl if not transpose else refl[::-1]
+    for v, t in seq:
+        yv = y @ v[..., :, None]                        # [. , m, 1]
+        y = y - t[..., None, None] * (yv * jnp.conj(v)[..., None, :])
+    return y
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):

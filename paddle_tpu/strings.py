@@ -1,0 +1,61 @@
+"""String tensor ops (reference: paddle/phi/kernels/strings/ —
+strings_empty, strings_lower_upper with ASCII/UTF-8 variants,
+unicode.h case conversion tables; the reference exposes these as PHI
+kernels with no separate Python namespace).
+
+TPU design: strings are HOST data — no accelerator represents them — so
+these ops run on numpy object arrays (the pythonic equivalent of the
+reference's CPU string kernels; its GPU "string kernels" copy to host
+too). They exist so preprocessing pipelines written against the kernel
+surface port over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["empty", "empty_like", "lower", "upper"]
+
+
+def _as_str_array(x):
+    a = np.asarray(x, dtype=object)
+    return a
+
+
+def empty(shape, name=None):
+    """(reference: strings_empty_kernel.cc) array of empty strings."""
+    del name
+    out = np.empty(tuple(shape), dtype=object)
+    out.fill("")
+    return out
+
+
+def empty_like(x, name=None):
+    del name
+    return empty(np.asarray(x, dtype=object).shape)
+
+
+def lower(x, use_utf8_encoding: bool = True, name=None):
+    """(reference: strings_lower_upper_kernel.h). use_utf8_encoding=False
+    restricts case mapping to ASCII (the reference's fast path)."""
+    del name
+    a = _as_str_array(x)
+    if use_utf8_encoding:
+        f = np.frompyfunc(lambda s: str(s).lower(), 1, 1)
+    else:
+        f = np.frompyfunc(
+            lambda s: "".join(c.lower() if c.isascii() else c
+                              for c in str(s)), 1, 1)
+    return f(a)
+
+
+def upper(x, use_utf8_encoding: bool = True, name=None):
+    del name
+    a = _as_str_array(x)
+    if use_utf8_encoding:
+        f = np.frompyfunc(lambda s: str(s).upper(), 1, 1)
+    else:
+        f = np.frompyfunc(
+            lambda s: "".join(c.upper() if c.isascii() else c
+                              for c in str(s)), 1, 1)
+    return f(a)

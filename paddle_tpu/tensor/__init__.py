@@ -1152,9 +1152,16 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
 
 
 from ._round2 import *  # noqa: F401,F403  (round-2 op surface)
+from ._round3 import *  # noqa: F401,F403  (round-3 tail + in-place family)
+from ._round3 import INPLACE_NOTE, register_inplace_aliases  # noqa: F401
+
+# the op_ in-place family: out-of-place ops under the reference's in-place
+# names (see INPLACE_NOTE — jax.Arrays are immutable)
+register_inplace_aliases(globals())
 
 _NON_API = {"jax", "jnp", "np", "lax", "builtins", "next_key",
-            "List", "Optional", "Sequence", "Union", "annotations"}
+            "List", "Optional", "Sequence", "Union", "annotations",
+            "register_inplace_aliases"}
 __all__ += [n for n in dir()
             if not n.startswith("_") and n not in _NON_API
             and callable(globals().get(n))]

@@ -1,0 +1,372 @@
+"""Round-3 tensor-op tail (VERDICT r2 #6): closes the diff against the
+reference tensor namespace (python/paddle/tensor/__init__.py
+tensor_method_func, ~380 names).
+
+Two families:
+
+* real ops — add_n, atleast_*, block_diag, bit shifts, cholesky_inverse /
+  cholesky_solve re-exports, low-rank svd/pca, reduce_as, as_strided,
+  top_p_sampling, stft/istft + linalg re-exports into the tensor
+  namespace (where the reference lists them);
+* the ``op_`` in-place family — on TPU jax.Arrays are immutable, so the
+  reference's aliasing in-place semantics cannot exist; each ``op_`` is
+  the out-of-place op returning the new value (the reference's
+  return-value contract, which is how its own code uses them). Code that
+  relied on aliasing side effects must rebind — documented divergence,
+  not a silent one: paddle.tensor.INPLACE_NOTE carries the contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# real ops
+# ---------------------------------------------------------------------------
+@_export
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: add_n_kernel)."""
+    del name
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    out = jnp.asarray(inputs[0])
+    for x in inputs[1:]:
+        out = out + jnp.asarray(x)
+    return out
+
+
+def _atleast(x, nd):
+    a = jnp.asarray(x)
+    while a.ndim < nd:
+        a = a[None] if a.ndim else a.reshape((1,) * nd)
+    return a
+
+
+@_export
+def atleast_1d(*inputs, name=None):
+    del name
+    outs = [_atleast(x, 1) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@_export
+def atleast_2d(*inputs, name=None):
+    del name
+    outs = [_atleast(x, 2) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@_export
+def atleast_3d(*inputs, name=None):
+    del name
+
+    def up(x):
+        a = jnp.asarray(x)
+        if a.ndim == 0:
+            return a.reshape(1, 1, 1)
+        if a.ndim == 1:
+            return a[None, :, None]
+        if a.ndim == 2:
+            return a[:, :, None]
+        return a
+
+    outs = [up(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@_export
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of 2-D tensors."""
+    del name
+    mats = [jnp.atleast_2d(jnp.asarray(m)) for m in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = lax.dynamic_update_slice(out, m.astype(out.dtype), (r, c))
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+@_export
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    del out, name
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    return jnp.left_shift(x, y)
+
+
+@_export
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    del out, name
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if is_arithmetic:
+        return jnp.right_shift(x, y)
+    # logical shift: operate on the unsigned view, shift in zeros
+    u = {"int8": jnp.uint8, "int16": jnp.uint16, "int32": jnp.uint32,
+         "int64": jnp.uint64}.get(str(x.dtype))
+    if u is None:
+        return jnp.right_shift(x, y)
+    return jax.lax.bitcast_convert_type(
+        jnp.right_shift(jax.lax.bitcast_convert_type(x, u),
+                        y.astype(u)), x.dtype)
+
+
+@_export
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A given its Cholesky factor (reference:
+    cholesky_inverse op): A = L L^T (or U^T U) -> A^-1 solved against
+    identity."""
+    del name
+    L = jnp.asarray(x)
+    n = L.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype),
+                           L.shape[:-2] + (n, n))
+    zT = lambda z: jnp.swapaxes(z, -1, -2)
+    if upper:
+        # A = U^T U  ->  A^-1 = U^-1 U^-T
+        z = jax.scipy.linalg.solve_triangular(L, eye, lower=False)
+        return z @ zT(z)
+    # A = L L^T  ->  A^-1 = L^-T L^-1
+    z = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return zT(z) @ z
+
+
+@_export
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference: stride kernels). XLA has no aliasing
+    views; this materializes the equivalent gather — same values, not the
+    same memory."""
+    del name
+    if not shape:
+        raise ValueError("as_strided needs a non-empty shape")
+    x = jnp.asarray(x).reshape(-1)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    flat = offset + sum(g * s for g, s in zip(grids, stride))
+    return x[flat.reshape(-1).astype(jnp.int32)].reshape(tuple(shape))
+
+
+@_export
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference: reduce_as op)."""
+    del name
+    x = jnp.asarray(x)
+    tshape = tuple(getattr(target, "shape", target))
+    while x.ndim > len(tshape):
+        x = x.sum(axis=0)
+    bad = [(a, b) for a, b in zip(x.shape, tshape) if a != b and b != 1]
+    if bad or x.ndim != len(tshape):
+        raise ValueError(
+            f"reduce_as: shape {x.shape} does not reduce to {tshape} "
+            f"(target dims must match or be 1)")
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, tshape))
+                 if a != b and b == 1)
+    if axes:
+        x = x.sum(axis=axes, keepdims=True)
+    return x
+
+
+@_export
+def reverse(x, axis, name=None):
+    del name
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return jnp.flip(jnp.asarray(x), axis=axis)
+
+
+@_export
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: svd_lowrank; Halko et al.) —
+    subspace iteration on the MXU, deterministic given the framework
+    seed."""
+    del name
+    from ..random import next_key
+    A = jnp.asarray(x, jnp.float32)
+    if M is not None:
+        A = A - jnp.asarray(M, jnp.float32)
+    m, n = A.shape[-2:]
+    q = min(q, m, n)
+    G = jax.random.normal(next_key(), (*A.shape[:-2], n, q), A.dtype)
+    Y = A @ G
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = jnp.swapaxes(A, -1, -2) @ Q
+        Q2, _ = jnp.linalg.qr(Z)
+        Y = A @ Q2
+        Q, _ = jnp.linalg.qr(Y)
+    B = jnp.swapaxes(Q, -1, -2) @ A
+    U, S, Vh = jnp.linalg.svd(B, full_matrices=False)
+    return Q @ U, S, jnp.swapaxes(Vh, -1, -2)
+
+
+@_export
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference: top_p_sampling op).
+    Returns (sampled values, sampled ids)."""
+    del threshold, name
+    from ..random import next_key
+    logits = jnp.asarray(x, jnp.float32)
+    p = jnp.asarray(ps, jnp.float32).reshape(-1, 1)
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < p  # first token always kept
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    key = next_key() if seed in (None, -1) else jax.random.PRNGKey(seed)
+    choice = jax.random.categorical(key, masked, axis=-1)
+    ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+    vals = jnp.take_along_axis(logits, ids, axis=-1)
+    return vals, ids
+
+
+@_export
+def create_tensor(dtype, name=None, persistable=False):
+    del name, persistable
+    return jnp.zeros((0,), dtype=dtype)
+
+
+@_export
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter creation (reference: create_parameter). The
+    default init draws from the framework RNG (paddle.seed-controlled)."""
+    del name
+    from ..nn.layer.layers import Layer
+    from ..random import next_key
+
+    holder = Layer()
+    if default_initializer is None and attr is None:
+        value = (jnp.zeros(tuple(shape), dtype) if is_bias else
+                 (jax.random.normal(next_key(), tuple(shape), jnp.float32)
+                  * 0.02).astype(dtype))
+        p = holder.create_parameter(tuple(shape), is_bias=is_bias)
+        p.value = value
+        return p
+    return holder.create_parameter(tuple(shape), attr=attr,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+# re-exports the reference lists under paddle.tensor
+from ..linalg import (cholesky_solve, eigvals, eigvalsh,  # noqa: E402,F401
+                      householder_product, lu, lu_unpack, ormqr,
+                      pca_lowrank)
+from ..signal import istft, stft  # noqa: E402,F401
+from ..nn.functional.activation import sigmoid  # noqa: E402,F401
+
+__all__ += ["cholesky_solve", "eigvals", "eigvalsh", "householder_product",
+            "lu", "lu_unpack", "ormqr", "pca_lowrank", "istft", "stft",
+            "sigmoid"]
+
+
+# ---------------------------------------------------------------------------
+# the op_ (in-place) family
+# ---------------------------------------------------------------------------
+INPLACE_NOTE = (
+    "jax.Arrays are immutable: every `op_` returns the computed value "
+    "instead of mutating its input in place. The reference's own return-"
+    "value contract (`y = x.add_(1)`) holds; aliasing side effects "
+    "(`x.add_(1)` changing x without rebinding) do not exist on TPU — "
+    "rebind the result.")
+
+# name -> base op (module-level lookup deferred so _round3 can alias ops
+# defined in tensor/__init__ and _round2 regardless of import order)
+_INPLACE = [
+    "abs", "acos", "acosh", "add", "addmm", "asin", "asinh", "atan",
+    "atanh", "bernoulli", "bitwise_and", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "cast", "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erfinv", "exp", "flatten", "floor",
+    "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "index_add", "index_fill", "index_put", "lcm", "ldexp", "lerp",
+    "less_equal", "less_than", "lgamma", "log", "log10", "log1p", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "masked_fill", "masked_scatter", "mod", "multigammaln", "multiply",
+    "nan_to_num", "neg", "not_equal", "polygamma", "pow", "put_along_axis",
+    "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+    "scatter", "sigmoid", "sin", "sinc", "sinh", "sqrt", "squeeze",
+    "subtract", "t", "tan", "tanh", "transpose", "tril", "triu", "trunc",
+    "unsqueeze", "where",
+]
+
+# random in-place fillers with no out-of-place base in the reference
+@_export
+def normal_(x, mean=0.0, std=1.0, name=None):
+    del name
+    from ..random import next_key
+    x = jnp.asarray(x)
+    return mean + std * jax.random.normal(next_key(), x.shape,
+                                          jnp.float32).astype(x.dtype)
+
+
+@_export
+def exponential_(x, lam=1.0, name=None):
+    del name
+    from ..random import next_key
+    x = jnp.asarray(x)
+    return (jax.random.exponential(next_key(), x.shape, jnp.float32)
+            / lam).astype(x.dtype)
+
+
+@_export
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    del name
+    from ..random import next_key
+    x = jnp.asarray(x)
+    u = jax.random.uniform(next_key(), x.shape, jnp.float32, 1e-6,
+                           1 - 1e-6)
+    return (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x.dtype)
+
+
+@_export
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    del name
+    return jnp.exp(normal_(x, mean, std))
+
+
+def register_inplace_aliases(namespace: dict):
+    """Called by tensor/__init__ AFTER all base ops exist: creates each
+    missing `op_` as the out-of-place op (INPLACE_NOTE semantics)."""
+    made = []
+    for base in _INPLACE:
+        fn = namespace.get(base)
+        if fn is None or not callable(fn):
+            continue
+        alias = base + "_"
+        if alias in namespace:
+            continue
+
+        def make(fn=fn, alias=alias):
+            def inplace(*args, **kwargs):
+                return fn(*args, **kwargs)
+            inplace.__name__ = alias
+            inplace.__qualname__ = alias
+            inplace.__doc__ = (f"Out-of-place `{fn.__name__}` under the "
+                               f"reference's in-place name. {INPLACE_NOTE}")
+            return inplace
+
+        namespace[alias] = make()
+        made.append(alias)
+    return made
+
+
+@_export
+def shape(input):
+    """Shape as an int32 tensor (reference: paddle.shape). Under jit the
+    shape is static — this is a trace-time constant, which is exactly what
+    XLA wants (the reference op exists for dynamic-shape graphs TPU
+    programs avoid)."""
+    return jnp.asarray(jnp.shape(jnp.asarray(input)), jnp.int32)

@@ -1,0 +1,191 @@
+"""Golden tests for the round-3 tensor-op tail + strings + the in-place
+family contract (VERDICT r2 #6)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import tensor as T
+
+
+def test_add_n():
+    xs = [np.arange(4.0), np.ones(4), np.full(4, 2.0)]
+    np.testing.assert_allclose(np.asarray(T.add_n(xs)),
+                               np.arange(4.0) + 3.0)
+
+
+def test_atleast_family():
+    assert T.atleast_1d(np.float32(3)).shape == (1,)
+    assert T.atleast_2d(np.ones(3)).shape == (1, 3)
+    assert T.atleast_3d(np.ones((2, 3))).shape == (2, 3, 1)
+    a, b = T.atleast_2d(np.ones(3), np.ones((2, 2)))
+    assert a.shape == (1, 3) and b.shape == (2, 2)
+
+
+def test_block_diag():
+    out = np.asarray(T.block_diag([np.ones((2, 2)), 2 * np.ones((1, 3))]))
+    assert out.shape == (3, 5)
+    np.testing.assert_allclose(out[:2, :2], 1)
+    np.testing.assert_allclose(out[2:, 2:], 2)
+    np.testing.assert_allclose(out[:2, 2:], 0)
+
+
+def test_bit_shifts():
+    x = np.array([8, -8], np.int32)
+    np.testing.assert_array_equal(np.asarray(T.bitwise_left_shift(x, 1)),
+                                  [16, -16])
+    np.testing.assert_array_equal(np.asarray(T.bitwise_right_shift(x, 1)),
+                                  [4, -4])
+    # logical: zeros shift in from the left
+    out = np.asarray(T.bitwise_right_shift(x, np.int32(1),
+                                           is_arithmetic=False))
+    assert out[0] == 4 and out[1] == np.int32((2 ** 32 - 8) >> 1)
+
+
+def test_cholesky_inverse_and_solve():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4)
+    A = a @ a.T + 4 * np.eye(4)
+    L = np.linalg.cholesky(A)
+    np.testing.assert_allclose(np.asarray(T.cholesky_inverse(L)),
+                               np.linalg.inv(A), atol=1e-4)
+    b = rng.randn(4, 2)
+    np.testing.assert_allclose(np.asarray(T.cholesky_solve(b, L)),
+                               np.linalg.solve(A, b), atol=1e-4)
+
+
+def test_as_strided():
+    x = np.arange(12.0)
+    out = np.asarray(T.as_strided(x, (3, 2), (4, 1), offset=1))
+    np.testing.assert_allclose(out, [[1, 2], [5, 6], [9, 10]])
+
+
+def test_reduce_as():
+    x = np.arange(24.0).reshape(2, 3, 4)
+    out = np.asarray(T.reduce_as(x, np.zeros((3, 1))))
+    np.testing.assert_allclose(out, x.sum(0).sum(-1, keepdims=True))
+
+
+def test_reverse():
+    x = np.arange(6).reshape(2, 3)
+    np.testing.assert_array_equal(np.asarray(T.reverse(x, 1)),
+                                  x[:, ::-1])
+
+
+def test_svd_pca_lowrank():
+    rng = np.random.RandomState(1)
+    base = rng.randn(20, 3) @ rng.randn(3, 10)
+    U, S, V = T.svd_lowrank(base, q=3, niter=3)
+    rec = np.asarray(U) @ np.diag(np.asarray(S)) @ np.asarray(V).T
+    np.testing.assert_allclose(rec, base, atol=1e-3)
+    U2, S2, V2 = T.pca_lowrank(base, q=3)
+    assert np.asarray(S2).shape == (3,)
+
+
+def test_ormqr():
+    # consistency with householder_product: ormqr(x, tau, y) == Q @ y for
+    # the SAME reflector inputs (any x/tau define a valid product)
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 3).astype(np.float32)
+    tau = rng.rand(3).astype(np.float32)
+    y = rng.randn(5, 2).astype(np.float32)
+    import paddle_tpu.linalg as L
+    Q = np.asarray(L._householder_full(jnp.asarray(x), jnp.asarray(tau)))
+    # the thin slice is consistent with the full product
+    np.testing.assert_allclose(np.asarray(L.householder_product(x, tau)),
+                               Q[:, :3], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(T.ormqr(x, tau, y)),
+                               Q @ y, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(T.ormqr(x, tau, y, transpose=True)),
+        Q.T @ y, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(T.ormqr(x, tau, y.T, left=False)),
+        y.T @ Q, atol=1e-4)
+
+
+def test_top_p_sampling_mass():
+    logits = np.log(np.array([[0.6, 0.3, 0.05, 0.05]], np.float32))
+    ids = set()
+    paddle.seed(0)
+    for _ in range(50):
+        _, i = T.top_p_sampling(logits, np.array([0.8], np.float32))
+        ids.add(int(np.asarray(i)[0, 0]))
+    assert ids <= {0, 1}, ids  # nucleus excludes the 5% tails
+
+
+def test_inplace_family_contract():
+    x = jnp.ones(3)
+    y = T.add_(x, 1.0)
+    np.testing.assert_allclose(np.asarray(y), 2.0)
+    np.testing.assert_allclose(np.asarray(x), 1.0)  # immutable input
+    assert "immutable" in T.INPLACE_NOTE
+    assert "rebind" in (T.add_.__doc__ or "")
+    for name in ("exp_", "clip_", "tril_", "scatter_", "squeeze_",
+                 "normal_", "exponential_", "cauchy_", "log_normal_"):
+        assert callable(getattr(T, name)), name
+
+
+def test_shape_op():
+    np.testing.assert_array_equal(np.asarray(T.shape(np.zeros((2, 5)))),
+                                  [2, 5])
+
+
+def test_strings_ops():
+    s = paddle.strings.empty((2, 2))
+    assert s.shape == (2, 2) and s[0, 0] == ""
+    arr = np.array([["Hello", "WORLD"], ["Grüße", "ok"]], dtype=object)
+    low = paddle.strings.lower(arr)
+    assert low[0, 0] == "hello" and low[1, 0] == "grüße"
+    up_ascii = paddle.strings.upper(arr, use_utf8_encoding=False)
+    assert up_ascii[0, 0] == "HELLO"
+    assert up_ascii[1, 0] == "GRüßE"  # non-ascii untouched on the fast path
+    assert paddle.strings.empty_like(arr).shape == arr.shape
+
+
+def test_reference_surface_coverage():
+    """The documented diff: every name in the reference tensor namespace
+    exists here (in-place family via the documented out-of-place
+    contract). Skips when the reference tree isn't mounted."""
+    ref_init = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref_init):
+        pytest.skip("reference tree not mounted")
+    import re
+    ref = set(re.findall(r"^\s*'(\w+)',\s*$", open(ref_init).read(), re.M))
+    have = set(dir(T)) | set(dir(paddle))
+    missing = sorted(n for n in ref if n not in have)
+    assert not missing, f"tensor surface regressed: {missing}"
+
+
+def test_cholesky_inverse_upper_and_batched():
+    rng = np.random.RandomState(7)
+    a = rng.randn(4, 4)
+    A = a @ a.T + 4 * np.eye(4)
+    U = np.linalg.cholesky(A).T  # A = U^T U
+    np.testing.assert_allclose(np.asarray(T.cholesky_inverse(U, upper=True)),
+                               np.linalg.inv(A), atol=1e-4)
+    batch = np.stack([np.linalg.cholesky(A), np.linalg.cholesky(A + np.eye(4))])
+    out = np.asarray(T.cholesky_inverse(batch))
+    np.testing.assert_allclose(out[0], np.linalg.inv(A), atol=1e-4)
+    np.testing.assert_allclose(out[1], np.linalg.inv(A + np.eye(4)),
+                               atol=1e-4)
+
+
+def test_reduce_as_rejects_impossible_target():
+    with pytest.raises(ValueError, match="reduce_as"):
+        T.reduce_as(np.ones((4, 3)), np.zeros((2, 3)))
+
+
+def test_create_parameter_seeded_and_distinct():
+    paddle.seed(123)
+    w1 = T.create_parameter((4, 4), "float32")
+    w2 = T.create_parameter((4, 4), "float32")
+    assert not np.allclose(np.asarray(w1.value), np.asarray(w2.value))
+    paddle.seed(123)
+    w3 = T.create_parameter((4, 4), "float32")
+    np.testing.assert_allclose(np.asarray(w1.value), np.asarray(w3.value))
+    b = T.create_parameter((4,), "float32", is_bias=True)
+    np.testing.assert_allclose(np.asarray(b.value), 0.0)
