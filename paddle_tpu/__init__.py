@@ -27,6 +27,7 @@ from .framework.selected_rows import SelectedRows  # noqa: F401
 from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
 from . import strings  # noqa: F401
+from . import enforce  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import Tensor  # noqa: F401
 from . import nn  # noqa: F401

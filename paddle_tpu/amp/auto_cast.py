@@ -86,7 +86,7 @@ def _resolve_dtype(dtype):
 @contextlib.contextmanager
 def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = None,
               custom_black_list: Optional[Iterable[str]] = None, level: str = "O1",
-              dtype: str = "bfloat16", use_promote: bool = True):
+              dtype: Optional[str] = None, use_promote: bool = True):
     """Context under which traced ops follow the AMP dtype policy.
 
     Reference: python/paddle/amp/auto_cast.py (amp_guard). level O1 casts
@@ -95,6 +95,9 @@ def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = 
     supported for parity testing.
     """
     del use_promote  # promote is the only inter-op behavior we implement
+    if dtype is None:
+        from ..flags import flag
+        dtype = flag("amp_dtype")
     assert level in ("O0", "O1", "O2"), level
     prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
             set(_STATE.white), set(_STATE.black))

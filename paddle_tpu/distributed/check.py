@@ -47,8 +47,12 @@ define_flag("enable_comm_dynamic_check", False,
             "scan collective inputs for NaN/Inf (compiled into the program)")
 
 
-class CommCheckError(ValueError):
-    """Raised when a pre-collective static check fails."""
+from ..enforce import InvalidArgumentError
+
+
+class CommCheckError(InvalidArgumentError):
+    """Raised when a pre-collective static check fails (typed through the
+    enforce taxonomy — reference: static_check.cc uses PADDLE_ENFORCE)."""
 
 
 def _shape_dtype(x):

@@ -28,6 +28,19 @@ from .utils import chunk_name, flatten_state_dict, shard_chunks, to_host
 __all__ = ["save_state_dict", "wait_async_save"]
 
 _PENDING: List[threading.Thread] = []
+_SEM: list = [None, 0]
+
+
+def _writer_semaphore(n: int) -> threading.Semaphore:
+    """Concurrent async-save writer cap (FLAGS_async_ckpt_workers). A
+    resize only takes effect once in-flight writers drain — swapping the
+    semaphore under live permit holders would let old+new permits exceed
+    the cap."""
+    if _SEM[0] is None or (_SEM[1] != n
+                           and not any(t.is_alive() for t in _PENDING)):
+        _SEM[0] = threading.Semaphore(max(n, 1))
+        _SEM[1] = n
+    return _SEM[0]
 _ASYNC_ERRORS: List[BaseException] = []
 
 
@@ -187,11 +200,15 @@ def save_state_dict(state_dict: Dict, path: str,
                 _write_metadata(all_meta)
 
     def run_async(**kw):
+        from ...flags import flag
+        sem = _writer_semaphore(int(flag("async_ckpt_workers")))
+
         def guarded():
-            try:
-                write_files(**kw)
-            except BaseException as e:  # surfaced by wait_async_save
-                _ASYNC_ERRORS.append(e)
+            with sem:
+                try:
+                    write_files(**kw)
+                except BaseException as e:  # surfaced by wait_async_save
+                    _ASYNC_ERRORS.append(e)
         t = threading.Thread(target=guarded, daemon=False)
         _PENDING.append(t)
         t.start()

@@ -175,7 +175,9 @@ class CollectiveController:
                 self._elastic.store.delete_key(
                     self._elastic._key("registered_count"))
         ctx = self.ctx
-        base_port = 37000 + (os.getpid() + generation * 131) % 2000
+        from ...flags import flag
+        base_port = (int(flag("launch_base_port"))
+                     + (os.getpid() + generation * 131) % 2000)
         my_eps = [f"{ctx.node.ip}:{base_port + i}" for i in range(ctx.nproc)]
         endpoints = self.master.sync_peers(my_eps, generation)
         coordinator = endpoints[0].rsplit(":", 1)[0] + ":" + str(

@@ -25,12 +25,18 @@ class ElasticLevel:
 
 class ElasticManager:
     def __init__(self, store, job_id: str, np: int,
-                 heartbeat_interval: float = 1.0,
-                 heartbeat_timeout: float = 5.0):
+                 heartbeat_interval: Optional[float] = None,
+                 heartbeat_timeout: Optional[float] = None):
         self.store = store
         self.job_id = job_id
         self.np = np
+        if heartbeat_interval is None:
+            from ...flags import flag
+            heartbeat_interval = float(flag("elastic_heartbeat_interval_s"))
         self.interval = heartbeat_interval
+        if heartbeat_timeout is None:
+            from ...flags import flag
+            heartbeat_timeout = float(flag("elastic_hang_timeout_s"))
         self.timeout = heartbeat_timeout
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -53,8 +53,11 @@ class TCPStore:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  world_size: int = 1, is_master: bool = False,
-                 timeout: float = 300.0):
+                 timeout: Optional[float] = None):
         self._lib = _native.load()
+        if timeout is None:
+            from ..flags import flag
+            timeout = float(flag("tcp_store_timeout_s"))
         self._timeout_ms = int(timeout * 1000)
         self._server = None
         self._client = None

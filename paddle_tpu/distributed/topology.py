@@ -219,9 +219,10 @@ def build_mesh(dims: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh
     device order; everything else is the flat reshape."""
     devices = list(devices if devices is not None else jax.devices())
     total = int(np.prod(list(dims.values())))
-    assert total == len(devices), (
-        f"product of parallel degrees {dims} = {total} != device count "
-        f"{len(devices)}")
+    from ..enforce import enforce
+    enforce(total == len(devices),
+            f"product of parallel degrees {dims} = {total} != device "
+            f"count {len(devices)}", op="build_mesh")
     shape = tuple(dims.values())
     n_proc = len({d.process_index for d in devices})
     if n_proc > 1:

@@ -73,9 +73,11 @@ class CommWatchdog:
         """Track one host-side operation; fires on_timeout if it overruns.
         Default timeout comes from FLAGS_comm_timeout_s (reference:
         FLAGS_nccl_blocking_wait / comm watchdog timeouts)."""
+        from ..flags import flag
         if timeout is None:
-            from ..flags import flag
             timeout = float(flag("comm_timeout_s"))
+        # FLAGS_stop_check_timeout (reference): hard ceiling on any span
+        timeout = min(timeout, float(flag("stop_check_timeout")))
         now = time.monotonic()
         span = _Span(tag, now, now + timeout, threading.get_ident())
         with self._lock:

@@ -1,0 +1,157 @@
+"""Structured error system (reference: paddle/common/enforce.h —
+PADDLE_ENFORCE_* macros raising typed errors with operator context and a
+FLAGS_call_stack_level-controlled amount of call-stack detail;
+paddle/phi/core/enforce.h).
+
+TPU design: a Python exception taxonomy + an `enforce()` helper that
+formats the failing condition with op/shape context. The error classes
+mirror the reference's error-type enum so ported `except` clauses keep
+working (`paddle.enforce.InvalidArgumentError` etc.).
+FLAGS_call_stack_level: 0 = message only, 1 (default) = message + the
+calling frame, 2 = full traceback appended.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Optional
+
+from .flags import flag
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+    "UnimplementedError", "UnavailableError", "PreconditionNotMetError",
+    "ExecutionTimeoutError", "enforce", "enforce_eq", "enforce_gt",
+    "enforce_ge", "enforce_in", "enforce_shape",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of the typed error taxonomy (reference: enforce.h
+    EnforceNotMet). Carries `error_type`, optional `op` and a context
+    dict; __str__ renders them plus the flag-controlled stack."""
+
+    error_type = "EnforceNotMet"
+
+    def __init__(self, message: str, op: Optional[str] = None, **context):
+        self.op = op
+        self.context = context
+        # capture at RAISE time (construction) — by __str__ the raising
+        # frames have unwound and extract_stack would blame the formatter
+        self._frames = [
+            f for f in traceback.extract_stack()[:-1]
+            if "paddle_tpu/enforce" not in f.filename.replace("\\", "/")]
+        super().__init__(message)
+
+    def __str__(self):
+        parts = [f"[{self.error_type}] {self.args[0]}"]
+        if self.op:
+            parts.append(f"  [operator: {self.op}]")
+        for k, v in self.context.items():
+            parts.append(f"  [{k}: {_fmt(v)}]")
+        level = flag("call_stack_level")
+        if level >= 1:
+            frames = self._frames
+            if frames:
+                if level >= 2:
+                    parts.append("  [call stack]")
+                    parts += [f"    {f.filename}:{f.lineno} ({f.name})"
+                              for f in frames[-8:]]
+                else:
+                    f = frames[-1]
+                    parts.append(f"  [at: {f.filename}:{f.lineno} "
+                                 f"({f.name})]")
+        return "\n".join(parts)
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    error_type = "InvalidArgument"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    error_type = "NotFound"
+
+    def __str__(self):  # KeyError quotes args[0]; keep the rich render
+        return EnforceNotMet.__str__(self)
+
+
+class OutOfRangeError(EnforceNotMet, IndexError, ValueError):
+    # also a ValueError: capacity/range failures were plain ValueErrors
+    # before the taxonomy landed, and reference code catches either
+    error_type = "OutOfRange"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    error_type = "AlreadyExists"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    error_type = "PermissionDenied"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    error_type = "Unimplemented"
+
+
+class UnavailableError(EnforceNotMet):
+    error_type = "Unavailable"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    error_type = "PreconditionNotMet"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    error_type = "ExecutionTimeout"
+
+
+def _fmt(v: Any) -> str:
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"Tensor(shape={tuple(shape)}, dtype={dtype})"
+    return repr(v)
+
+
+def enforce(cond: Any, message: str, *,
+            error=InvalidArgumentError, op: Optional[str] = None,
+            **context) -> None:
+    """PADDLE_ENFORCE: raise `error` with op/shape context unless cond.
+
+    >>> enforce(x.ndim == 4, "flash attention needs rank-4 q",
+    ...         op="flash_attention", q=x)
+    """
+    if not cond:
+        raise error(message, op=op, **context)
+
+
+def enforce_eq(a, b, message: str = "", **kw) -> None:
+    enforce(a == b, message or f"expected equality, got {a!r} != {b!r}",
+            expected=b, actual=a, **kw)
+
+
+def enforce_gt(a, b, message: str = "", **kw) -> None:
+    enforce(a > b, message or f"expected {a!r} > {b!r}",
+            lhs=a, rhs=b, **kw)
+
+
+def enforce_ge(a, b, message: str = "", **kw) -> None:
+    enforce(a >= b, message or f"expected {a!r} >= {b!r}",
+            lhs=a, rhs=b, **kw)
+
+
+def enforce_in(value, options, message: str = "", **kw) -> None:
+    enforce(value in options,
+            message or f"{value!r} not in allowed set {sorted(options)!r}",
+            value=value, options=sorted(options), **kw)
+
+
+def enforce_shape(x, expected, message: str = "", *, op=None, name="input"
+                  ) -> None:
+    """Shape check with wildcards (None matches any dim)."""
+    shape = tuple(getattr(x, "shape", ()))
+    ok = len(shape) == len(expected) and all(
+        e is None or s == e for s, e in zip(shape, expected))
+    enforce(ok, message or f"{name} expects shape {tuple(expected)}, got "
+            f"{shape}", op=op, **{name: x})

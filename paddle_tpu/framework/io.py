@@ -35,6 +35,14 @@ def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
         os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
         pickle.dump(_to_host(obj), f, protocol=protocol)
+    from ..flags import flag
+    dump = flag("dump_dir")
+    if dump:
+        os.makedirs(dump, exist_ok=True)
+        target = os.path.join(dump, os.path.basename(path))
+        if os.path.abspath(target) != os.path.abspath(path):
+            import shutil
+            shutil.copy2(path, target)
 
 
 def load(path: str, **configs) -> Any:

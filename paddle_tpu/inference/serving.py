@@ -196,9 +196,16 @@ class ServingEngine:
     """Continuous-batching engine over a paged KV pool (see module doc)."""
 
     def __init__(self, params, cfg: G.GPTConfig, *, max_batch: int = 4,
-                 block_size: int = 16, num_blocks: int = 256,
-                 max_blocks_per_seq: int = 32, chunk: int = 32,
-                 decode_burst: int = 8, seed: int = 0):
+                 block_size: int = None, num_blocks: int = 256,
+                 max_blocks_per_seq: int = 32, chunk: int = None,
+                 decode_burst: int = None, seed: int = 0):
+        from ..flags import flag
+        block_size = (int(flag("paged_block_size")) if block_size is None
+                      else block_size)
+        chunk = (int(flag("serving_prefill_chunk")) if chunk is None
+                 else chunk)
+        decode_burst = (int(flag("serving_decode_burst"))
+                        if decode_burst is None else decode_burst)
         self.params, self.cfg = params, cfg
         self.bs, self.chunk = block_size, chunk
         self.max_batch = max_batch

@@ -55,13 +55,19 @@ class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
-                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 num_workers=None, use_buffer_reader=True, prefetch_factor=None,
                  use_shared_memory=True, timeout=0, worker_init_fn=None):
         del feed_list, places, return_list, use_shared_memory, timeout
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        if num_workers is None:
+            from ..flags import flag
+            num_workers = int(flag("dataloader_num_workers"))
         self.num_workers = num_workers
-        self.prefetch_factor = max(prefetch_factor, 2)
+        if prefetch_factor is None:
+            from ..flags import flag
+            prefetch_factor = int(flag("io_prefetch_factor"))
+        self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)

@@ -350,10 +350,13 @@ class PagedKVCache:
         capacity = self.block_tables.shape[1] * self.block_size
         if pos + need > capacity:
             # JAX index clamping would silently overwrite the last slot
-            raise ValueError(
+            from ..enforce import OutOfRangeError
+            raise OutOfRangeError(
                 f"sequence {b} is full: {pos}+{need} tokens > capacity "
                 f"{capacity} (max_blocks_per_seq * block_size); allocate "
-                f"more blocks in its block table")
+                f"more blocks in its block table",
+                op="PagedKVCache.write", pos=pos, need=need,
+                capacity=capacity)
         return pos
 
     def write(self, b: int, k, v):

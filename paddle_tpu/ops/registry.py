@@ -42,8 +42,11 @@ class OpSchema:
     tags: List[str] = field(default_factory=list)
 
     def dispatch(self, *args, **kwargs):
-        stats = DISPATCH_STATS.setdefault(self.name,
-                                          {"pallas": 0, "reference": 0})
+        from ..flags import flag
+        count = flag("enable_dispatch_stats")
+        stats = (DISPATCH_STATS.setdefault(
+            self.name, {"pallas": 0, "reference": 0}) if count
+            else {"pallas": 0, "reference": 0})
         if (
             self.pallas_impl is not None
             and flag("enable_pallas_kernels")

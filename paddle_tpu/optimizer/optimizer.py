@@ -44,7 +44,9 @@ def _sr_to_bf16(x, key):
 
 
 def _store_moment(x, dtype, key):
-    if dtype == jnp.bfloat16 and key is not None:
+    from ..flags import flag
+    if dtype == jnp.bfloat16 and key is not None \
+            and flag("bf16_stochastic_rounding_moments"):
         return _sr_to_bf16(x, key)
     return x.astype(dtype)
 

@@ -68,9 +68,13 @@ def _default_scheduler(step: int) -> ProfilerState:
     return ProfilerState.RECORD  # record everything until stop()
 
 
-def export_chrome_tracing(dir_name: str,
+def export_chrome_tracing(dir_name: Optional[str] = None,
                           worker_name: Optional[str] = None) -> Callable:
-    """on_trace_ready callback writing chrome://tracing JSON."""
+    """on_trace_ready callback writing chrome://tracing JSON. dir_name
+    defaults to FLAGS_profiler_dir."""
+    if dir_name is None:
+        from ..flags import flag
+        dir_name = flag("profiler_dir")
 
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)

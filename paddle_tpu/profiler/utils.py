@@ -12,6 +12,8 @@ when a jax trace is active.
 from __future__ import annotations
 
 import threading
+
+from ..flags import flag as _flag
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -63,6 +65,10 @@ class EventCollector:
 
     def add(self, ev: HostEvent):
         if not self.enabled:
+            if _flag("enable_host_event_recorder_hook"):
+                with self._lock:
+                    self._events.append(ev)
+                return
             return
         with self._lock:
             self._events.append(ev)

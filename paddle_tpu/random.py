@@ -122,6 +122,9 @@ def next_mask_key() -> jax.Array:
     uniqueness/determinism still come from the threefry sequence; only the
     bit expansion changes engine."""
     k = next_key()
+    from .flags import flag
+    if not flag("dropout_use_rbg"):
+        return k
     kd = jax.random.key_data(k).astype(jnp.uint32).reshape(-1)  # (2,)
     try:
         return jax.random.wrap_key_data(jnp.concatenate([kd, kd]),

@@ -1,0 +1,86 @@
+"""Weight-zoo download/cache machinery (reference:
+python/paddle/utils/download.py — get_weights_path_from_url, md5-checked
+cache under ~/.cache/paddle/hapi/weights; used by vision models'
+pretrained=True path).
+
+TPU build note: this environment has zero egress, so the loader is
+cache-first: `file://` URLs and plain paths load directly, http(s) URLs
+resolve against the local cache (`$PADDLE_TPU_WEIGHTS_HOME`, default
+~/.cache/paddle_tpu/weights) and only then attempt a network fetch —
+failing with a typed UnavailableError that names the cache path to
+pre-seed, never a silent hang."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..enforce import UnavailableError
+
+__all__ = ["get_weights_path_from_url", "load_dict_from_url",
+           "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_WEIGHTS_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "weights"))
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
+    """Resolve `url` to a local weights file (reference:
+    utils/download.py:get_weights_path_from_url). Accepts plain paths and
+    file:// URLs directly; http(s) URLs hit the cache first."""
+    parsed = urlparse(url)
+    if parsed.scheme in ("", "file"):
+        path = parsed.path if parsed.scheme == "file" else url
+        if not os.path.exists(path):
+            raise UnavailableError(f"weights file not found: {path}",
+                                   op="get_weights_path_from_url")
+        return path
+
+    fname = os.path.basename(parsed.path)
+    cached = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(cached):
+        if md5sum and _md5(cached) != md5sum:
+            raise UnavailableError(
+                f"cached weights {cached} fail the md5 check "
+                f"(expected {md5sum})", op="get_weights_path_from_url")
+        return cached
+
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    try:
+        import urllib.request
+        tmp = cached + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        if md5sum and _md5(tmp) != md5sum:
+            os.remove(tmp)
+            raise UnavailableError(f"downloaded weights fail the md5 check",
+                                   op="get_weights_path_from_url")
+        shutil.move(tmp, cached)
+        return cached
+    except UnavailableError:
+        raise
+    except Exception as e:
+        raise UnavailableError(
+            f"cannot fetch {url} ({type(e).__name__}: {e}); this "
+            f"environment may have no egress — pre-seed the file at "
+            f"{cached}", op="get_weights_path_from_url") from e
+
+
+def load_dict_from_url(url: str, md5sum: Optional[str] = None):
+    """Fetch (or resolve) + paddle.load the state dict (reference:
+    hapi pretrained loading)."""
+    from ..framework.io import load
+
+    return load(get_weights_path_from_url(url, md5sum))

@@ -137,3 +137,110 @@ class MNIST(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, np.int64(self.labels[idx])
+
+
+def _default_loader(path: str):
+    """cv2-first image loading like the reference's folder datasets
+    (vision/datasets/folder.py default backends); .npy arrays load
+    directly so pipelines can stay image-library-free."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+    except ImportError:
+        import cv2
+
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:  # cv2's documented failure mode: no exception
+            from ..enforce import UnavailableError
+            raise UnavailableError(f"cv2 could not read image: {path}",
+                                   op="DatasetFolder.loader")
+        return img[:, :, ::-1]  # BGR -> RGB
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory dataset (reference:
+    vision/datasets/folder.py DatasetFolder): root/<class_x>/xxx.ext.
+    Yields (sample, class_index); `classes`/`class_to_idx` expose the
+    discovered taxonomy."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            from ..enforce import NotFoundError
+            raise NotFoundError(f"no class folders under {root}",
+                                op="DatasetFolder")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = (is_valid_file if is_valid_file is not None
+                 else lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    p = os.path.join(dirpath, f)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        self.targets = [t for _, t in self.samples]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Unlabeled image-folder dataset (reference: folder.py ImageFolder):
+    every valid file under root, returned as [sample]."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        valid = (is_valid_file if is_valid_file is not None
+                 else lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                p = os.path.join(dirpath, f)
+                if valid(p):
+                    self.samples.append(p)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class FashionMNIST(MNIST):
+    """Fashion-MNIST (reference: vision/datasets/mnist.py FashionMNIST) —
+    identical idx file format and loader, different corpus files (point
+    image_path/label_path at the fashion idx files)."""
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "FashionMNIST",
+            "IMG_EXTENSIONS"]

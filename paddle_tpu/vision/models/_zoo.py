@@ -1,0 +1,45 @@
+"""Pretrained-weight zoo plumbing (reference: each vision/models/*.py
+carries a model_urls dict of (bcebos URL, md5) pairs and loads through
+utils.download when pretrained=True).
+
+The URLs/md5s below are the reference's published weight artifacts (data,
+not code). With no egress the loader resolves them against the local cache
+(see utils.download.WEIGHTS_HOME); `pretrained` may also be a direct
+path/file:// URL to a .pdparams state dict."""
+
+from __future__ import annotations
+
+from ...enforce import NotFoundError
+
+MODEL_URLS = {
+    "resnet18": ("https://paddle-hapi.bj.bcebos.com/models/resnet18.pdparams",
+                 "cf548f46534aa3560945be4b95cd11c4"),
+    "resnet34": ("https://paddle-hapi.bj.bcebos.com/models/resnet34.pdparams",
+                 "8d2275cf8706028345f78ac0e1d31969"),
+    "resnet50": ("https://paddle-hapi.bj.bcebos.com/models/resnet50.pdparams",
+                 "ca6f485ee1ab0492d38f323885b0ad80"),
+    "resnet101": ("https://paddle-hapi.bj.bcebos.com/models/resnet101.pdparams",
+                  "02f35f034ca3858e1e54d4036443c92d"),
+    "resnet152": ("https://paddle-hapi.bj.bcebos.com/models/resnet152.pdparams",
+                  "7ad16a2f1e7333859ff986138630fd7a"),
+}
+
+
+def load_pretrained(model, arch: str, pretrained):
+    """Apply zoo weights when requested. `pretrained` forms: False (no-op),
+    True (registered URL for `arch`), or a path / file:// / http(s) URL."""
+    if not pretrained:
+        return model
+    from ...utils.download import load_dict_from_url
+
+    if isinstance(pretrained, str):
+        sd = load_dict_from_url(pretrained)
+    else:
+        if arch not in MODEL_URLS:
+            raise NotFoundError(
+                f"no registered pretrained weights for '{arch}'; pass a "
+                f"path or URL as pretrained=", op="load_pretrained")
+        url, md5 = MODEL_URLS[arch]
+        sd = load_dict_from_url(url, md5)
+    model.set_state_dict(sd)
+    return model

@@ -142,52 +142,70 @@ def _resnet(block, depth, **kwargs):
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BasicBlock, 18, **kwargs),
+                           "resnet18", pretrained)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BasicBlock, 34, **kwargs),
+                           "resnet34", pretrained)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 50, **kwargs),
+                           "resnet50", pretrained)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 101, **kwargs),
+                           "resnet101", pretrained)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 152, **kwargs),
+                           "resnet152", pretrained)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, groups=32, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 50, groups=32, width=4, **kwargs), "resnext50_32x4d", pretrained)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, groups=64, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 50, groups=64, width=4, **kwargs), "resnext50_64x4d", pretrained)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, groups=32, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 101, groups=32, width=4, **kwargs), "resnext101_32x4d", pretrained)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, groups=64, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 101, groups=64, width=4, **kwargs), "resnext101_64x4d", pretrained)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, groups=32, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 152, groups=32, width=4, **kwargs), "resnext152_32x4d", pretrained)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, groups=64, width=4, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 152, groups=64, width=4, **kwargs), "resnext152_64x4d", pretrained)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=128, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 50, width=128, **kwargs), "wide_resnet50_2", pretrained)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=128, **kwargs)
+    from ._zoo import load_pretrained
+    return load_pretrained(_resnet(BottleneckBlock, 101, width=128, **kwargs), "wide_resnet101_2", pretrained)

