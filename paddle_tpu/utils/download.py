@@ -53,10 +53,11 @@ def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
     cached = os.path.join(WEIGHTS_HOME, fname)
     if os.path.exists(cached):
         if md5sum and _md5(cached) != md5sum:
-            raise UnavailableError(
-                f"cached weights {cached} fail the md5 check "
-                f"(expected {md5sum})", op="get_weights_path_from_url")
-        return cached
+            # corrupted/partial cache entry: evict and fall through to a
+            # re-fetch (the reference's behavior) instead of dead-ending
+            os.remove(cached)
+        else:
+            return cached
 
     os.makedirs(WEIGHTS_HOME, exist_ok=True)
     try:

@@ -419,3 +419,32 @@ def test_int8_scale_convention_interops_with_ptq():
     out = int8_matmul(xq, wq, xs, ws)
     ref = np.asarray(x) @ np.asarray(w)
     assert np.abs(np.asarray(out) - ref).max() < 0.05 * np.abs(ref).max()
+
+
+def test_sparse_add_true_coo():
+    """round-3: COO add merges coordinate lists (no dense round trip)."""
+    import jax.numpy as jnp
+    from paddle_tpu import sparse as S
+
+    a = S.to_sparse_coo(jnp.asarray([[1.0, 0, 0], [0, 2.0, 0]]))
+    b = S.to_sparse_coo(jnp.asarray([[0, 0, 3.0], [0, 4.0, 0]]))
+    out = S.add(a, b)
+    assert S.is_sparse(out)
+    np.testing.assert_allclose(np.asarray(out.todense()),
+                               [[1, 0, 3], [0, 6, 0]])
+
+
+def test_sparse_sddmm_matches_dense_sample():
+    import jax.numpy as jnp
+    from paddle_tpu import sparse as S
+
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(5, 4).astype(np.float32))
+    b = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+    mask = S.to_sparse_coo(jnp.asarray(
+        (rng.rand(5, 6) < 0.3).astype(np.float32)))
+    out = S.masked_matmul(a, b, mask)
+    dense = np.asarray(a) @ np.asarray(b)
+    got = np.asarray(out.todense())
+    want = dense * (np.asarray(mask.todense()) != 0)
+    np.testing.assert_allclose(got, want, atol=1e-5)
