@@ -32,17 +32,22 @@ _SEM: list = [None, 0]
 _SEM_LOCK = threading.Lock()
 
 
-def _writer_semaphore(n: int) -> threading.Semaphore:
+def _writer_semaphore(n: int, thread: threading.Thread
+                      ) -> threading.Semaphore:
     """Concurrent async-save writer cap (FLAGS_async_ckpt_workers). A
-    resize only takes effect once in-flight writers drain — swapping the
-    semaphore under live permit holders would let old+new permits exceed
-    the cap. The check-and-swap (and the _PENDING scan) run under a lock
-    so concurrent savers can't both swap."""
+    resize only takes effect once every registered writer has finished —
+    swapping the semaphore under outstanding permits would let old+new
+    permits exceed the cap. Registration (append) happens under the SAME
+    lock as the check-and-swap, and unstarted threads (ident None) count
+    as outstanding, so a writer that was handed the old semaphore can
+    never be missed by the drain scan."""
     with _SEM_LOCK:
-        if _SEM[0] is None or (_SEM[1] != n
-                               and not any(t.is_alive() for t in _PENDING)):
+        drained = all(t.ident is not None and not t.is_alive()
+                      for t in _PENDING)
+        if _SEM[0] is None or (_SEM[1] != n and drained):
             _SEM[0] = threading.Semaphore(max(n, 1))
             _SEM[1] = n
+        _PENDING.append(thread)
         return _SEM[0]
 _ASYNC_ERRORS: List[BaseException] = []
 
@@ -204,16 +209,16 @@ def save_state_dict(state_dict: Dict, path: str,
 
     def run_async(**kw):
         from ...flags import flag
-        sem = _writer_semaphore(int(flag("async_ckpt_workers")))
+        sem_box = []
 
         def guarded():
-            with sem:
+            with sem_box[0]:
                 try:
                     write_files(**kw)
                 except BaseException as e:  # surfaced by wait_async_save
                     _ASYNC_ERRORS.append(e)
         t = threading.Thread(target=guarded, daemon=False)
-        _PENDING.append(t)
+        sem_box.append(_writer_semaphore(int(flag("async_ckpt_workers")), t))
         t.start()
 
     _SAVE_SEQ[0] += 1

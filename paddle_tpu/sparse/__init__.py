@@ -80,7 +80,13 @@ def add(a, b):
         data = jnp.concatenate([a.data.astype(dt), b.data.astype(dt)])
         idx = jnp.concatenate([a.indices, b.indices])
         out = jsparse.BCOO((data, idx), shape=a.shape)
-        return out.sum_duplicates(nse=a.nse + b.nse)
+        import jax as _jax
+        if isinstance(out.data, _jax.core.Tracer):
+            # under jit nse must be static: bound = nnz_a + nnz_b (tail
+            # padded with sentinel indices per BCOO semantics)
+            return out.sum_duplicates(nse=a.nse + b.nse)
+        # eager: exact nse so nnz()/indices expose no sentinel padding
+        return out.sum_duplicates()
     return to_dense(a) + to_dense(b)
 
 

@@ -51,11 +51,14 @@ def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
 
     fname = os.path.basename(parsed.path)
     cached = os.path.join(WEIGHTS_HOME, fname)
+    quarantined = None
     if os.path.exists(cached):
         if md5sum and _md5(cached) != md5sum:
-            # corrupted/partial cache entry: evict and fall through to a
-            # re-fetch (the reference's behavior) instead of dead-ending
-            os.remove(cached)
+            # mismatching cache entry: QUARANTINE (never delete — in a
+            # no-egress env this may be the user's pre-seeded file) and
+            # fall through to a re-fetch
+            quarantined = cached + ".bad"
+            os.replace(cached, quarantined)
         else:
             return cached
 
@@ -73,10 +76,14 @@ def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
     except UnavailableError:
         raise
     except Exception as e:
+        extra = (f" NOTE: a cached file failed its md5 check (expected "
+                 f"{md5sum}) and was moved to {quarantined} — if it is a "
+                 f"deliberately different weight set, load it by path "
+                 f"instead of pretrained=True." if quarantined else "")
         raise UnavailableError(
             f"cannot fetch {url} ({type(e).__name__}: {e}); this "
             f"environment may have no egress — pre-seed the file at "
-            f"{cached}", op="get_weights_path_from_url") from e
+            f"{cached}.{extra}", op="get_weights_path_from_url") from e
 
 
 def load_dict_from_url(url: str, md5sum: Optional[str] = None):

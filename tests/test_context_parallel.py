@@ -255,3 +255,25 @@ def test_ring_attention_einsum_rejects_gqa():
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     with pytest.raises(ValueError, match="GQA"):
         jax.jit(f)(q, k, k)
+
+
+def test_einsum_ring_odd_length_chunk_padding():
+    """round-3: the einsum tier is chunked (O(S_local x 512) scores).
+    S_local = 521 > 512 and odd: n_chunks=2, chunk=261, S_pad=522 — the
+    jnp.pad branch and the idx < S pad-key masking BOTH execute (any
+    S_local <= 512 is its own exact chunk and would skip them)."""
+    mesh = sep_mesh(2)
+    q, k, v = make_qkv(B=1, S=2 * 521, H=2, D=8)
+    spec = P(None, "sep")
+    f = shard_map(
+        functools.partial(ring_attention, axis="sep", causal=True,
+                          impl="einsum"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = jax.jit(f)(q, k, v)
+    golden = full_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+    g = jax.grad(lambda q: jnp.sum(jax.jit(f)(q, k, v) ** 2))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(full_attention(q, k, v, True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=3e-4, atol=3e-4)
