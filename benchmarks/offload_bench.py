@@ -1,25 +1,37 @@
-"""Bigger-than-HBM single-chip training via host offload (VERDICT r2 #3).
+"""Bigger-than-HBM single-chip training via host offload.
 
-A 2.76B-param GPT (H=2560, L=34, 20 heads -> head_dim 128, vocab 32768) in
-bf16 needs ~5.5 GB params + 5.5 GB grads + 11 GB Adam moments = ~22 GB —
-over a v5e's 16 GB HBM. With `build_sharded_train_step(offload=True)` the
-moments are parked in pinned_host between steps and streamed through HBM
-one leaf at a time during the update, so HBM holds only params + grads +
-activations (~12 GB) and the config trains.
+Two tiers, both on one 16 GB v5e:
 
-Run on the TPU: `python benchmarks/offload_bench.py` — prints one JSON
-line. The step is PCIe-bound (moments cross the host link twice per step);
-the point is capability (reference: group_sharded_stage3.py:85 offload),
-not throughput.
+* ``--size 2.85b`` (moments offload, VERDICT r2 #3): a 2.76B-param GPT
+  (H=2560, L=34, 20 heads) trains with Adam moments parked in pinned_host
+  and streamed through HBM one leaf at a time — HBM holds params + grads +
+  activations only.
+
+* ``--size 6.7b`` (param streaming, VERDICT r3 #1): the GPT-3 6.7B
+  north-star shape (H=4096, L=32, heads=32, vocab 50304) — its bf16 params
+  alone (~13.4 GB) don't fit next to activations, so the PARAMS themselves
+  live in pinned_host and stream through HBM one block at a time, forward
+  and backward, with the optimizer update fused into the backward
+  (distributed/sharding/param_stream.py; reference:
+  group_sharded_stage3.py:85 param slicing + gather-on-use + offload).
+
+Run on the TPU: `python benchmarks/offload_bench.py --size 6.7b` — prints
+one JSON line. Both tiers are host-link-bound by design; the point is
+capability (the shape trains at all), not throughput.
 """
 
+import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main():
+
+def run_moments_offload(on_tpu):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -28,7 +40,6 @@ def main():
         build_sharded_train_step)
     from paddle_tpu.models import gpt as G
 
-    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
     if on_tpu:
         cfg = G.GPTConfig(vocab_size=32768, hidden_size=2560, num_layers=34,
                           num_heads=20, max_seq_len=1024,
@@ -83,6 +94,77 @@ def main():
         "config": f"GPT {n_params/1e9:.2f}B bf16, seq {seq}, batch {batch}, "
                   "Adam moments parked in pinned_host, streamed per leaf",
     }))
+
+
+def run_param_stream(on_tpu):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.sharding.param_stream import (
+        build_param_streamed_train_step, park)
+    from paddle_tpu.models import gpt as G
+
+    if on_tpu:
+        cfg = G.gpt_6p7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        batch, seq, iters = 2, 2048, 2
+        moment_dtype = jnp.bfloat16
+    else:  # CPU smoke
+        cfg = G.gpt_tiny(dtype=jnp.float32)
+        batch, seq, iters = 2, 128, 2
+        moment_dtype = None
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 moment_dtype=moment_dtype)
+    place, init_state, step = build_param_streamed_train_step(
+        *G.streamed_fns(cfg), opt)
+
+    t_init = time.perf_counter()
+    hparams = G.init_streamed_params(cfg, jax.random.PRNGKey(0), park=park)
+    hstate = init_state(hparams)
+    n_params = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(hparams))
+    init_s = time.perf_counter() - t_init
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    hparams, hstate, loss = step(hparams, hstate, tokens, labels, 1e-4)
+    l0 = float(loss)  # warmup incl. all 5 program compiles
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        hparams, hstate, loss = step(hparams, hstate, tokens, labels, 1e-4)
+    l_final = float(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    kinds = {leaf.sharding.memory_kind for leaf in jax.tree.leaves(hparams)}
+    assert np.isfinite(l_final), (l0, l_final)
+    assert kinds == {"pinned_host"}, kinds
+    print(json.dumps({
+        "metric": "offload_6p7b_param_stream_step_time",
+        "value": round(dt, 3), "unit": "s/step",
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "n_params_b": round(n_params / 1e9, 2),
+        "loss_first_to_last": [round(l0, 3), round(l_final, 3)],
+        "init_s": round(init_s, 1),
+        "param_memory": sorted(kinds),
+        "config": f"GPT-3 {n_params/1e9:.2f}B bf16 (H={cfg.hidden_size}, "
+                  f"L={cfg.num_layers}, heads={cfg.num_heads}, "
+                  f"vocab={cfg.vocab_size}), seq {seq}, batch {batch}; "
+                  "params+moments in pinned_host, streamed per block "
+                  "fwd+bwd, update fused into backward",
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", choices=["2.85b", "6.7b"], default="2.85b")
+    args = ap.parse_args()
+    import jax
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    if args.size == "2.85b":
+        run_moments_offload(on_tpu)
+    else:
+        run_param_stream(on_tpu)
 
 
 if __name__ == "__main__":

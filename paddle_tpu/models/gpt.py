@@ -36,7 +36,8 @@ from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
 
 __all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
            "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
-           "build_hybrid_train_step"]
+           "build_hybrid_train_step", "split_streamed_params",
+           "init_streamed_params", "streamed_fns"]
 
 
 @dataclasses.dataclass
@@ -286,32 +287,54 @@ def _vocab_parallel_ce(logits_local, labels, mp_axis: str = "mp",
     return jnp.where(valid, loss, 0.0), valid
 
 
+def dense_embed(params, tokens, cfg: GPTConfig):
+    """Token+position embedding over the embed sub-tree {wte, wpe}."""
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][None, :tokens.shape[1]]
+    return x.astype(cfg.dtype)
+
+
+def dense_block(p, x, cfg: GPTConfig):
+    """One transformer block on an UNstacked per-layer param tree — shared
+    by the scan in dense_forward and the param-streaming trainer."""
+    B, S, H = x.shape
+    h = _ln(x, p["ln1_g"], p["ln1_b"])
+    qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+           + p["qkv_b"].astype(cfg.dtype))
+    qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
+    # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
+    # composition elsewhere — same math as the hybrid engine's
+    attn = F.scaled_dot_product_attention(
+        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
+        is_causal=True)
+    out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
+    x = x + out + p["proj_b"].astype(cfg.dtype)
+    h = _ln(x, p["ln2_g"], p["ln2_b"])
+    m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+         + p["fc1_b"].astype(cfg.dtype))
+    m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
+    return x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+
+
+def dense_head_loss(params, x, labels, cfg: GPTConfig):
+    """Final LN + LM head + logsumexp CE over the head sub-tree
+    {lnf_g, lnf_b, head_w}. Identical math to dense_loss's tail."""
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = (x.astype(cfg.dtype)
+              @ params["head_w"].astype(cfg.dtype)).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
+
+
 def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
     """Single-device forward over the stacked-parameter pytree (no
     collectives). Same math/layout as the hybrid engine — head-major QKV.
     remat=True checkpoints each block (recompute in backward) — the memory/
     FLOPs trade that keeps long-sequence training inside HBM."""
-    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][None, :tokens.shape[1]]
-    x = x.astype(cfg.dtype)
+    x = dense_embed(params, tokens, cfg)
 
     def block(p, x):
-        B, S, H = x.shape
-        h = _ln(x, p["ln1_g"], p["ln1_b"])
-        qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
-               + p["qkv_b"].astype(cfg.dtype))
-        qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
-        # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
-        # composition elsewhere — same math as the hybrid engine's
-        attn = F.scaled_dot_product_attention(
-            qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
-            is_causal=True)
-        out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
-        x = x + out + p["proj_b"].astype(cfg.dtype)
-        h = _ln(x, p["ln2_g"], p["ln2_b"])
-        m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
-             + p["fc1_b"].astype(cfg.dtype))
-        m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-        return x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+        return dense_block(p, x, cfg)
 
     blk = jax.checkpoint(block) if remat else block
 
@@ -330,6 +353,76 @@ def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True):
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return jnp.mean(lse - picked)
+
+
+# ---------------------------------------------------------------------------
+# Param-streaming (bigger-than-HBM) form: segmented params
+# ---------------------------------------------------------------------------
+def split_streamed_params(params, cfg: GPTConfig):
+    """Stacked hybrid tree → segmented {embed, blocks: [per-layer], head}
+    layout for the param-streaming trainer (small models / tests — a
+    bigger-than-HBM model must use init_streamed_params instead, which
+    never materializes the whole tree on device)."""
+    blocks = [jax.tree.map(lambda a: a[i], params["blocks"])
+              for i in range(cfg.num_layers)]
+    return {
+        "embed": {"wte": params["wte"], "wpe": params["wpe"]},
+        "blocks": blocks,
+        "head": {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+                 "head_w": params["head_w"]},
+    }
+
+
+def init_streamed_params(cfg: GPTConfig, key, park=lambda t: t):
+    """Segmented init that materializes ONE segment on device at a time,
+    parking each through `park` (pinned_host placement) before the next is
+    generated — a whole-tree init of a 6.7B model would OOM HBM before the
+    first step ran. Same distributions as init_hybrid_params."""
+    H, L, FF, V = (cfg.hidden_size, cfg.num_layers, cfg.ffn_hidden,
+                   cfg.vocab_size)
+    std, pd = 0.02, cfg.param_dtype
+    k_embed, k_head, *k_blocks = jax.random.split(key, 2 + L)
+
+    def nrm(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(pd)
+
+    @jax.jit
+    def one_block(key):
+        ks = jax.random.split(key, 4)
+        return {
+            "ln1_g": jnp.ones((H,), pd), "ln1_b": jnp.zeros((H,), pd),
+            "qkv_w": nrm(ks[0], (H, 3 * H)), "qkv_b": jnp.zeros((3 * H,), pd),
+            "proj_w": nrm(ks[1], (H, H), std / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((H,), pd),
+            "ln2_g": jnp.ones((H,), pd), "ln2_b": jnp.zeros((H,), pd),
+            "fc1_w": nrm(ks[2], (H, FF)), "fc1_b": jnp.zeros((FF,), pd),
+            "fc2_w": nrm(ks[3], (FF, H), std / math.sqrt(2 * L)),
+            "fc2_b": jnp.zeros((H,), pd),
+        }
+
+    @jax.jit
+    def embed_init(key):
+        k1, k2 = jax.random.split(key)
+        return {"wte": nrm(k1, (V, H)), "wpe": nrm(k2, (cfg.max_seq_len, H))}
+
+    @jax.jit
+    def head_init(key):
+        return {"lnf_g": jnp.ones((H,), pd), "lnf_b": jnp.zeros((H,), pd),
+                "head_w": nrm(key, (H, V))}
+
+    return {
+        "embed": park(embed_init(k_embed)),
+        "blocks": [park(one_block(k)) for k in k_blocks],
+        "head": park(head_init(k_head)),
+    }
+
+
+def streamed_fns(cfg: GPTConfig):
+    """(embed_fn, block_fn, head_loss_fn) for
+    build_param_streamed_train_step — the same math as dense_loss."""
+    return (lambda p, tokens: dense_embed(p, tokens, cfg),
+            lambda p, x: dense_block(p, x, cfg),
+            lambda p, x, labels: dense_head_loss(p, x, labels, cfg))
 
 
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,

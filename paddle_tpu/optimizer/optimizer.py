@@ -94,15 +94,14 @@ class Optimizer:
         slots = _tree_map(lambda p: self._init_slot(p), params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
 
-    def apply(self, params, grads, state, lr=None):
-        """Pure update: returns (new_params, new_state). jit/pjit-safe."""
-        lr = self.get_lr() if lr is None else lr
-        step = state["step"] + 1
-        if self._grad_clip is not None:
-            grads = self._grad_clip(grads)
+    def _apply_leaves(self, params, grads, slots, lr, step, offset=None):
+        """Per-leaf update loop shared by apply() and the param-streaming
+        tier (distributed/sharding/param_stream.py). `offset`: traced base
+        leaf index decorrelating the stochastic-rounding rng streams when
+        the loop is split across multiple jitted programs."""
         leaves_p, treedef = jax.tree.flatten(params)
         leaves_g = treedef.flatten_up_to(grads)
-        leaves_s = treedef.flatten_up_to(state["slots"])
+        leaves_s = treedef.flatten_up_to(slots)
         rng_base = None
         if getattr(self, "_needs_update_rng", False):
             # per-step, per-leaf keys for stochastic rounding of
@@ -117,14 +116,25 @@ class Optimizer:
                 new_s.append(s)
                 continue
             if rng_base is not None:
+                idx = i if offset is None else offset + i
                 np_, ns_ = self._update(p, g, s, lr, step,
-                                        rng=jax.random.fold_in(rng_base, i))
+                                        rng=jax.random.fold_in(rng_base, idx))
             else:
                 np_, ns_ = self._update(p, g, s, lr, step)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
-                {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
+                jax.tree.unflatten(treedef, new_s))
+
+    def apply(self, params, grads, state, lr=None):
+        """Pure update: returns (new_params, new_state). jit/pjit-safe."""
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        new_p, new_slots = self._apply_leaves(params, grads, state["slots"],
+                                              lr, step)
+        return new_p, {"step": step, "slots": new_slots}
 
     # -- weight decay helpers ------------------------------------------------
     def _decay_coeff(self) -> float:

@@ -150,6 +150,109 @@ def test_sharded_offload_fallback_for_name_aware_optimizers():
     np.testing.assert_allclose(run(False), run(True), rtol=0, atol=1e-6)
 
 
+class TestParamStreaming:
+    """Per-block PARAM streaming (VERDICT r3 #1): params live in
+    pinned_host, stream through HBM one block at a time fwd+bwd, update
+    fused into the backward. Reference: group_sharded_stage3.py:85 param
+    slicing + gather-on-use + release + offload."""
+
+    def _jobs(self):
+        from paddle_tpu.models import gpt as G
+        cfg = G.gpt_tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        cfg.dropout = 0.0
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 64)))
+        labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 64)))
+        params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+        return cfg, params, tokens, labels
+
+    def test_streamed_matches_dense_training(self):
+        from paddle_tpu.distributed.sharding.param_stream import (
+            build_param_streamed_train_step)
+        from paddle_tpu.models import gpt as G
+
+        cfg, params, tokens, labels = self._jobs()
+
+        # dense golden: whole-tree jit step
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        state = opt.init_state(params)
+        jstep = jax.jit(lambda p, s, t, y: (
+            *opt.apply(p, jax.grad(
+                lambda p_: G.dense_loss(p_, t, y, cfg))(p), s, 1e-3),
+            G.dense_loss(p, t, y, cfg)))
+        dense_losses = []
+        for _ in range(3):
+            params2, state, l = jstep(params, state, tokens, labels)
+            dense_losses.append(float(l))
+            params = params2
+
+        # streamed: same init, segmented layout
+        cfg2, params, tokens, labels = self._jobs()
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-3)
+        place, init_state, step = build_param_streamed_train_step(
+            *G.streamed_fns(cfg2), opt2)
+        hp = place(G.split_streamed_params(params, cfg2))
+        hs = init_state(hp)
+        stream_losses = []
+        for _ in range(3):
+            hp, hs, l = step(hp, hs, tokens, labels, 1e-3)
+            stream_losses.append(float(l))
+
+        np.testing.assert_allclose(stream_losses, dense_losses,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_streamed_params_live_on_host(self):
+        from paddle_tpu.distributed.sharding.param_stream import (
+            build_param_streamed_train_step)
+        from paddle_tpu.models import gpt as G
+
+        cfg, params, tokens, labels = self._jobs()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        place, init_state, step = build_param_streamed_train_step(
+            *G.streamed_fns(cfg), opt)
+        hp = place(G.split_streamed_params(params, cfg))
+        hs = init_state(hp)
+        hp, hs, _ = step(hp, hs, tokens, labels, 1e-3)
+        for tree in (hp, hs["slots"]):
+            kinds = {leaf.sharding.memory_kind
+                     for leaf in jax.tree.leaves(tree)}
+            assert kinds == {"pinned_host"}, kinds
+
+    def test_streamed_init_never_builds_full_tree(self):
+        from paddle_tpu.distributed.sharding.param_stream import park
+        from paddle_tpu.models import gpt as G
+
+        cfg = G.gpt_tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        hp = G.init_streamed_params(cfg, jax.random.PRNGKey(0), park=park)
+        assert len(hp["blocks"]) == cfg.num_layers
+        kinds = {leaf.sharding.memory_kind for leaf in jax.tree.leaves(hp)}
+        assert kinds == {"pinned_host"}, kinds
+        # shapes match the split of the stacked init
+        ref = G.split_streamed_params(
+            G.init_hybrid_params(cfg, jax.random.PRNGKey(0)), cfg)
+        assert (jax.tree.map(lambda a: a.shape, hp)
+                == jax.tree.map(lambda a: a.shape, ref))
+
+    def test_streamed_rejects_grad_clip_and_custom_apply(self):
+        import pytest as _pytest
+        from paddle_tpu.distributed.sharding.param_stream import (
+            build_param_streamed_train_step)
+        from paddle_tpu.models import gpt as G
+        from paddle_tpu import nn
+
+        cfg = G.gpt_tiny()
+        with _pytest.raises(NotImplementedError, match="grad clip"):
+            build_param_streamed_train_step(
+                *G.streamed_fns(cfg),
+                paddle.optimizer.AdamW(
+                    1e-3, grad_clip=nn.ClipGradByGlobalNorm(1.0)))
+        with _pytest.raises(NotImplementedError, match="_init_slot"):
+            build_param_streamed_train_step(
+                *G.streamed_fns(cfg),
+                paddle.optimizer.Lars(1e-3,
+                                      exclude_from_weight_decay=["w"]))
+
+
 def test_leaf_streamable_gate():
     from paddle_tpu.distributed.sharding.group_sharded import (
         _leaf_streamable)
