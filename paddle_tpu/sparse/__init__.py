@@ -160,12 +160,104 @@ def reshape(x, shape, name=None):
     return jnp.reshape(x, shape)
 
 
+def _linearize(indices, shape):
+    """[nnz, nd] coordinate rows -> scalar keys (row-major)."""
+    mult = np.cumprod([1] + [int(s) for s in shape[::-1]][:-1])[::-1]
+    return indices @ jnp.asarray(mult.copy(), indices.dtype)
+
+
+def _delinearize(keys, shape):
+    """Scalar keys -> [m, nd] coordinate rows (row-major)."""
+    cols = []
+    for s in [int(s) for s in shape[::-1]]:
+        cols.append(keys % s)
+        keys = keys // s
+    return jnp.stack(cols[::-1], axis=1).astype(jnp.int32)
+
+
+def _aligned_union(a, b):
+    """Coalesced union merge of two same-shape COO tensors: returns
+    (union_indices [m, nd], va, vb, in_a, in_b) with zero fill on the
+    absent side — the O(nnz log nnz) COO merge the reference's
+    elementwise kernels walk (paddle/phi/kernels/sparse/
+    elementwise_kernel.h Merge). Eager-only: the union size is
+    data-dependent, exactly like the reference's out_nnz."""
+    from ..enforce import enforce_eq
+    enforce_eq(tuple(a.shape), tuple(b.shape),
+               f"sparse elementwise shape mismatch: {tuple(a.shape)} vs "
+               f"{tuple(b.shape)}", op="sparse.elementwise")
+    a, b = coalesce(a), coalesce(b)
+    dt = jnp.result_type(a.data.dtype, b.data.dtype)
+    ka = _linearize(a.indices, a.shape)
+    kb = _linearize(b.indices, b.shape)
+    keys = jnp.unique(jnp.concatenate([ka, kb]))
+    m = keys.shape[0]
+    pa = jnp.searchsorted(keys, ka)
+    pb = jnp.searchsorted(keys, kb)
+    va = jnp.zeros((m,), dt).at[pa].set(a.data.astype(dt))
+    vb = jnp.zeros((m,), dt).at[pb].set(b.data.astype(dt))
+    in_a = jnp.zeros((m,), bool).at[pa].set(True)
+    in_b = jnp.zeros((m,), bool).at[pb].set(True)
+    return _delinearize(keys, a.shape), va, vb, in_a, in_b
+
+
+def _sample_at(x_sparse, dense):
+    """dense values gathered at the sparse operand's coordinates."""
+    idx = x_sparse.indices
+    return dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+
+
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    """Reduction over a sparse tensor (dense result, reference
-    sparse.sum semantics)."""
-    d = to_dense(x)
-    out = jnp.sum(d, axis=axis, keepdims=keepdim)
-    return out.astype(dtype) if dtype is not None else out
+    """Reduction over a sparse tensor via segment ops on the stored
+    values — the dense array is never built (reference:
+    paddle/phi/kernels/sparse/cpu/sum_kernel.cc; returns a sparse
+    tensor like paddle.sparse.sum, shape [1] for axis=None)."""
+    if not is_sparse(x):
+        out = jnp.sum(jnp.asarray(x), axis=axis, keepdims=keepdim)
+        return out.astype(dtype) if dtype is not None else out
+    xc = coalesce(x)
+    vals = xc.data
+    if dtype is None and vals.dtype in (jnp.bool_, jnp.int32):
+        # reference promotes bool/int32 sums to int64; under 32-bit jax
+        # that truncates back, so promote only when x64 is live
+        dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    if axis is None:
+        total = jnp.sum(vals, dtype=dtype)[None]
+        shape = (1,) * x.ndim if keepdim else (1,)
+        idx = jnp.zeros((1, len(shape)), jnp.int32)
+        return jsparse.BCOO((total, idx), shape=shape)
+    axes = sorted({int(a) % x.ndim
+                   for a in (axis if isinstance(axis, (list, tuple))
+                             else [axis])})
+    kept = [d for d in range(x.ndim) if d not in axes]
+    kept_shape = [int(x.shape[d]) for d in kept]
+    key = (_linearize(xc.indices[:, kept], kept_shape) if kept
+           else jnp.zeros((xc.nse,), jnp.int32))
+    ukeys, inv = jnp.unique(key, return_inverse=True)
+    out_vals = jax.ops.segment_sum(vals.astype(dtype) if dtype else vals,
+                                   inv.reshape(-1),
+                                   num_segments=int(ukeys.shape[0]))
+    kept_idx = _delinearize(ukeys, kept_shape) if kept else \
+        jnp.zeros((int(ukeys.shape[0]), 0), jnp.int32)
+    if keepdim:
+        cols = []
+        k = 0
+        for d in range(x.ndim):
+            if d in axes:
+                cols.append(jnp.zeros((kept_idx.shape[0],), jnp.int32))
+            else:
+                cols.append(kept_idx[:, k])
+                k += 1
+        out_idx = jnp.stack(cols, axis=1)
+        out_shape = tuple(1 if d in axes else int(x.shape[d])
+                          for d in range(x.ndim))
+    else:
+        out_idx = kept_idx
+        out_shape = tuple(kept_shape)
+        if not kept:
+            out_idx = jnp.zeros((1, 1), jnp.int32)
+            out_shape = (1,)
+    return jsparse.BCOO((out_vals, out_idx), shape=out_shape)
 
 
 def softmax(x, axis=-1, name=None):
@@ -192,17 +284,48 @@ def subtract(a, b, name=None):
 
 
 def multiply(a, b, name=None):
-    """Elementwise; sparse*sparse multiplies on the union pattern via the
-    dense fallback (XLA fuses), sparse*scalar scales values."""
+    """Elementwise multiply ON THE PATTERN — never densified:
+    sparse*sparse computes on the INTERSECTION of the two patterns (the
+    product is zero anywhere either operand is an implicit zero);
+    sparse*dense samples the dense at the sparse coordinates;
+    sparse*scalar scales values. Reference:
+    paddle/phi/kernels/sparse/elementwise_kernel.h."""
     if is_sparse(a) and jnp.isscalar(b):
         return jsparse.BCOO((a.data * b, a.indices), shape=a.shape)
-    return to_sparse_coo(to_dense(a) * to_dense(b))
+    if jnp.isscalar(a) and is_sparse(b):
+        return jsparse.BCOO((a * b.data, b.indices), shape=b.shape)
+    if is_sparse(a) and is_sparse(b):
+        idx, va, vb, in_a, in_b = _aligned_union(a, b)
+        both = in_a & in_b
+        return jsparse.BCOO(((va * vb)[both], idx[both]), shape=a.shape)
+    if is_sparse(a):
+        return jsparse.BCOO((a.data * _sample_at(a, jnp.asarray(b)),
+                             a.indices), shape=a.shape)
+    if is_sparse(b):
+        return jsparse.BCOO((_sample_at(b, jnp.asarray(a)) * b.data,
+                             b.indices), shape=b.shape)
+    return jnp.asarray(a) * jnp.asarray(b)
 
 
 def divide(a, b, name=None):
+    """Elementwise divide ON THE PATTERN: sparse/sparse computes on the
+    UNION of the patterns (a-only positions -> a/0 = ±inf, b-only ->
+    0/b = 0, matching dense semantics at every stored coordinate).
+    Positions in NEITHER pattern stay implicit zeros — dense math calls
+    those 0/0 = nan; the reference's CPU kernel expands the divisor to
+    the full coordinate space to store them (elementwise_kernel.cc
+    is_divide b_full_index), which is a dense-sized result in sparse
+    clothing. We keep the union contract instead; densify explicitly if
+    nan-at-empty semantics are needed."""
     if is_sparse(a) and jnp.isscalar(b):
         return jsparse.BCOO((a.data / b, a.indices), shape=a.shape)
-    return to_sparse_coo(to_dense(a) / to_dense(b))
+    if is_sparse(a) and is_sparse(b):
+        idx, va, vb, _, _ = _aligned_union(a, b)
+        return jsparse.BCOO((va / vb, idx), shape=a.shape)
+    if is_sparse(a):
+        return jsparse.BCOO((a.data / _sample_at(a, jnp.asarray(b)),
+                             a.indices), shape=a.shape)
+    return jnp.asarray(a) / to_dense(b)
 
 
 def mv(x, vec, name=None):
