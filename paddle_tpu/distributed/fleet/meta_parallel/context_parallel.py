@@ -238,8 +238,10 @@ def _tile_bwd(q3, k3, v3, out3, lse, do3, causal, scale, h, h_kv, vma):
     from ....kernels.pallas import flash_attention as _fa
     if not _fa._interpret():
         blk = _fa._pick_block(q3.shape[1])
-        return _fa._bwd_impl(q3, k3, v3, out3, lse, do3, scale, causal,
-                             blk, blk, h=h, h_kv=h_kv, vma=vma)
+        dq, dk, dv, _ = _fa._bwd_impl(q3, k3, v3, out3, lse, do3, scale,
+                                      causal, blk, blk, h=h, h_kv=h_kv,
+                                      vma=vma)
+        return dq, dk, dv
     kv_shape = k3.shape
     if h_kv != h:
         k3 = _expand_kv(k3, h, h_kv)

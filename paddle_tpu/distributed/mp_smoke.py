@@ -88,7 +88,8 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
     return results
 
 
-def run_training(mesh, steps: int = 4, return_params: bool = False):
+def run_training(mesh, steps: int = 4, return_params: bool = False,
+                 num_microbatches: int = 1, schedule: str = "1F1B"):
     """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
     pp / mp); every process computes identical host inputs. The ONE copy of
     the parity workload — the launcher golden, the spawned workers and the
@@ -104,7 +105,8 @@ def run_training(mesh, steps: int = 4, return_params: bool = False):
     params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
     opt = paddle.optimizer.AdamW(learning_rate=1e-2)
     step, shard_params, init_state = G.build_hybrid_train_step(
-        cfg, mesh, opt, num_microbatches=1)
+        cfg, mesh, opt, num_microbatches=num_microbatches,
+        schedule=schedule)
     params = shard_params(params)
     state = init_state(params)
     rng = np.random.RandomState(0)
@@ -118,6 +120,18 @@ def run_training(mesh, steps: int = 4, return_params: bool = False):
     return (losses, params) if return_params else losses
 
 
+# mode -> (mesh dims builder, microbatches, schedule). "dpmp" is the hybrid
+# dp-across-processes layout; the pp modes put the PIPELINE axis on the
+# process boundary — each stage lives on its own process and the 1F1B/ZBH1
+# ppermute hops cross it, the reference's dominant multi-node integration
+# (fleet/meta_parallel/pp_utils/p2p_communication.py:570 cross-node p2p).
+_MODES = {
+    "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B"),
+    "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B"),
+    "ppzbh1": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "ZBH1"),
+}
+
+
 def main():
     from . import env as dist_env
     from .topology import build_mesh
@@ -125,18 +139,31 @@ def main():
     dist_env.init_parallel_env()
     import jax
 
+    mode = os.environ.get("MPSMOKE_MODE", "dpmp")
+    dims_of, M, schedule = _MODES[mode]
     n = len(jax.devices())
-    mesh = build_mesh({"dp": 2, "pp": 1, "mp": n // 2})
-    # hybrid-layout invariant: mp intra-process, dp across processes
-    assert len({d.process_index for d in mesh.devices[0, 0, :]}) == 1
-    assert (mesh.devices[0, 0, 0].process_index
-            != mesh.devices[1, 0, 0].process_index)
-    losses = run_training(mesh)
+    mesh = build_mesh(dims_of(n))
+    ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
+    dev = np.moveaxis(mesh.devices,
+                      (ax["dp"], ax["pp"], ax["mp"]), (0, 1, 2))
+    if mode == "dpmp":
+        # hybrid-layout invariant: mp intra-process, dp across processes
+        assert len({d.process_index for d in dev[0, 0, :]}) == 1
+        assert dev[0, 0, 0].process_index != dev[1, 0, 0].process_index
+    else:
+        # pp across the PROCESS boundary: each stage entirely on one
+        # process, stages on different processes
+        for s in range(2):
+            assert len({d.process_index for d in dev[0, s, :]}) == 1, mode
+        assert dev[0, 0, 0].process_index != dev[0, 1, 0].process_index
+    losses = run_training(mesh, num_microbatches=M, schedule=schedule)
     print("MPSMOKE " + json.dumps(
-        {"rank": jax.process_index(), "losses": losses}), flush=True)
+        {"rank": jax.process_index(), "mode": mode, "losses": losses}),
+        flush=True)
 
 
-def spawn_and_check(n_devices: int, golden, timeout: float = 300.0) -> None:
+def spawn_and_check(n_devices: int, golden, timeout: float = 300.0,
+                    mode: str = "dpmp") -> None:
     """Spawn the 2-process cluster (n_devices/2 virtual CPU devices per
     process) and assert its loss curve matches `golden` (the single-process
     run of `run_training` on the same mesh shape)."""
@@ -144,12 +171,21 @@ def spawn_and_check(n_devices: int, golden, timeout: float = 300.0) -> None:
     results = spawn_cluster(
         [sys.executable, "-m", "paddle_tpu.distributed.mp_smoke"],
         nproc=2, devices_per_proc=n_devices // 2, sentinel="MPSMOKE ",
-        timeout=timeout)
+        timeout=timeout, extra_env={"MPSMOKE_MODE": mode})
     for res in results:
         if not np.allclose(res["losses"], golden, rtol=0, atol=5e-5):
             raise AssertionError(
-                f"2-process loss curve {res['losses']} != "
+                f"2-process ({mode}) loss curve {res['losses']} != "
                 f"single-process {golden}")
+
+
+def golden_for(n_devices: int, mode: str = "dpmp", devices=None):
+    """Single-process golden loss curve for a spawn mode (same mesh dims,
+    same schedule, one process)."""
+    from .topology import build_mesh
+    dims_of, M, schedule = _MODES[mode]
+    mesh = build_mesh(dims_of(n_devices), devices=devices)
+    return run_training(mesh, num_microbatches=M, schedule=schedule)
 
 
 if __name__ == "__main__":

@@ -49,16 +49,20 @@ def _leaf_streamable(optimizer) -> bool:
     apply() threads names (AdamW apply_decay_param_fun, Lars
     exclude_from_weight_decay) or restructures state (GradientMerge) must
     run their own apply."""
-    from ...optimizer.optimizer import AdamW, Optimizer
+    from ...optimizer.optimizer import Adam, AdamW, Optimizer
 
     if not hasattr(optimizer, "_init_slot"):
         return False
     cls_apply = type(optimizer).apply
     if cls_apply is Optimizer.apply:
         return True
+    if cls_apply is Adam.apply:
+        # Adam.apply only adds the fused multi-tensor dispatch — the
+        # per-leaf _update math is unchanged (covers Adam/NAdam/RAdam)
+        return True
     if (isinstance(optimizer, AdamW) and cls_apply is AdamW.apply
             and getattr(optimizer, "_apply_decay_param_fun", None) is None):
-        return True  # AdamW.apply falls through to the base loop
+        return True  # AdamW.apply falls through to the base/fused loop
     return False
 
 

@@ -147,7 +147,11 @@ def _mask_scores(s, i, j, *, block_q, block_k, causal, offset, window,
         if right is not None:
             masked = _or(masked, kpos > qpos + offset + right)
     if qseg is not None:
-        masked = _or(masked, qseg[:, None] != kseg[None, :])
+        # qseg arrives as a [bq, 1] COLUMN (sublane-major, pre-broadcast
+        # outside the kernel); kseg as a [bk] lane vector. A [bq]
+        # lane-vector qseg here would need an in-tile cross-lane
+        # transpose — measured 8x slower bwd at BERT shapes.
+        masked = _or(masked, qseg != kseg[None, :])
     if fm_start is not None:
         masked = _or(masked, (qpos >= fm_start[None, :])
                      & (qpos < fm_end[None, :]))
@@ -228,7 +232,8 @@ def _mask_ref_args(masks):
     bias_ref, qseg_ref, kseg_ref, fms_ref, fme_ref = masks
     return dict(
         bias=bias_ref[0, 0] if bias_ref is not None else None,
-        qseg=qseg_ref[0, 0] if qseg_ref is not None else None,
+        # [bq, 1] column slice of the lane-broadcast q-side ids
+        qseg=qseg_ref[0][:, :1] if qseg_ref is not None else None,
         kseg=kseg_ref[0, 0] if kseg_ref is not None else None,
         fm_start=fms_ref[0, 0] if fms_ref is not None else None,
         fm_end=fme_ref[0, 0] if fme_ref is not None else None)
@@ -345,15 +350,16 @@ def _build_specs(*, grid_kind, h, h_kv, g, nq, block_q, block_k, d,
             (1, 1, block_q, block_k),
             _bias_index(fwd_grid, bias_shape, h, h_kv, g, nq)))
     if has_seg:
-        # segment ids ride as [B, 1, S]: block (1, 1, block) keeps the
-        # second-to-last block dim equal to the array dim (TPU tiling rule)
+        # q-side ids ride lane-BROADCAST as [B, Sq, LANES] (the lse/delta
+        # pattern) so the kernel reads a sublane-major [bq, 1] column with
+        # no in-tile transpose; k-side ids ride lane-major [B, 1, Sk]
         if fwd_grid:
-            qidx = lambda b, i, j: (b // h, 0, i)
+            qidx = lambda b, i, j: (b // h, i, 0)
             kidx = lambda b, i, j: (b // h, 0, j)
         else:
-            qidx = lambda bkv, j, t: (bkv // h_kv, 0, t % nq)
+            qidx = lambda bkv, j, t: (bkv // h_kv, t % nq, 0)
             kidx = lambda bkv, j, t: (bkv // h_kv, 0, j)
-        tail.append(pl.BlockSpec((1, 1, block_q), qidx))
+        tail.append(pl.BlockSpec((1, block_q, _LANES), qidx))
         tail.append(pl.BlockSpec((1, 1, block_k), kidx))
     if has_fm:
         # flashmask arrays ride flattened as [B*Hm, 1, Sk] (same tiling rule)
@@ -384,11 +390,14 @@ def _sds(shape, dtype, vma=None):
 
 
 def _prep_mask_operands(qseg, kseg, fm_start, fm_end):
-    """Reshape mask operands to their kernel ride layouts ([B,1,S] segments,
-    [B*Hm,1,Sk] flashmask) — shared by _fwd and _bwd_impl."""
+    """Reshape mask operands to their kernel ride layouts (q segments
+    lane-broadcast [B,Sq,LANES], k segments [B,1,Sk], flashmask
+    [B*Hm,1,Sk]) — shared by _fwd and _bwd_impl."""
     fm_mh = None
     if qseg is not None:
-        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
+        qseg = jnp.broadcast_to(qseg[:, :, None],
+                                (*qseg.shape, _LANES))
+        kseg = kseg[:, None, :]
     if fm_start is not None:
         fm_mh = fm_start.shape[1]
         fm_start = fm_start.reshape(-1, 1, fm_start.shape[-1])
@@ -544,12 +553,17 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
 
 def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
-               num_k, has_bias, has_seg, has_fm, dropout_p):
+               num_k, has_bias, has_seg, has_fm, dropout_p,
+               bias_grad=False):
     seed_ref, main, masks, rest = _unpack_refs(
         refs, n_main=6, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
         dropout_p=dropout_p)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = main
-    dq_ref, dq_sc = rest
+    if bias_grad:
+        dq_ref, db_ref, dq_sc = rest
+    else:
+        dq_ref, dq_sc = rest
+        db_ref = None
 
     b = pl.program_id(0)
     i = pl.program_id(1)  # q block
@@ -561,6 +575,13 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
     run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
                      offset=offset, window=window)
+
+    if bias_grad:
+        @pl.when(jnp.logical_not(run))
+        def _zero_db():
+            # block-skipped tiles (outside causal/window bands) still own
+            # their slice of the dbias output — make it zeros, not garbage
+            db_ref[0] = jnp.zeros_like(db_ref[0])
 
     @pl.when(run)
     def _compute():
@@ -585,7 +606,13 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
             keep = _dropout_keep(seed_ref, b, i, j, pl.num_programs(1),
                                   num_k, p.shape, dropout_p)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        # dS without the sm_scale factor IS the additive-bias gradient
+        # (s = qk*scale + bias): emit it per tile — every mask/dropout
+        # effect is already inside p/dp, so dbias composes with all of them
+        ds_raw = p * (dp - delta[:, None])
+        if bias_grad:
+            db_ref[0] = ds_raw.astype(db_ref.dtype)
+        ds = ds_raw * sm_scale
         dq_sc[:] += jax.lax.dot_general(
             ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -600,7 +627,8 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
 def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
               h, h_kv, bias=None, qseg=None, kseg=None, fm_start=None,
-              fm_end=None, window=None, dropout_p=0.0, seed=None, vma=None):
+              fm_end=None, window=None, dropout_p=0.0, seed=None, vma=None,
+              bias_grad=False):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     g = h // h_kv
@@ -668,21 +696,38 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
         grid_kind="dq", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
         has_fm=has_fm, dropout_p=dropout_p, fm_mh=fm_mh)
-    dq = pl.pallas_call(
+    emit_db = bias_grad and bias is not None
+    dq_ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    dq_oshape = _sds((bh, sq, d), q.dtype, vma)
+    if emit_db:
+        # in-kernel dbias: each (b, i, j) tile writes its slice of the
+        # full-resolution [B*H, Sq, Sk] gradient once (fp32); broadcast
+        # bias shapes reduce OUTSIDE the kernel. Strictly cheaper than the
+        # old composed recompute (no second QK/PV matmul pass) and composes
+        # with dropout/segments/window/flashmask since ds already does.
+        out_specs = [dq_ospec, pl.BlockSpec((1, block_q, block_k),
+                                            lambda b, i, j: (b, i, j))]
+        out_shape = [dq_oshape, _sds((bh, sq, sk), jnp.float32, vma)]
+    else:
+        out_specs, out_shape = dq_ospec, dq_oshape
+    res = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
             window=window, block_q=block_q, block_k=block_k, num_k=nk,
             has_bias=bias is not None, has_seg=has_seg, has_fm=has_fm,
-            dropout_p=dropout_p),
+            dropout_p=dropout_p, bias_grad=emit_db),
         grid=(bh, nq, nk),
         in_specs=head + [qspec2, kspec2, kspec2, qspec2, rspec2, rspec2]
         + tail,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=_sds((bh, sq, d), q.dtype, vma),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)
-    return dq, dk, dv
+    if emit_db:
+        dq, db_full = res
+        return dq, dk, dv, db_full
+    return res, dk, dv, None
 
 
 # ---------------------------------------------------------------------------
@@ -746,62 +791,34 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
     if is_fm:
         bias, fm_start, fm_end = bias
     do = _prep(g)
-    dq, dk, dv = _bwd_impl(
+    dq, dk, dv, db_full = _bwd_impl(
         q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, h=h,
         h_kv=h_kv, bias=bias, qseg=q_seg, kseg=kv_seg, fm_start=fm_start,
-        fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed)
+        fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed,
+        bias_grad=bias_grad)
     dbias = None
     if bias is not None:
         if bias_grad:
-            db = _dbias_composed(q, k, v, out, lse, do, bias, sm_scale,
-                                 causal, h, h_kv, b)
+            # in-kernel dbias: the dq kernel emitted the full-resolution
+            # [B*H, Sq, Sk] dS; reduce to the (possibly broadcast) bias
+            # shape here
+            ds = db_full.reshape(b, h, *db_full.shape[-2:])
+            if bias.shape[0] == 1:
+                ds = ds.sum(axis=0, keepdims=True)
+            if bias.shape[1] == 1:
+                ds = ds.sum(axis=1, keepdims=True)
+            db = ds.astype(bias.dtype)
             dbias = (db, jnp.zeros_like(fm_start),
                      jnp.zeros_like(fm_end)) if is_fm else db
         else:
             # constant-mask contract (padding masks, flashmask rows) — the
             # reference flash kernels likewise emit no mask gradient. Pass
-            # bias_grad=True for a LEARNED bias (composed O(S^2) recompute).
+            # bias_grad=True for a LEARNED bias (in-kernel dS emission).
             dbias = jax.tree_util.tree_map(jnp.zeros_like,
                                            (bias, fm_start, fm_end)
                                            if is_fm else bias)
     return (_unprep(dq, b, h), _unprep(dk, b, h_kv), _unprep(dv, b, h_kv),
             dbias, None, None, None)
-
-
-def _dbias_composed(q, k, v, out, lse, do, bias, sm_scale, causal, h, h_kv,
-                    b):
-    """Additive-bias gradient, recomputed composed (one O(S^2) fp32 score
-    pass — the cost the in-kernel path avoids; only taken on request).
-    Restrictions: plain bias only, no dropout/segments (callers gate)."""
-    if h_kv != h:
-        batch = k.shape[0] // h_kv
-        g = h // h_kv
-        k = jnp.repeat(k.reshape(batch, h_kv, *k.shape[1:]), g,
-                       axis=1).reshape(batch * h, *k.shape[1:])
-        v = jnp.repeat(v.reshape(batch, h_kv, *v.shape[1:]), g,
-                       axis=1).reshape(batch * h, *v.shape[1:])
-    sq, sk = q.shape[1], k.shape[1]
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    s = s + bias.astype(jnp.float32).reshape(-1, *bias.shape[-2:])         if bias.shape[0] * bias.shape[1] == s.shape[0] else         s + jnp.broadcast_to(
-            bias.astype(jnp.float32),
-            (b, h, sq, sk)).reshape(b * h, sq, sk)
-    if causal:
-        cm = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
-        s = jnp.where(cm[None], s, -1e30)
-    p = jnp.exp(s - lse[..., None])
-    p = jnp.where((lse <= -1e29)[..., None], 0.0, p)
-    do32 = do.astype(jnp.float32)
-    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
-    dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
-    ds = p * (dp - delta[..., None])  # [b*h, sq, sk]
-    ds = ds.reshape(b, h, sq, sk)
-    # reduce to the (possibly broadcast) bias shape
-    if bias.shape[0] == 1:
-        ds = ds.sum(axis=0, keepdims=True)
-    if bias.shape[1] == 1:
-        ds = ds.sum(axis=1, keepdims=True)
-    return ds.astype(bias.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -859,14 +876,6 @@ def flash_attention(query, key, value, causal=False, sm_scale=None,
         fm_start, fm_end = startend_row_indices
         packed_bias = (bias, fm_start.astype(jnp.int32),
                        fm_end.astype(jnp.int32))
-    if bias_grad and (dropout_p > 0 or q_segment_ids is not None
-                      or window is not None
-                      or startend_row_indices is not None):
-        raise NotImplementedError(
-            "bias_grad=True (learned additive bias) supports only the "
-            "plain/causal bias form — the composed dbias recompute does "
-            "not model dropout, segments, windows or flashmask rows; "
-            "compose attention manually for those combinations")
     return _flash(query, key, value, packed_bias, q_segment_ids,
                   kv_segment_ids, dropout_seed, bool(causal), scale, bq, bk,
                   window, float(dropout_p), bool(bias_grad))

@@ -161,9 +161,17 @@ def reshape(x, shape, name=None):
 
 
 def _linearize(indices, shape):
-    """[nnz, nd] coordinate rows -> scalar keys (row-major)."""
+    """[nnz, nd] coordinate rows -> scalar keys (row-major). Keys use the
+    widest available integer; without jax x64 a shape whose element count
+    exceeds int32 cannot be keyed — raise instead of silently wrapping."""
+    numel = int(np.prod([int(s) for s in shape])) if len(shape) else 1
+    if numel > np.iinfo(np.int32).max and not jax.config.jax_enable_x64:
+        raise OverflowError(
+            f"sparse merge over shape {tuple(shape)} needs int64 linear "
+            "keys; enable jax_enable_x64")
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     mult = np.cumprod([1] + [int(s) for s in shape[::-1]][:-1])[::-1]
-    return indices @ jnp.asarray(mult.copy(), indices.dtype)
+    return indices.astype(dt) @ jnp.asarray(mult.copy(), dt)
 
 
 def _delinearize(keys, shape):
@@ -202,7 +210,10 @@ def _aligned_union(a, b):
 
 
 def _sample_at(x_sparse, dense):
-    """dense values gathered at the sparse operand's coordinates."""
+    """dense values gathered at the sparse operand's coordinates (the
+    dense side is broadcast to the sparse shape first, so lower-rank and
+    0-d operands keep numpy broadcasting semantics)."""
+    dense = jnp.broadcast_to(jnp.asarray(dense), tuple(x_sparse.shape))
     idx = x_sparse.indices
     return dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
 
@@ -215,6 +226,11 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     if not is_sparse(x):
         out = jnp.sum(jnp.asarray(x), axis=axis, keepdims=keepdim)
         return out.astype(dtype) if dtype is not None else out
+    if isinstance(x.data, jax.core.Tracer):
+        raise TypeError(
+            "sparse.sum is eager-only (the output nnz is data-dependent, "
+            "like the reference kernel's out_nnz) — call it outside jit, "
+            "or densify explicitly with to_dense(x) first")
     xc = coalesce(x)
     vals = xc.data
     if dtype is None and vals.dtype in (jnp.bool_, jnp.int32):
@@ -325,7 +341,10 @@ def divide(a, b, name=None):
     if is_sparse(a):
         return jsparse.BCOO((a.data / _sample_at(a, jnp.asarray(b)),
                              a.indices), shape=a.shape)
-    return jnp.asarray(a) / to_dense(b)
+    # dense / sparse is dense everywhere (x/0 = inf at every implicit
+    # zero) — inherently a dense-sized result; keep the sparse return
+    # type for API continuity
+    return jsparse.BCOO.fromdense(jnp.asarray(a) / to_dense(b))
 
 
 def mv(x, vec, name=None):

