@@ -797,8 +797,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
         fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed,
         bias_grad=bias_grad)
     dbias = None
-    if bias is not None:
-        if bias_grad:
+    if bias is not None or is_fm:
+        if bias_grad and bias is not None:
             # in-kernel dbias: the dq kernel emitted the full-resolution
             # [B*H, Sq, Sk] dS; reduce to the (possibly broadcast) bias
             # shape here
@@ -813,7 +813,9 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
         else:
             # constant-mask contract (padding masks, flashmask rows) — the
             # reference flash kernels likewise emit no mask gradient. Pass
-            # bias_grad=True for a LEARNED bias (in-kernel dS emission).
+            # bias_grad=True for a LEARNED bias (in-kernel dS emission);
+            # flashmask rows are integer indices and always get zeros, so
+            # bias_grad with flashmask-but-no-bias degrades to that.
             dbias = jax.tree_util.tree_map(jnp.zeros_like,
                                            (bias, fm_start, fm_end)
                                            if is_fm else bias)

@@ -132,6 +132,11 @@ def swiglu(x, y=None):
 
 
 def softmax(x, axis=-1, dtype=None):
+    from ...enforce import enforce
+    nd = getattr(x, "ndim", 0)
+    enforce(-max(nd, 1) <= axis < max(nd, 1),
+            f"softmax axis {axis} out of range for rank-{nd} input",
+            op="softmax", axis=axis, x=x)
     if dtype is not None:
         x = x.astype(dtype)
     else:
@@ -159,8 +164,11 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
 
 
 def maxout(x, groups, axis=1):
+    from ...enforce import enforce
     c = x.shape[axis]
-    assert c % groups == 0
+    enforce(c % groups == 0,
+            f"maxout: channels {c} not divisible by groups {groups}",
+            op="maxout", x=x, groups=groups)
     new_shape = list(x.shape)
     new_shape[axis] = c // groups
     new_shape.insert(axis + 1, groups)

@@ -24,8 +24,14 @@ def linear(x, weight, bias=None, name=None):
     contraction dims multiples of 128 for best tiling."""
     del name
     from ...amp.auto_cast import white_cast
+    from ...enforce import enforce
     x, weight, bias = white_cast("linear", x, weight, bias)
     w = jnp.asarray(weight)
+    enforce(w.ndim == 2 and getattr(x, "ndim", 0) >= 1
+            and x.shape[-1] == w.shape[0],
+            f"linear: x{tuple(getattr(x, 'shape', ()))} @ "
+            f"W{tuple(w.shape)} — last dim of x must equal W's in dim",
+            op="linear", x=x, weight=w)
     out = jnp.matmul(x, w)
     if bias is not None:
         out = out + jnp.asarray(bias)

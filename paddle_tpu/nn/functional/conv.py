@@ -39,7 +39,10 @@ def _padding(padding, n):
         return [(p, p) for p in padding]
     if len(padding) == 2 * n:
         return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
-    raise ValueError(f"bad padding: {padding}")
+    from ...enforce import enforce
+    enforce(False, f"padding {padding!r} is not an int, a length-{n} or "
+            f"length-{2 * n} list, pairs, or SAME/VALID", op=f"conv{n}d",
+            padding=padding)
 
 
 def _dim_numbers(ndim_spatial, data_format):
@@ -63,8 +66,24 @@ def _dim_numbers(ndim_spatial, data_format):
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
     from ...amp.auto_cast import white_cast
+    from ...enforce import enforce
     x, weight, bias = white_cast(f"conv{n}d", x, weight, bias)
     w = jnp.asarray(weight)
+    op = f"conv{n}d"
+    enforce(getattr(x, "ndim", 0) == n + 2,
+            f"{op} input must be rank {n + 2} ({data_format}), got rank "
+            f"{getattr(x, 'ndim', 0)}", op=op, x=x)
+    enforce(w.ndim == n + 2,
+            f"{op} weight must be rank {n + 2} [out_c, in_c/groups, "
+            f"*spatial], got rank {w.ndim}", op=op, weight=w)
+    c_in = x.shape[-1] if data_format.endswith("C") else x.shape[1]
+    enforce(w.shape[1] * groups == c_in,
+            f"{op}: input channels {c_in} != weight in_c/groups "
+            f"{w.shape[1]} * groups {groups}", op=op, x=x, weight=w,
+            groups=groups)
+    enforce(w.shape[0] % groups == 0,
+            f"{op}: out_channels {w.shape[0]} not divisible by groups "
+            f"{groups}", op=op, weight=w, groups=groups)
     stride = _ntuple(stride, n)
     dilation = _ntuple(dilation, n)
     pad = _padding(padding, n)
@@ -110,9 +129,11 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     opad = _ntuple(output_padding, n)
     pad = _padding(padding, n)
     if isinstance(pad, str):
-        pad = [(0, 0)] * n if pad == "VALID" else None
-        if pad is None:
-            raise ValueError("SAME padding unsupported for conv_transpose")
+        from ...enforce import UnimplementedError, enforce
+        enforce(pad == "VALID",
+                "SAME padding unsupported for conv_transpose",
+                error=UnimplementedError, op=f"conv{n}d_transpose")
+        pad = [(0, 0)] * n
     dn = _dim_numbers(n, data_format)
     # gradient-of-conv formulation: lhs_dilation = stride
     trans_pad = []

@@ -199,8 +199,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             banned = ((lt & (rows >= col(0)) & (rows < col(1)))
                       | (ut & (rows >= col(2)) & (rows < col(3))))
         else:
-            raise ValueError(
-                f"startend_row_indices last dim must be 1, 2 or 4, got {C}")
+            from ...enforce import enforce_in
+            enforce_in(C, (1, 2, 4),
+                       f"startend_row_indices last dim must be 1, 2 or 4, "
+                       f"got {C}", op="flashmask_attention")
         mask = ~banned
     if causal:
         cm = jnp.tril(jnp.ones((S, Sk), bool), Sk - S)[None, None]

@@ -36,11 +36,26 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     (numerics match the reference's hard/soft label + ignore_index + weight
     surface)."""
     del name
+    from ...enforce import enforce, enforce_in
+    enforce_in(reduction, ("mean", "sum", "none"), op="cross_entropy",
+               reduction=reduction)
+    enforce(getattr(input, "ndim", 0) >= 1,
+            "cross_entropy needs logits with a class axis",
+            op="cross_entropy", input=input)
     logits = input.astype(jnp.float32)
     logp = None  # soft/prob paths only: [. , V]-sized, materialized lazily
 
     n_classes = input.shape[axis]
     label_arr = jnp.asarray(label)
+    if (jnp.issubdtype(label_arr.dtype, jnp.integer)
+            and not soft_label):
+        squeeze_ok = (label_arr.ndim == input.ndim
+                      and label_arr.shape[axis] == 1)
+        enforce(label_arr.ndim == input.ndim - 1 or squeeze_ok,
+                f"hard labels must have the logits shape minus the class "
+                f"axis: logits {tuple(input.shape)}, labels "
+                f"{tuple(label_arr.shape)}", op="cross_entropy",
+                input=input, label=label_arr)
     # hard float labels of shape [..., 1] (paddle's standard label shape)
     # must NOT be mistaken for soft distributions — require a full class dim
     looks_soft = (not jnp.issubdtype(label_arr.dtype, jnp.integer)

@@ -41,7 +41,9 @@ def calculate_gain(nonlinearity: str, param=None):
         return math.sqrt(2.0 / (1 + neg ** 2))
     if nonlinearity == "selu":
         return 3.0 / 4
-    raise ValueError(f"unknown nonlinearity {nonlinearity}")
+    from ..enforce import enforce
+    enforce(False, f"unknown nonlinearity {nonlinearity!r}",
+            op="calculate_gain", nonlinearity=nonlinearity)
 
 
 class Initializer:
@@ -63,7 +65,10 @@ class Assign(Initializer):
 
     def __call__(self, shape, dtype=jnp.float32):
         v = jnp.asarray(self.value, dtype=dtype)
-        assert tuple(v.shape) == tuple(shape), f"Assign shape {v.shape} != {shape}"
+        from ..enforce import enforce_eq
+        enforce_eq(tuple(v.shape), tuple(shape),
+                   f"Assign initializer shape {tuple(v.shape)} != param "
+                   f"shape {tuple(shape)}", op="initializer.Assign")
         return v
 
 

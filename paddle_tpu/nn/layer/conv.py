@@ -25,7 +25,10 @@ class _ConvNd(Layer):
                  dilation, groups, padding_mode, weight_attr, bias_attr,
                  data_format, dims, transpose=False, output_padding=0):
         super().__init__()
-        assert in_channels % groups == 0
+        from ...enforce import enforce
+        enforce(groups > 0 and in_channels % groups == 0,
+                f"in_channels {in_channels} not divisible by groups "
+                f"{groups}", op=type(self).__name__, groups=groups)
         self.in_channels = in_channels
         self.out_channels = out_channels
         self.kernel_size = _ntuple(kernel_size, dims)

@@ -51,7 +51,10 @@ class MultiHeadAttention(Layer):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
-        assert self.head_dim * num_heads == embed_dim
+        from ...enforce import enforce
+        enforce(self.head_dim * num_heads == embed_dim,
+                f"embed_dim {embed_dim} not divisible by num_heads "
+                f"{num_heads}", op="MultiHeadAttention")
         self.dropout = dropout
         self.need_weights = need_weights
         kdim = kdim or embed_dim

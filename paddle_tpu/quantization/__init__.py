@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from ..nn.layer.layers import Layer
 
 __all__ = ["fake_quant", "dequantize", "quantize_weights", "AbsmaxObserver",
-           "FakeQuant", "QuantConfig", "QAT", "PTQ"]
+           "HistObserver", "FakeQuant", "QuantConfig", "QAT", "PTQ"]
 
 
 def absmax_scale(x):
@@ -80,6 +80,57 @@ class AbsmaxObserver:
     @property
     def scale(self) -> float:
         return max(self._absmax, 1e-8)
+
+
+class HistObserver:
+    """Percentile histogram observer (reference:
+    quantization/observers/hist.py): accumulates a fixed-bin histogram of
+    |x| with range-doubling rebinning and picks the scale at the `percent`
+    quantile of observed mass — robust to the activation outliers an
+    absmax observer chases (one spike would otherwise blow up the scale
+    and crush resolution for the bulk)."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048,
+                 percent: float = 0.999):
+        import numpy as _np
+        self.bits = quant_bits
+        self.bins = bins
+        self.percent = percent
+        self._hist = _np.zeros(bins, _np.float64)
+        self._range = 0.0
+
+    def observe(self, x):
+        import numpy as _np
+        ax = _np.abs(_np.asarray(jax.device_get(x), _np.float32)).ravel()
+        top = float(ax.max()) if ax.size else 0.0
+        if top > self._range:
+            # double the range until it covers, merging bin pairs so the
+            # accumulated mass survives the re-binning
+            new_range = max(self._range, 1e-8)
+            while new_range < top:
+                new_range *= 2.0
+                half = self._hist.reshape(-1, 2).sum(axis=1)
+                self._hist = _np.concatenate(
+                    [half, _np.zeros(self.bins // 2)])
+            self._range = new_range
+        if self._range > 0 and ax.size:
+            h, _ = _np.histogram(ax, bins=self.bins,
+                                 range=(0.0, self._range))
+            self._hist += h
+        return x
+
+    @property
+    def scale(self) -> float:
+        import numpy as _np
+        total = self._hist.sum()
+        if total <= 0:
+            return 1e-8
+        c = _np.cumsum(self._hist) / total
+        idx = int(_np.searchsorted(c, self.percent))
+        return max((idx + 1) / self.bins * self._range, 1e-8)
+
+
+_OBSERVER_TYPES = {"abs_max": AbsmaxObserver, "hist": HistObserver}
 
 
 class FakeQuant(Layer):
@@ -158,34 +209,45 @@ class QAT:
         return out
 
 
-class PTQ:
-    """Post-training quantization: observe activations on calibration data,
-    then emit scales (reference: quantization/ptq.py)."""
+class _Observed(Layer):
+    def __init__(self, inner, name, observer):
+        super().__init__()
+        self.inner = inner
+        self._obs_name = name
+        self._observer = observer
 
-    def __init__(self, config: Optional[QuantConfig] = None):
+    def forward(self, x):
+        self._observer.observe(x)
+        return self.inner(x)
+
+
+class PTQ:
+    """Post-training quantization (reference: quantization/ptq.py):
+    `quantize(model)` wraps eligible layers with activation observers,
+    calibration forwards populate them, and `convert(model)` replaces each
+    observed Linear with a W8A8 QuantizedLinear whose ACTIVATION scale is
+    the calibrated one (static quantization — no per-call absmax at
+    deploy time). observer: "abs_max" or "hist" (percentile)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None,
+                 observer: str = "abs_max", **observer_kw):
+        from ..enforce import enforce_in
         self.config = config or QuantConfig()
-        self.observers: Dict[str, AbsmaxObserver] = {}
+        enforce_in(observer, set(_OBSERVER_TYPES), op="PTQ",
+                   observer=observer)
+        self._obs_cls = _OBSERVER_TYPES[observer]
+        self._obs_kw = observer_kw
+        self.observers: Dict[str, object] = {}
 
     def quantize(self, model: Layer) -> Layer:
-        ptq = self
-
-        class _Observed(Layer):
-            def __init__(self, inner, name):
-                super().__init__()
-                self.inner = inner
-                self._obs_name = name
-
-            def forward(self, x):
-                ptq.observers[self._obs_name].observe(x)
-                return self.inner(x)
-
         def convert(layer: Layer, prefix=""):
             for name, sub in list(layer._sub_layers.items()):
                 path = f"{prefix}.{name}" if prefix else name
                 if type(sub).__name__ in self.config.types:
-                    self.observers[path] = AbsmaxObserver(
-                        self.config.activation_bits)
-                    layer._sub_layers[name] = _Observed(sub, path)
+                    obs = self._obs_cls(self.config.activation_bits,
+                                        **self._obs_kw)
+                    self.observers[path] = obs
+                    layer._sub_layers[name] = _Observed(sub, path, obs)
                 else:
                     convert(sub, path)
             return layer
@@ -193,6 +255,28 @@ class PTQ:
 
     def scales(self) -> Dict[str, float]:
         return {k: o.scale for k, o in self.observers.items()}
+
+    def convert(self, model: Layer) -> Layer:
+        """Calibrated deploy conversion: every observed Linear becomes a
+        QuantizedLinear with static activation scale from its observer
+        (per-output-channel weight scales). Non-Linear observed layers are
+        unwrapped (their scales remain available via scales())."""
+        from ..nn.layer.common import Linear
+
+        def walk(layer: Layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, _Observed):
+                    inner = sub.inner
+                    if isinstance(inner, Linear):
+                        layer._sub_layers[name] = (
+                            QuantizedLinear.from_linear(
+                                inner, act_scale=sub._observer.scale))
+                    else:
+                        layer._sub_layers[name] = inner
+                else:
+                    walk(sub)
+            return layer
+        return walk(model)
 
 
 # ---------------------------------------------------------------------------
@@ -246,26 +330,39 @@ def qlinear(x, w_q, w_scale, bias=None, out_dtype=None):
 class QuantizedLinear(Layer):
     """Weight-only-storage / W8A8-compute linear (reference:
     fused int8 matmul kernels). Construct from a trained Linear via
-    from_linear(); weights live as int8 + per-output-channel scales."""
+    from_linear(); weights live as int8 + per-output-channel scales.
+    act_scale: optional STATIC activation scale (PTQ-calibrated) — when
+    absent, activations quantize dynamically per call (absmax)."""
 
-    def __init__(self, w_q, w_scale, bias=None):
+    def __init__(self, w_q, w_scale, bias=None, act_scale=None):
         super().__init__()
         self.register_buffer("w_q", w_q)
         self.register_buffer("w_scale", jnp.reshape(w_scale, (-1,)))
+        if act_scale is not None:
+            self.register_buffer("act_scale", jnp.asarray(act_scale))
+        else:
+            self.act_scale = None
         if bias is not None:
             self.register_buffer("bias", bias)
         else:
             self.bias = None
 
     @classmethod
-    def from_linear(cls, linear):
+    def from_linear(cls, linear, act_scale=None):
         w = jnp.asarray(linear.weight.value)  # [in, out]
         w_q, w_scale = quantize_to_int8(w, axis=1)
         b = (jnp.asarray(linear.bias.value)
              if getattr(linear, "bias", None) is not None else None)
-        return cls(w_q, w_scale, b)
+        return cls(w_q, w_scale, b, act_scale=act_scale)
 
     def forward(self, x):
+        if self.act_scale is not None:
+            x_q, _ = quantize_to_int8(x, scale=self.act_scale)
+            out = int8_matmul(x_q, self.w_q, self.act_scale, self.w_scale,
+                              out_dtype=jnp.float32)
+            if self.bias is not None:
+                out = out + self.bias.astype(jnp.float32)
+            return out.astype(x.dtype)
         return qlinear(x, self.w_q, self.w_scale, self.bias)
 
 

@@ -16,6 +16,7 @@ directly.
 from __future__ import annotations
 
 import builtins
+import operator
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -166,12 +167,39 @@ def astype(x, dtype):
     return cast(x, dtype)
 
 
+def _enf_axis(axis, ndim, op):
+    """Typed axis-range validation shared by the shape ops (reference:
+    PADDLE_ENFORCE axis checks in infermeta/unary.cc). NB: this module
+    shadows builtins (max/min/sum/all/any are paddle reduction ops)."""
+    from ..enforce import enforce
+    enforce(-ndim <= axis < builtins.max(ndim, 1),
+            f"axis {axis} out of range for rank-{ndim} tensor",
+            op=op, axis=axis, rank=ndim)
+
+
 def reshape(x, shape):
+    from ..enforce import enforce
+    x = jnp.asarray(x)
+    known = 1
+    minus_ones = 0
+    for s in shape:
+        if s == -1:
+            minus_ones += 1
+        else:
+            known *= int(s)
+    enforce(minus_ones <= 1,
+            f"reshape shape {tuple(shape)} has more than one -1",
+            op="reshape", shape=tuple(shape))
+    numel = int(np.prod(x.shape)) if x.ndim else 1
+    ok = ((numel % builtins.max(known, 1) == 0) if minus_ones
+          else (known == numel))
+    enforce(ok, f"cannot reshape {tuple(x.shape)} ({numel} elements) into "
+            f"{tuple(shape)}", op="reshape", x=x, shape=tuple(shape))
     return jnp.reshape(x, shape)
 
 
 def reshape_(x, shape):
-    return jnp.reshape(x, shape)
+    return reshape(x, shape)
 
 
 def flatten(x, start_axis=0, stop_axis=-1):
@@ -202,6 +230,17 @@ def unsqueeze(x, axis):
 
 
 def transpose(x, perm=None):
+    if perm is not None:
+        from ..enforce import enforce
+        x = jnp.asarray(x)
+        nd = x.ndim
+        entries = [int(p) for p in perm]
+        enforce(builtins.all(-nd <= p < nd for p in entries)
+                and builtins.sorted(p % builtins.max(nd, 1)
+                                    for p in entries)
+                == list(range(nd)),
+                f"perm {list(perm)} is not a permutation of rank "
+                f"{nd}", op="transpose", perm=list(perm), x=x)
     return jnp.transpose(x, axes=perm)
 
 
@@ -225,11 +264,12 @@ def expand(x, shape):
     # -1 keeps the corresponding (trailing-aligned) dim of x
     offset = len(shape) - x.ndim
     resolved = []
+    from ..enforce import enforce
     for i, s in enumerate(shape):
         if s == -1:
             src = i - offset
-            if src < 0:
-                raise ValueError(f"expand shape {shape}: -1 in a new leading dim")
+            enforce(src >= 0, f"expand shape {tuple(shape)}: -1 in a new "
+                    "leading dim", op="expand", shape=tuple(shape), x=x)
             resolved.append(x.shape[src])
         else:
             resolved.append(s)
@@ -245,7 +285,21 @@ def broadcast_shape(s1, s2):
 
 
 def concat(x: Sequence[Tensor], axis=0):
-    return jnp.concatenate(list(x), axis=axis)
+    from ..enforce import enforce
+    xs = [jnp.asarray(v) for v in x]
+    enforce(len(xs) > 0, "concat needs at least one tensor", op="concat")
+    try:
+        axis_i = operator.index(axis)  # python/numpy ints; not tracers
+    except TypeError:
+        axis_i = None
+    if axis_i is not None:
+        _enf_axis(axis_i, xs[0].ndim, "concat")
+    r0 = xs[0].ndim
+    for i, v in enumerate(xs[1:], 1):
+        enforce(v.ndim == r0,
+                f"concat input {i} has rank {v.ndim}, expected {r0}",
+                op="concat", input0=xs[0], mismatched=v)
+    return jnp.concatenate(xs, axis=axis)
 
 
 def stack(x: Sequence[Tensor], axis=0):
@@ -254,17 +308,25 @@ def stack(x: Sequence[Tensor], axis=0):
 
 def split(x, num_or_sections, axis=0):
     """Paddle semantics: sections are SIZES (may contain one -1), not indices."""
+    from ..enforce import enforce
+    x = jnp.asarray(x)
+    _enf_axis(int(axis), x.ndim, "split")
+    total = x.shape[axis]
     if isinstance(num_or_sections, int):
+        enforce(num_or_sections > 0 and total % num_or_sections == 0,
+                f"split into {num_or_sections} parts does not divide dim "
+                f"size {total} on axis {axis}", op="split", x=x,
+                num=num_or_sections, axis=axis)
         return jnp.split(x, num_or_sections, axis=axis)
     sizes = list(num_or_sections)
-    total = x.shape[axis]
     if -1 in sizes:
         known = builtins.sum(s for s in sizes if s != -1)
         sizes[sizes.index(-1)] = total - known
-    if builtins.sum(sizes) != total or builtins.any(s < 0 for s in sizes):
-        raise ValueError(
-            f"split sections {num_or_sections} do not sum to dim size {total} "
-            f"on axis {axis}")
+    enforce(builtins.sum(sizes) == total
+            and not builtins.any(s < 0 for s in sizes),
+            f"split sections {list(num_or_sections)} do not sum to dim "
+            f"size {total} on axis {axis}", op="split", x=x,
+            sections=list(num_or_sections), axis=axis)
     idx = np.cumsum(sizes)[:-1].tolist()
     return jnp.split(x, idx, axis=axis)
 
@@ -585,11 +647,19 @@ def multiplex(inputs, index):
 # ---------------------------------------------------------------------------
 def matmul(x, y, transpose_x=False, transpose_y=False):
     from ..amp.auto_cast import white_cast
+    from ..enforce import enforce
     x, y = white_cast("matmul", x, y)
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if transpose_y:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    kx = x.shape[-1] if x.ndim else None
+    ky = (y.shape[-2] if y.ndim > 1 else y.shape[-1]) if y.ndim else None
+    enforce(x.ndim >= 1 and y.ndim >= 1 and kx == ky,
+            f"matmul contraction mismatch: x{tuple(x.shape)} @ "
+            f"y{tuple(y.shape)} (K {kx} vs {ky}, after "
+            f"transpose_x={transpose_x}, transpose_y={transpose_y})",
+            op="matmul", x=x, y=y)
     return jnp.matmul(x, y)
 
 
@@ -1048,7 +1118,10 @@ def put_along_axis(x, indices, values, axis, reduce="assign"):
             indices.shape) for d in range(indices.ndim)]
         dim_idx[axis] = indices
         return x.at[tuple(dim_idx)].add(vals)
-    raise ValueError(f"unsupported reduce: {reduce}")
+    from ..enforce import enforce_in
+    enforce_in(reduce, ("assign", "add"),
+               f"unsupported reduce: {reduce!r} (assign/add implemented)",
+               op="put_along_axis")
 
 
 def index_select(x, index, axis=0):

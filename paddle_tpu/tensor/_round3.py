@@ -150,8 +150,9 @@ def as_strided(x, shape, stride, offset=0, name=None):
     views; this materializes the equivalent gather — same values, not the
     same memory."""
     del name
-    if not shape:
-        raise ValueError("as_strided needs a non-empty shape")
+    from ..enforce import enforce
+    enforce(bool(shape), "as_strided needs a non-empty shape",
+            op="as_strided", shape=tuple(shape))
     x = jnp.asarray(x).reshape(-1)
     grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
     flat = offset + sum(g * s for g, s in zip(grids, stride))
@@ -167,10 +168,10 @@ def reduce_as(x, target, name=None):
     while x.ndim > len(tshape):
         x = x.sum(axis=0)
     bad = [(a, b) for a, b in zip(x.shape, tshape) if a != b and b != 1]
-    if bad or x.ndim != len(tshape):
-        raise ValueError(
+    from ..enforce import enforce
+    enforce(not bad and x.ndim == len(tshape),
             f"reduce_as: shape {x.shape} does not reduce to {tshape} "
-            f"(target dims must match or be 1)")
+            f"(target dims must match or be 1)", op="reduce_as", x=x)
     axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, tshape))
                  if a != b and b == 1)
     if axes:

@@ -15,8 +15,24 @@ if os.environ.get("PADDLE_TPU_TESTS") != "1":
     # baked in; force the CPU backend before any computation initializes it.
     force_virtual_cpu_devices(8)
 
+import time
+
 import numpy as np
 import pytest
+
+_SESSION_T0 = time.time()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Wall-time accounting per tier (VERDICT r3 #10): CI output states
+    what the tier actually cost, and README budgets come from here."""
+    del exitstatus
+    dt = time.time() - _SESSION_T0
+    expr = (getattr(config.option, "markexpr", "") or "")
+    tier = "fast (-m 'not slow')" if "not slow" in expr else (
+        "slow-only" if expr == "slow" else "full")
+    terminalreporter.write_line(
+        f"[paddle_tpu] {tier} tier wall time: {dt / 60:.1f} min")
 
 
 def pytest_configure(config):
@@ -70,6 +86,17 @@ _SLOW_TESTS = {
     "test_blocks_recycled_across_many_requests",
     "test_static_batch_baseline_matches_generate",
     "test_ring_attention_gqa_grad_parity",
+    # round 4 (fast tier re-budgeted to <= 10 min: the heaviest spawns and
+    # interpret-mode kernel tests move here; `pytest -m slow` is nightly)
+    "test_two_process_pipeline_parity",
+    "test_tp_sharded_decode_matches_generate",
+    "test_adaptive_burst_frees_slots_early",
+    "test_static_batch_mixed_prompt_lengths",
+    "test_flash_bias_grad_with_dropout_and_window",
+    "test_flash_bias_grad_broadcast_shapes",
+    "test_flash_learned_bias_grad",
+    "test_streamed_matches_dense_training",
+    "test_ptq_calibrated_gpt_matches_fp",
 }
 
 

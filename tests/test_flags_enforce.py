@@ -325,3 +325,54 @@ def test_enforce_error_types_inherit_python_types():
     assert issubclass(enforce.OutOfRangeError, IndexError)
     assert issubclass(enforce.UnimplementedError, NotImplementedError)
     assert issubclass(enforce.ExecutionTimeoutError, TimeoutError)
+
+
+def test_public_api_raises_typed_contextual_errors():
+    """VERDICT r3 #5: the top public ops validate shapes/axes through the
+    enforce taxonomy — typed errors with op + tensor context, not bare
+    ValueErrors."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x23 = jnp.zeros((2, 3))
+
+    def check(fn, *frags):
+        with pytest.raises(enforce.InvalidArgumentError) as ei:
+            fn()
+        msg = str(ei.value)
+        assert "[InvalidArgument]" in msg
+        for f in frags:
+            assert f in msg, (f, msg)
+
+    # 1 matmul: contraction mismatch, names both operand shapes
+    check(lambda: paddle.matmul(x23, jnp.zeros((4, 5))),
+          "[operator: matmul]", "(2, 3)", "(4, 5)")
+    # 2 reshape: element-count mismatch
+    check(lambda: paddle.reshape(x23, (4, 2)), "[operator: reshape]",
+          "6 elements")
+    # 3 transpose: bad permutation
+    check(lambda: paddle.transpose(x23, (0, 0)), "[operator: transpose]")
+    # 4 concat: rank mismatch + empty input
+    check(lambda: paddle.concat([x23, jnp.zeros((2, 3, 1))]),
+          "[operator: concat]", "rank")
+    check(lambda: paddle.concat([]), "[operator: concat]")
+    # 5 split: sections don't sum
+    check(lambda: paddle.split(x23, [1, 4], axis=1), "[operator: split]",
+          "sum")
+    # 6 expand: -1 in a new leading dim
+    check(lambda: paddle.expand(x23, (-1, 2, 3)), "[operator: expand]")
+    # 7 linear: W in-dim mismatch
+    check(lambda: F.linear(x23, jnp.zeros((4, 5))), "[operator: linear]")
+    # 8 softmax: axis out of range
+    check(lambda: F.softmax(x23, axis=5), "[operator: softmax]", "axis 5")
+    # 9 cross_entropy: label shape mismatch
+    check(lambda: F.cross_entropy(jnp.zeros((4, 10)),
+                                  jnp.zeros((4, 2), jnp.int32)),
+          "[operator: cross_entropy]", "labels")
+    # 10 conv2d: channel/groups mismatch (typed, with both shapes)
+    check(lambda: F.conv2d(jnp.zeros((1, 3, 8, 8)),
+                           jnp.zeros((4, 5, 3, 3))),
+          "[operator: conv2d]", "channels")
+    # axis checks ride OutOfRange-compatible InvalidArgument too
+    check(lambda: paddle.split(x23, 2, axis=7), "[operator: split]")

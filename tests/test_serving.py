@@ -98,6 +98,68 @@ def test_oversized_request_rejected(params):
         eng.run()
 
 
+def test_tp_sharded_decode_matches_generate(params):
+    """Megatron-TP serving over an mp mesh axis (VERDICT r3 #8): sharded
+    qkv/proj/fc + head-sharded KV pools + vocab-parallel logits must
+    reproduce the single-device goldens exactly."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, CFG.vocab_size, (n,)) for n in (9, 14, 5)]
+    news = [6, 4, 8]
+    eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                        num_blocks=24, max_blocks_per_seq=8, chunk=8,
+                        mesh=mesh, mp_axis="mp")
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+    res = eng.run()
+    for rid, p, n in zip(rids, prompts, news):
+        assert res[rid] == golden(params, p, n), rid
+
+
+def test_adaptive_burst_frees_slots_early(params):
+    """With a queue waiting, the burst shortens to the earliest finisher
+    (power-of-two programs) so freed slots re-admit before the next
+    burst — total decode steps spent must shrink vs the fixed burst."""
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(0, CFG.vocab_size, (6,)) for _ in range(6)]
+    news = [2, 3, 2, 9, 2, 3]  # short finishers + queue pressure
+
+    def run(adaptive):
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            num_blocks=32, max_blocks_per_seq=8, chunk=8,
+                            decode_burst=8, adaptive_burst=adaptive)
+        rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+        while eng.has_work():
+            eng.step()
+        return eng.decode_microsteps
+
+    s_adaptive = run(adaptive=True)
+    s_fixed = run(adaptive=False)
+    # adaptive spends fewer DEVICE decode steps (it trades them for more
+    # dispatches — a win only when dispatch overhead is low, hence opt-in)
+    assert s_adaptive <= s_fixed
+    # and outputs still match goldens
+    eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                        num_blocks=32, max_blocks_per_seq=8, chunk=8,
+                        decode_burst=8, adaptive_burst=True)
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+    res = eng.run()
+    for rid, p, n in zip(rids, prompts, news):
+        assert res[rid] == golden(params, p, n), rid
+
+
+def test_static_batch_mixed_prompt_lengths(params):
+    """The static baseline buckets mixed-length prompts by length and pads
+    to the bucket max; equal-length groups still match goldens exactly."""
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, CFG.vocab_size, (n,)) for n in (8, 8, 12, 12)]
+    news = [5, 4, 6, 3]
+    outs = generate_static_batch(params, CFG, prompts, news, batch_size=2)
+    for p, n, o in zip(prompts, news, outs):
+        assert o == golden(params, p, n)
+
+
 def test_static_batch_baseline_matches_generate(params):
     rng = np.random.RandomState(5)
     prompts = [rng.randint(0, CFG.vocab_size, (8,)) for _ in range(4)]
