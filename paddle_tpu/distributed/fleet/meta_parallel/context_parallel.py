@@ -70,13 +70,6 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
     if impl == "auto":
         lanes_ok = S % 128 == 0 or jax.default_backend() == "cpu"
         tiled_ok = D <= 256 and lanes_ok and H % max(H_kv, 1) == 0
-        if not tiled_ok and H_kv != H:
-            raise ValueError(
-                f"GQA ring attention needs the tiled kernel but the shape "
-                f"can't take it (D={D} <= 256? S_local={S} % 128 == 0? "
-                f"H={H} % H_kv={H_kv} == 0?). Pad S_local to a 128 "
-                f"multiple, or repeat KV heads upstream and use "
-                f"impl='einsum'.")
         impl = "tiled" if tiled_ok else "einsum"
     if impl == "tiled":
         if H % max(H_kv, 1) != 0:
@@ -85,13 +78,11 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
                 f"(got q {H}, kv {H_kv})")
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
         return _ring_tiled(q, k, v, axis, bool(causal), float(scale))
-    if H_kv != H:
-        # einsum tier materializes [B,H,S,S] scores anyway; GQA rides the
-        # tiled tier — requiring an upstream repeat here would silently
-        # reintroduce the memory the ring exists to avoid
+    if H % max(H_kv, 1) != 0:
         raise ValueError(
-            "einsum ring attention does not support GQA (q heads "
-            f"{H} != kv heads {H_kv}); use impl='tiled'")
+            f"ring attention GQA needs q heads divisible by kv heads "
+            f"(got q {H}, kv {H_kv})")
+    g = H // H_kv  # grouped einsum handles GQA without repeating KV
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
@@ -116,8 +107,17 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
     def chunk_step(q32, k_blk, v_blk, src, m, l, acc, c):
         k_c = lax.dynamic_slice_in_dim(k_blk, c * chunk, chunk, axis=1)
         v_c = lax.dynamic_slice_in_dim(v_blk, c * chunk, chunk, axis=1)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_c,
-                       preferred_element_type=jnp.float32)  # [B,H,Sq,chunk]
+        if g > 1:
+            # GQA: grouped einsum — each kv head serves its g query heads
+            # via index sharing, never a repeated KV copy (round 4; the
+            # tier previously raised and forced the tiled path)
+            qr = q32.reshape(B, S, H_kv, g, D)
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qr, k_c,
+                           preferred_element_type=jnp.float32)
+            s = s.reshape(B, H, S, chunk)               # [B,H,Sq,chunk]
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_c,
+                           preferred_element_type=jnp.float32)
         idx = c * chunk + k_off
         valid = idx < S                                 # pad keys are dead
         if causal:
@@ -134,8 +134,14 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
         p = jnp.where(valid[None, None], p, 0.0)
         alpha = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - shift))
         l = l * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_c.dtype), v_c,
-                        preferred_element_type=jnp.float32)
+        if g > 1:
+            pr = p.reshape(B, H_kv, g, S, chunk).astype(v_c.dtype)
+            pv = jnp.einsum("bhgqk,bkhd->bqhgd", pr, v_c,
+                            preferred_element_type=jnp.float32)
+            pv = pv.reshape(B, S, H, D)
+        else:
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_c.dtype), v_c,
+                            preferred_element_type=jnp.float32)
         acc = acc * alpha.transpose(0, 2, 1)[..., None] + pv
         return m_new, l, acc
 

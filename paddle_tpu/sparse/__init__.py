@@ -230,7 +230,7 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
         raise TypeError(
             "sparse.sum is eager-only (the output nnz is data-dependent, "
             "like the reference kernel's out_nnz) — call it outside jit, "
-            "or densify explicitly with to_dense(x) first")
+            "or densify the input explicitly first")
     xc = coalesce(x)
     vals = xc.data
     if dtype is None and vals.dtype in (jnp.bool_, jnp.int32):

@@ -244,17 +244,24 @@ def test_ring_attention_gqa_grad_parity(causal):
                                    rtol=3e-4, atol=3e-4, err_msg=name)
 
 
-def test_ring_attention_einsum_rejects_gqa():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_einsum_gqa_parity(causal):
+    """round-4: the einsum tier handles GQA via grouped einsum (no KV
+    repeat) — parity vs the dense GQA golden (was a hard raise)."""
     mesh = sep_mesh(4)
     rng = np.random.RandomState(8)
-    q = jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
-    k = jnp.asarray(rng.randn(2, 32, 2, 8).astype(np.float32))
+    q = jnp.asarray(rng.randn(2, 64, 4, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 64, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 64, 2, 8).astype(np.float32))
     spec = P(None, "sep")
     f = shard_map(
-        functools.partial(ring_attention, axis="sep", impl="einsum"),
+        functools.partial(ring_attention, axis="sep", causal=causal,
+                          impl="einsum"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    with pytest.raises(ValueError, match="GQA"):
-        jax.jit(f)(q, k, k)
+    out = jax.jit(f)(q, k, v)
+    golden = full_attention_gqa(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_einsum_ring_odd_length_chunk_padding():
