@@ -67,21 +67,16 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
     """
     B, S, H, D = q.shape
     H_kv = k.shape[2]
-    if impl == "auto":
-        lanes_ok = S % 128 == 0 or jax.default_backend() == "cpu"
-        tiled_ok = D <= 256 and lanes_ok and H % max(H_kv, 1) == 0
-        impl = "tiled" if tiled_ok else "einsum"
-    if impl == "tiled":
-        if H % max(H_kv, 1) != 0:
-            raise ValueError(
-                f"ring attention GQA needs q heads divisible by kv heads "
-                f"(got q {H}, kv {H_kv})")
-        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
-        return _ring_tiled(q, k, v, axis, bool(causal), float(scale))
     if H % max(H_kv, 1) != 0:
         raise ValueError(
             f"ring attention GQA needs q heads divisible by kv heads "
             f"(got q {H}, kv {H_kv})")
+    if impl == "auto":
+        lanes_ok = S % 128 == 0 or jax.default_backend() == "cpu"
+        impl = "tiled" if D <= 256 and lanes_ok else "einsum"
+    if impl == "tiled":
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+        return _ring_tiled(q, k, v, axis, bool(causal), float(scale))
     g = H // H_kv  # grouped einsum handles GQA without repeating KV
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)

@@ -263,6 +263,18 @@ def test_ring_attention_einsum_gqa_parity(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
                                rtol=2e-4, atol=2e-4)
 
+    # gradient parity too (the tiled tier's GQA test asserts the same)
+    g_ring = jax.jit(jax.grad(
+        lambda q_, k_, v_: jnp.sum(f(q_, k_, v_) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q_, k_, v_: jnp.sum(full_attention_gqa(q_, k_, v_,
+                                                      causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-3, err_msg=nm)
+
 
 def test_einsum_ring_odd_length_chunk_padding():
     """round-3: the einsum tier is chunked (O(S_local x 512) scores).
