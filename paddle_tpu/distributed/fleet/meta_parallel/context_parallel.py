@@ -413,7 +413,13 @@ def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = False,
     """
     n = lax.axis_size(axis)
     B, S, H, D = q.shape
-    assert H % n == 0, f"heads {H} not divisible by axis size {n}"
+    H_kv = k.shape[2]
+    if H % n != 0 or H_kv % n != 0:
+        raise ValueError(
+            f"ulysses needs q heads ({H}) AND kv heads ({H_kv}) divisible "
+            f"by the axis size ({n}) — the all-to-all trades the sequence "
+            "shard for a head shard on both; repeat kv heads upstream or "
+            "use ring attention for H_kv < n")
 
     def to_heads(x):
         # split heads across ranks, gather the full sequence

@@ -1,10 +1,11 @@
 """paddle.text equivalent (reference: python/paddle/text/ — dataset
 wrappers + ViterbiDecoder backed by phi viterbi_decode kernels).
 
-The datasets in the reference are thin download helpers (out of scope on
-an air-gapped TPU host — use paddle_tpu.io.Dataset over local data); the
-real op is Viterbi decoding for CRF-style sequence labeling, implemented
-here as a lax.scan (jit/vmap/grad-safe).
+Round 4 adds the dataset parsers (datasets.py: UCIHousing/Imdb/Imikolov —
+the reference's file formats and preprocessing over LOCAL artifacts; this
+host has no egress so download=True without a data_file raises a typed
+UnavailableError). ViterbiDecoder is the CRF-decode op, a lax.scan
+(jit/vmap/grad-safe).
 """
 
 from __future__ import annotations
@@ -15,7 +16,11 @@ from jax import lax
 
 from ..nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from . import datasets  # noqa: E402
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: E402
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "UCIHousing",
+           "Imdb", "Imikolov"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

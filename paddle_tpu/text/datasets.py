@@ -1,0 +1,219 @@
+"""Text datasets (reference: python/paddle/text/datasets/{uci_housing,
+imdb,imikolov}.py) — the same file formats and preprocessing, loaded from
+LOCAL files.
+
+This build has no network egress, so `download=True` without a local
+`data_file` raises a typed UnavailableError naming the expected artifact
+instead of silently fetching; every parser consumes the reference's
+published archive layout (UCI whitespace table, aclImdb tar, PTB tar) so
+the official downloads drop in unchanged. Remaining reference tail
+(Conll05/Movielens/WMT14/WMT16) is consciously absent — egress-blocked
+corpora with task-specific vocab files; use local preprocessing + io.Dataset.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+def _need_file(data_file, download, name, url):
+    from ..enforce import UnavailableError, enforce
+    enforce(data_file is not None,
+            f"{name}: no network egress in this build — pass data_file= "
+            f"pointing at a local copy of the reference artifact ({url})",
+            error=UnavailableError, op=name, download=download)
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """UCI housing regression (reference: uci_housing.py). 14 whitespace-
+    separated columns; features normalized by (x - avg) / (max - min)
+    computed over the WHOLE file, 80/20 train/test split — byte-for-byte
+    the reference preprocessing."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        from ..enforce import enforce_in
+        mode = mode.lower()
+        enforce_in(mode, ("train", "test"), op="UCIHousing", mode=mode)
+        self.mode = mode
+        self.data_file = _need_file(
+            data_file, download, "UCIHousing",
+            "paddlemodels.bj.bcebos.com/uci_housing/housing.data")
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums, minimums, avgs = (data.max(0), data.min(0),
+                                    data.sum(0) / data.shape[0])
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1], np.float32),
+                np.array(row[-1:], np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: imdb.py): aclImdb tar layout; word dict
+    from the WHOLE corpus with `cutoff` frequency pruning, docs tokenized
+    by punctuation-stripped lowercase split, label 0=pos 1=neg (the
+    reference's convention)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        from ..enforce import enforce_in
+        mode = mode.lower()
+        enforce_in(mode, ("train", "test"), op="Imdb", mode=mode)
+        self.mode = mode
+        self.data_file = _need_file(
+            data_file, download, "Imdb",
+            "dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz")
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if bool(pattern.match(tf.name)):
+                    data.append(
+                        tarf.extractfile(tf).read().rstrip(b"\n\r")
+                        .translate(None,
+                                   string.punctuation.encode("latin-1"))
+                        .lower().split())
+                tf = tarf.next()
+        return data
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                word_freq[word] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words = [w for w, _ in dictionary]
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        pos = re.compile(rf"aclImdb/{self.mode}/pos/.*\.txt$")
+        neg = re.compile(rf"aclImdb/{self.mode}/neg/.*\.txt$")
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for doc in self._tokenize(pos):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(0)
+        for doc in self._tokenize(neg):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(1)
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx], np.int64),
+                np.array([self.labels[idx]], np.int64))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference: imikolov.py, preprocessing
+    mirrored exactly): simple-examples tar; dict from train+valid counts
+    with per-line <s>/<e> credit and strict `> min_word_freq` pruning,
+    <unk> reserved last; data_type 'NGRAM' (window_size-grams over
+    <s> line <e>) or 'SEQ' (src/trg shifted pairs, window_size caps
+    length)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        from ..enforce import enforce_in
+        mode = mode.lower()
+        enforce_in(mode, ("train", "test"), op="Imikolov", mode=mode)
+        data_type = data_type.upper()
+        enforce_in(data_type, ("NGRAM", "SEQ"), op="Imikolov",
+                   data_type=data_type)
+        self.mode = mode
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _need_file(
+            data_file, download, "Imikolov",
+            "dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tar.gz")
+        self.word_idx = self._build_work_dict(min_word_freq)
+        self._load_anno()
+
+    def _read_lines(self, path_suffix):
+        with tarfile.open(self.data_file) as tarf:
+            member = next(m for m in tarf.getmembers()
+                          if m.name.endswith(path_suffix))
+            return [l.decode().strip()
+                    for l in tarf.extractfile(member).read().splitlines()]
+
+    @staticmethod
+    def word_count(lines, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in lines:
+            for w in line.split():
+                word_freq[w] += 1
+            word_freq["<s>"] += 1
+            word_freq["<e>"] += 1
+        return word_freq
+
+    def _build_work_dict(self, cutoff):
+        word_freq = self.word_count(
+            self._read_lines("ptb.valid.txt"),
+            self.word_count(self._read_lines("ptb.train.txt")))
+        word_freq.pop("<unk>", None)  # reserved as the last index
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words = [w for w, _ in dictionary]
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for line in self._read_lines(f"ptb.{self.mode}.txt"):
+            if self.data_type == "NGRAM":
+                from ..enforce import enforce
+                enforce(self.window_size > -1, "Invalid gram length",
+                        op="Imikolov", window_size=self.window_size)
+                toks = ["<s>", *line.split(), "<e>"]
+                if len(toks) >= self.window_size:
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+            else:
+                ids = [self.word_idx.get(w, unk) for w in line.split()]
+                src = [self.word_idx["<s>"], *ids]
+                trg = [*ids, self.word_idx["<e>"]]
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue
+                self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

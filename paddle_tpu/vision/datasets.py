@@ -242,5 +242,122 @@ class FashionMNIST(MNIST):
     image_path/label_path at the fashion idx files)."""
 
 
+class Flowers(Dataset):
+    """Flowers102 (reference: vision/datasets/flowers.py — same archive
+    layout and the reference's swapped trnid/tstid convention): data_file
+    = 102flowers tgz (jpg/image_%05d.jpg), label_file = imagelabels.mat,
+    setid_file = setid.mat. Local files only (no egress)."""
+
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend="pil"):
+        import os
+        import tarfile
+
+        from ..enforce import UnavailableError, enforce, enforce_in
+        mode = mode.lower()
+        enforce_in(mode, ("train", "test", "valid"), op="Flowers",
+                   mode=mode)
+        enforce_in(backend, ("pil", "cv2"), op="Flowers", backend=backend)
+        enforce(data_file and label_file and setid_file,
+                "Flowers: no network egress in this build — pass "
+                "data_file/label_file/setid_file pointing at local copies "
+                "of 102flowers.tgz / imagelabels.mat / setid.mat",
+                error=UnavailableError, op="Flowers", download=download)
+        self.backend = backend
+        self.transform = transform
+        self.flag = self.MODE_FLAG_MAP[mode]
+
+        data_tar = tarfile.open(data_file)
+        self.data_path = data_file.replace(".tgz", "/")
+        if not os.path.exists(os.path.join(self.data_path, "jpg")):
+            os.makedirs(self.data_path, exist_ok=True)
+            data_tar.extractall(self.data_path)
+        import scipy.io as scio
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self.flag][0]
+
+    def __getitem__(self, idx):
+        import os
+
+        from PIL import Image
+        index = int(self.indexes[idx])
+        label = np.array([int(self.labels[index - 1])])
+        path = os.path.join(self.data_path, "jpg",
+                            "image_%05d.jpg" % index)
+        image = Image.open(path)
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype(np.int64)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference: vision/datasets/voc2012.py — same
+    VOCtrainval tar layout): (image, label-mask) pairs listed by
+    ImageSets/Segmentation/{train,val,trainval}.txt. Local tar only."""
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    MODE_FLAG_MAP = {"train": "train", "test": "val", "valid": "val",
+                     "trainval": "trainval"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="pil"):
+        import tarfile
+
+        from ..enforce import UnavailableError, enforce, enforce_in
+        mode = mode.lower()
+        enforce_in(mode, tuple(self.MODE_FLAG_MAP), op="VOC2012",
+                   mode=mode)
+        enforce_in(backend, ("pil", "cv2"), op="VOC2012", backend=backend)
+        enforce(data_file,
+                "VOC2012: no network egress in this build — pass "
+                "data_file= pointing at a local VOCtrainval tar",
+                error=UnavailableError, op="VOC2012", download=download)
+        self.backend = backend
+        self.transform = transform
+        self.flag = self.MODE_FLAG_MAP[mode]
+        self.data_tar = tarfile.open(data_file)
+        self.name2mem = {m.name: m for m in self.data_tar.getmembers()}
+        sets = self.data_tar.extractfile(
+            self.name2mem[self.SET_FILE.format(self.flag)])
+        self.data, self.labels = [], []
+        for line in sets:
+            name = line.decode("utf-8").strip()
+            if not name:
+                continue
+            self.data.append(self.DATA_FILE.format(name))
+            self.labels.append(self.LABEL_FILE.format(name))
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        data = self.data_tar.extractfile(
+            self.name2mem[self.data[idx]]).read()
+        label = self.data_tar.extractfile(
+            self.name2mem[self.labels[idx]]).read()
+        data = Image.open(_io.BytesIO(data))
+        label = Image.open(_io.BytesIO(label))
+        if self.backend == "cv2":
+            data, label = np.array(data), np.array(label)
+        if self.transform is not None:
+            data = self.transform(data)
+        return data, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+__all__ += ["Flowers", "VOC2012"]
+
 __all__ += ["DatasetFolder", "ImageFolder", "FashionMNIST",
             "IMG_EXTENSIONS"]

@@ -94,6 +94,31 @@ def test_ulysses_forward_parity(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_gqa_parity_and_gate():
+    """Ulysses with GQA: kv heads all-to-all over their own (smaller)
+    count when divisible by the axis; loud typed error when not."""
+    mesh = sep_mesh(2)
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(1, 32, 8, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 32, 4, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 32, 4, 8).astype(np.float32))
+    golden = full_attention_gqa(q, k, v, True)
+    out = run_sharded(
+        functools.partial(ulysses_attention, axis="sep", causal=True),
+        mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+
+    mesh8 = sep_mesh(8)
+    q8 = jnp.asarray(rng.randn(1, 64, 8, 8).astype(np.float32))
+    k8 = jnp.asarray(rng.randn(1, 64, 4, 8).astype(np.float32))
+    spec = P(None, "sep")
+    f = shard_map(functools.partial(ulysses_attention, axis="sep"),
+                  mesh=mesh8, in_specs=(spec, spec, spec), out_specs=spec)
+    with pytest.raises(ValueError, match="kv heads"):
+        jax.jit(f)(q8, k8, k8)  # 4 kv heads over 8 ranks
+
+
 def test_ulysses_grad_parity():
     mesh = sep_mesh(4)
     q, k, v = make_qkv(H=8)

@@ -140,3 +140,173 @@ def test_local_fs():
     fs.delete(sub)
     assert not fs.is_exist(sub)
     assert not fs.need_upload_download()
+
+
+# -- paddle.text datasets (round 4; reference file formats over local
+# artifacts — no egress, so tests synthesize the archives) ------------------
+def test_uci_housing_dataset(tmp_path):
+    from paddle_tpu.text import UCIHousing
+
+    rng = np.random.RandomState(0)
+    table = rng.rand(20, 14) * 10
+    f = tmp_path / "housing.data"
+    f.write_text("\n".join(" ".join(f"{v:.4f}" for v in row)
+                           for row in table))
+    tr = UCIHousing(data_file=str(f), mode="train")
+    te = UCIHousing(data_file=str(f), mode="test")
+    assert len(tr) == 16 and len(te) == 4  # 80/20 split
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features normalized by whole-file stats (the reference formula)
+    maxs, mins, avgs = table.max(0), table.min(0), table.mean(0)
+    np.testing.assert_allclose(
+        x, ((table[0, :13] - avgs[:13]) / (maxs[:13] - mins[:13]))
+        .astype(np.float32), rtol=3e-4, atol=1e-5)  # %.4f round trip
+    np.testing.assert_allclose(y, table[0, 13:14].astype(np.float32),
+                               rtol=3e-4)
+
+
+def test_imdb_dataset(tmp_path):
+    import io as _io
+    import tarfile
+    from paddle_tpu.text import Imdb
+
+    tar_path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"great movie, great fun!",
+        "aclImdb/train/neg/0_1.txt": b"terrible movie. boring",
+        "aclImdb/test/pos/0_10.txt": b"great great great",
+        "aclImdb/test/neg/0_2.txt": b"boring and terrible",
+    }
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, payload in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, _io.BytesIO(payload))
+    ds = Imdb(data_file=str(tar_path), mode="train", cutoff=0)
+    assert len(ds) == 2
+    # tokens are BYTES keys (the reference tokenizes raw tar bytes);
+    # '<unk>' is the one str key, reserved last
+    assert b"great" in ds.word_idx and "<unk>" in ds.word_idx
+    doc0, label0 = ds[0]
+    assert label0[0] == 0  # pos first, reference convention
+    # punctuation stripped: "great movie, great fun!" -> 4 tokens
+    assert doc0.shape == (4,)
+    assert doc0[0] == doc0[2] == ds.word_idx[b"great"]
+    _, label1 = ds[1]
+    assert label1[0] == 1
+
+
+def test_imikolov_dataset(tmp_path):
+    import io as _io
+    import tarfile
+    from paddle_tpu.text import Imikolov
+
+    tar_path = tmp_path / "simple-examples.tar.gz"
+    files = {
+        "./simple-examples/data/ptb.train.txt":
+            b"the cat sat\nthe dog sat\n",
+        "./simple-examples/data/ptb.valid.txt": b"the cat ran\n",
+        "./simple-examples/data/ptb.test.txt": b"the dog ran\n",
+    }
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, payload in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, _io.BytesIO(payload))
+
+    ds = Imikolov(data_file=str(tar_path), data_type="NGRAM",
+                  window_size=2, mode="train", min_word_freq=0)
+    # each train line: <s> w w w <e> -> 4 bigrams, 2 lines
+    assert len(ds) == 8
+    g = ds[0]
+    assert len(g) == 2 and g[0].shape == ()
+    assert int(g[0]) == ds.word_idx["<s>"]
+
+    seq = Imikolov(data_file=str(tar_path), data_type="SEQ",
+                   mode="test", min_word_freq=0)
+    src, trg = seq[0]
+    assert int(src[0]) == seq.word_idx["<s>"]
+    assert int(trg[-1]) == seq.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    # no egress: download-only construction raises the typed error
+    import pytest as _pytest
+    from paddle_tpu.enforce import UnavailableError
+    with _pytest.raises(UnavailableError, match="egress"):
+        Imikolov(download=True)
+
+
+def test_flowers_dataset(tmp_path):
+    import tarfile
+    import numpy as _np
+    import scipy.io as scio
+    from PIL import Image
+    from paddle_tpu.vision.datasets import Flowers
+
+    n = 6
+    src = tmp_path / "src"
+    (src / "jpg").mkdir(parents=True)
+    for i in range(1, n + 1):
+        Image.fromarray(
+            _np.full((8, 8, 3), i * 20, _np.uint8)).save(
+            src / "jpg" / ("image_%05d.jpg" % i))
+    tgz = tmp_path / "102flowers.tgz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(src / "jpg", arcname="jpg")
+    labels = tmp_path / "imagelabels.mat"
+    scio.savemat(labels, {"labels": _np.arange(1, n + 1)[None]})
+    setid = tmp_path / "setid.mat"
+    scio.savemat(setid, {"tstid": _np.array([[1, 3, 5]]),
+                         "trnid": _np.array([[2, 4]]),
+                         "valid": _np.array([[6]])})
+
+    tr = Flowers(data_file=str(tgz), label_file=str(labels),
+                 setid_file=str(setid), mode="train", backend="cv2")
+    te = Flowers(data_file=str(tgz), label_file=str(labels),
+                 setid_file=str(setid), mode="test", backend="cv2")
+    assert len(tr) == 3 and len(te) == 2  # reference's swapped flags
+    img, label = tr[0]
+    assert img.shape == (8, 8, 3) and label[0] == 1
+    img2, label2 = tr[1]
+    assert label2[0] == 3
+
+
+def test_voc2012_dataset(tmp_path):
+    import io as _io
+    import tarfile
+    import numpy as _np
+    from PIL import Image
+    from paddle_tpu.vision.datasets import VOC2012
+
+    def png_bytes(v, mode="RGB"):
+        buf = _io.BytesIO()
+        arr = (_np.full((8, 8, 3), v, _np.uint8) if mode == "RGB"
+               else _np.full((8, 8), v, _np.uint8))
+        Image.fromarray(arr).save(buf, format="PNG" if mode == "P" or
+                                  mode == "L" else "JPEG")
+        return buf.getvalue()
+
+    tar_path = tmp_path / "VOCtrainval.tar"
+    names = ["2007_000001", "2007_000002"]
+    with tarfile.open(tar_path, "w") as tf:
+        def add(name, payload):
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, _io.BytesIO(payload))
+        add("VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+            ("\n".join(names) + "\n").encode())
+        add("VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+            (names[0] + "\n").encode())
+        for nm in names:
+            add(f"VOCdevkit/VOC2012/JPEGImages/{nm}.jpg",
+                png_bytes(100, "RGB"))
+            add(f"VOCdevkit/VOC2012/SegmentationClass/{nm}.png",
+                png_bytes(1, "L"))
+
+    tr = VOC2012(data_file=str(tar_path), mode="train", backend="cv2")
+    va = VOC2012(data_file=str(tar_path), mode="valid", backend="cv2")
+    assert len(tr) == 2 and len(va) == 1
+    img, mask = tr[0]
+    assert img.shape == (8, 8, 3) and mask.shape == (8, 8)
+    assert int(mask[0, 0]) == 1
