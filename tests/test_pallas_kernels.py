@@ -660,3 +660,94 @@ def test_flash_bias_grad_with_dropout_and_window():
     g_wref = jax.grad(loss_ref_win)(bias)
     np.testing.assert_allclose(np.asarray(g_win), np.asarray(g_wref),
                                rtol=4e-4, atol=4e-4)
+
+
+# -- KPS portable primitives (round 4; reference paddle/phi/kernels/
+# primitive/ — SURVEY §2.2) ---------------------------------------------------
+def test_kps_elementwise_primitive():
+    from paddle_tpu.kernels.pallas.primitives import elementwise
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 256).astype(np.float32))
+    y = jnp.asarray(rng.randn(64, 256).astype(np.float32))
+    out = elementwise(lambda a, b: a * b + 1.0, x, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x * y + 1.0),
+                               rtol=1e-5, atol=1e-5)
+    # unary + 3-D view
+    x3 = jnp.asarray(rng.randn(4, 16, 128).astype(np.float32))
+    out3 = elementwise(jnp.tanh, x3)
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(jnp.tanh(x3)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_kps_row_reduce_primitive():
+    from paddle_tpu.kernels.pallas.primitives import row_reduce
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, 512).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(row_reduce(jnp.add, 0.0, x)),
+                               np.asarray(x).sum(-1), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(row_reduce(jnp.maximum, -np.inf, x)),
+        np.asarray(x).max(-1), rtol=1e-6)
+    # multi-tile column streaming + 3-D view
+    x3 = jnp.asarray(rng.randn(2, 8, 4096).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(row_reduce(jnp.add, 0.0, x3, block_cols=1024)),
+        np.asarray(x3).sum(-1), rtol=1e-4, atol=1e-4)
+    from paddle_tpu.enforce import InvalidArgumentError
+    with pytest.raises(InvalidArgumentError, match="lane"):
+        row_reduce(jnp.add, 0.0, jnp.ones((4, 100)))
+
+
+def test_kps_online_softmax_update():
+    from paddle_tpu.kernels.pallas.primitives import online_softmax_update
+
+    rng = np.random.RandomState(2)
+    s1 = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+    s2 = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+    v1 = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+    v2 = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+
+    m = jnp.full((8,), -1e30)
+    l = jnp.zeros((8,))
+    acc = jnp.zeros((8, 16))
+    m, l, acc, _ = online_softmax_update(s1, m, l, acc, v1)
+    m, l, acc, _ = online_softmax_update(s2, m, l, acc, v2)
+    out = acc / l[:, None]
+
+    s = jnp.concatenate([s1, s2], axis=1)
+    v = jnp.concatenate([v1, v2], axis=0)
+    ref = jax.nn.softmax(s, axis=-1) @ v
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kps_fused_layer_norm_fwd_bwd():
+    from paddle_tpu.kernels.pallas.primitives import layer_norm
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(6, 32, 256).astype(np.float32))
+    g = jnp.asarray(rng.rand(256).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(256).astype(np.float32) * 0.1)
+
+    def composed(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    y = layer_norm(x, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(composed(x, g, b)),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_fused(x, g, b):
+        return jnp.sum(layer_norm(x, g, b) ** 2)
+
+    def loss_ref(x, g, b):
+        return jnp.sum(composed(x, g, b) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b)
+    for a, bb, nm in zip(gf, gr, ("dx", "dg", "db")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4, err_msg=nm)
