@@ -300,3 +300,7 @@ def _kl_unif_unif(p: Uniform, q: Uniform):
 from ._round2 import *  # noqa: E402,F401,F403
 from ._round2 import __all__ as _r2_all
 __all__ += list(_r2_all)
+
+# round-4: the last reference distribution the inventory named absent
+from .lkj_cholesky import LKJCholesky  # noqa: E402
+__all__ += ["LKJCholesky"]

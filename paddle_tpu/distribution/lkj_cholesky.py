@@ -1,0 +1,134 @@
+"""LKJCholesky distribution (reference:
+python/paddle/distribution/lkj_cholesky.py — LKJ over Cholesky factors of
+correlation matrices, Lewandowski-Kurowicka-Joe 2009; the one distribution
+the round-3 inventory named absent).
+
+Same math, jnp-native: both reference samplers ("onion" and "cvine",
+Sec. 3.2 of the paper) and the exact normalized log_prob (page 1999's
+normalization constant via multigammaln). Scalar concentration (the
+reference's default and test surface); samplers compose with jit/vmap.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import Distribution
+from ._round2 import Beta
+from ..random import next_key
+
+__all__ = ["LKJCholesky"]
+
+_LGAMMA = jax.scipy.special.gammaln
+
+
+def _mvlgamma(a, p: int):
+    """Multivariate log-gamma (scipy.special.multigammaln for traced a)."""
+    j = jnp.arange(1, p + 1, dtype=jnp.float32)
+    return (0.25 * p * (p - 1) * math.log(math.pi)
+            + jnp.sum(_LGAMMA(a[..., None] + 0.5 * (1.0 - j)), axis=-1))
+
+
+class LKJCholesky(Distribution):
+    """LKJ over Cholesky factors of correlation matrices.
+
+    concentration == 1 is uniform over correlation matrices; > 1
+    concentrates mass near the identity; < 1 near extreme correlations.
+    sample() returns a lower-triangular L with positive diagonal such
+    that L @ L.T is a correlation matrix.
+    """
+
+    event_rank = 2
+
+    def __init__(self, dim: int = 2, concentration=1.0,
+                 sample_method: str = "onion", name=None):
+        from ..enforce import enforce, enforce_in
+        del name
+        enforce(isinstance(dim, int) and dim >= 2,
+                f"Expected integer dim >= 2. Found dim={dim}.",
+                op="LKJCholesky", dim=dim)
+        enforce_in(sample_method, ("onion", "cvine"), op="LKJCholesky",
+                   sample_method=sample_method)
+        self.dim = dim
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        enforce(self.concentration.ndim == 0,
+                "this build supports scalar concentration (the reference "
+                "default); vmap over LKJCholesky for batches",
+                op="LKJCholesky", concentration=self.concentration)
+        enforce(bool(jnp.all(self.concentration > 0)),
+                "The arg of `concentration` must be positive.",
+                op="LKJCholesky")
+        self.sample_method = sample_method
+
+        # vectorized Beta marginals (Sec. 3.2 of the paper; mirrors the
+        # reference's _beta construction)
+        marginal_conc = self.concentration + 0.5 * (dim - 2)
+        offset = jnp.arange(dim - 1, dtype=jnp.float32)
+        if sample_method == "onion":
+            offset = jnp.concatenate([jnp.zeros((1,)), offset])
+            self._beta = Beta(offset + 0.5,
+                              marginal_conc[..., None] - 0.5 * offset)
+        else:
+            tril_off = jnp.tril(jnp.broadcast_to(
+                0.5 * offset, (dim - 1, dim - 1)))
+            rows, cols = jnp.tril_indices(dim - 1)
+            conc = marginal_conc[..., None] - tril_off[rows, cols]
+            self._beta = Beta(conc, conc)
+
+    def _onion(self, sample_shape, key):
+        k1, k2 = jax.random.split(key)
+        y = self._beta.sample(sample_shape, key=k1)[..., None]
+        u_normal = jnp.tril(
+            jax.random.normal(k2, (*sample_shape, self.dim, self.dim)), -1)
+        norm = jnp.linalg.norm(u_normal, axis=-1, keepdims=True)
+        u_hyper = u_normal / jnp.where(norm == 0, 1.0, norm)
+        # first row is all zeros (its diagonal becomes 1)
+        u_hyper = u_hyper.at[..., 0, :].set(0.0)
+        w = jnp.sqrt(y) * u_hyper
+        tiny = jnp.finfo(w.dtype).tiny
+        diag = jnp.sqrt(jnp.clip(1.0 - jnp.sum(w ** 2, axis=-1), tiny))
+        return w + jnp.zeros_like(w).at[..., jnp.arange(self.dim),
+                                        jnp.arange(self.dim)].set(diag)
+
+    def _cvine(self, sample_shape, key):
+        d = self.dim
+        beta_sample = self._beta.sample(sample_shape, key=key)
+        partial = 2.0 * beta_sample - 1.0  # [..., d(d-1)/2]
+        rows, cols = jnp.tril_indices(d - 1)
+        r = jnp.zeros((*partial.shape[:-1], d, d), partial.dtype)
+        # partial correlations occupy the strict lower triangle (shifted
+        # down one row so row i has i entries)
+        r = r.at[..., rows + 1, cols].set(partial)
+        tiny = jnp.finfo(r.dtype).tiny
+        r = jnp.clip(r, -1 + tiny, 1 - tiny)
+        z1m_sqrt = jnp.cumprod(jnp.sqrt(1.0 - r ** 2), axis=-1)
+        shifted = jnp.concatenate(
+            [jnp.ones((*z1m_sqrt.shape[:-1], 1), r.dtype),
+             z1m_sqrt[..., :-1]], axis=-1)
+        r = r + jnp.eye(d, dtype=r.dtype)
+        return r * shifted
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_key()
+        shape = tuple(shape)
+        out = (self._onion if self.sample_method == "onion"
+               else self._cvine)(shape or (1,), key)
+        return out.reshape((*shape, self.dim, self.dim))
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
+        order = jnp.arange(2, self.dim + 1, dtype=jnp.float32)
+        order = 2.0 * (self.concentration - 1.0)[..., None] \
+            + self.dim - order
+        unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+        # normalization constant, page 1999 of the paper
+        dm1 = self.dim - 1
+        alpha = self.concentration + 0.5 * dm1
+        denominator = _LGAMMA(alpha) * dm1
+        numerator = _mvlgamma(alpha - 0.5, dm1)
+        pi_constant = 0.5 * dm1 * math.log(math.pi)
+        return unnorm - (pi_constant + numerator - denominator)
