@@ -23,13 +23,7 @@ from ..random import next_key
 __all__ = ["LKJCholesky"]
 
 _LGAMMA = jax.scipy.special.gammaln
-
-
-def _mvlgamma(a, p: int):
-    """Multivariate log-gamma (scipy.special.multigammaln for traced a)."""
-    j = jnp.arange(1, p + 1, dtype=jnp.float32)
-    return (0.25 * p * (p - 1) * math.log(math.pi)
-            + jnp.sum(_LGAMMA(a[..., None] + 0.5 * (1.0 - j)), axis=-1))
+_MVLGAMMA = jax.scipy.special.multigammaln
 
 
 class LKJCholesky(Distribution):
@@ -58,9 +52,13 @@ class LKJCholesky(Distribution):
                 "this build supports scalar concentration (the reference "
                 "default); vmap over LKJCholesky for batches",
                 op="LKJCholesky", concentration=self.concentration)
-        enforce(bool(jnp.all(self.concentration > 0)),
-                "The arg of `concentration` must be positive.",
-                op="LKJCholesky")
+        if not isinstance(self.concentration, jax.core.Tracer):
+            # value check only when concrete — a vmapped/jitted
+            # concentration (the documented batching path) is validated
+            # by its caller
+            enforce(bool(jnp.all(self.concentration > 0)),
+                    "The arg of `concentration` must be positive.",
+                    op="LKJCholesky")
         self.sample_method = sample_method
 
         # vectorized Beta marginals (Sec. 3.2 of the paper; mirrors the
@@ -83,10 +81,10 @@ class LKJCholesky(Distribution):
         y = self._beta.sample(sample_shape, key=k1)[..., None]
         u_normal = jnp.tril(
             jax.random.normal(k2, (*sample_shape, self.dim, self.dim)), -1)
+        # row 0 is all zeros; guard its 0/0 once (the row stays zero, so
+        # its diagonal becomes 1)
         norm = jnp.linalg.norm(u_normal, axis=-1, keepdims=True)
         u_hyper = u_normal / jnp.where(norm == 0, 1.0, norm)
-        # first row is all zeros (its diagonal becomes 1)
-        u_hyper = u_hyper.at[..., 0, :].set(0.0)
         w = jnp.sqrt(y) * u_hyper
         tiny = jnp.finfo(w.dtype).tiny
         diag = jnp.sqrt(jnp.clip(1.0 - jnp.sum(w ** 2, axis=-1), tiny))
@@ -129,6 +127,6 @@ class LKJCholesky(Distribution):
         dm1 = self.dim - 1
         alpha = self.concentration + 0.5 * dm1
         denominator = _LGAMMA(alpha) * dm1
-        numerator = _mvlgamma(alpha - 0.5, dm1)
+        numerator = _MVLGAMMA(alpha - 0.5, dm1)
         pi_constant = 0.5 * dm1 * math.log(math.pi)
         return unnorm - (pi_constant + numerator - denominator)
