@@ -96,24 +96,40 @@ def run_moments_offload(on_tpu):
     }))
 
 
-def run_param_stream(on_tpu):
+def run_param_stream(on_tpu, model: str = "gpt"):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.distributed.sharding.param_stream import (
         build_param_streamed_train_step, park)
-    from paddle_tpu.models import gpt as G
 
-    if on_tpu:
-        cfg = G.gpt_6p7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
-        # the step is PCIe-bound, so batch 4 costs ~the same transfer
-        # time as batch 2 and nearly doubles tok/s (225 vs 144 measured)
-        batch, seq, iters = 4, 2048, 2
-        moment_dtype = jnp.bfloat16
-    else:  # CPU smoke
-        cfg = G.gpt_tiny(dtype=jnp.float32)
-        batch, seq, iters = 2, 128, 2
-        moment_dtype = None
+    if model == "llama":
+        from paddle_tpu.models import llama as G
+        if on_tpu:
+            cfg = G.llama2_7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+            batch, seq, iters = 2, 2048, 2
+            moment_dtype = jnp.bfloat16
+            name = "llama2_7b"
+        else:
+            cfg = G.llama_tiny(dtype=jnp.float32)
+            batch, seq, iters = 2, 64, 2
+            moment_dtype = None
+            name = "llama_tiny"
+    else:
+        from paddle_tpu.models import gpt as G
+        if on_tpu:
+            cfg = G.gpt_6p7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+            # the step is PCIe-bound, so batch 4 costs ~the same transfer
+            # time as batch 2 and nearly doubles tok/s (225 vs 144
+            # measured)
+            batch, seq, iters = 4, 2048, 2
+            moment_dtype = jnp.bfloat16
+            name = "gpt3_6p7b"
+        else:  # CPU smoke
+            cfg = G.gpt_tiny(dtype=jnp.float32)
+            batch, seq, iters = 2, 128, 2
+            moment_dtype = None
+            name = "gpt_tiny"
 
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  moment_dtype=moment_dtype)
@@ -142,14 +158,14 @@ def run_param_stream(on_tpu):
     assert np.isfinite(l_final), (l0, l_final)
     assert kinds == {"pinned_host"}, kinds
     print(json.dumps({
-        "metric": "offload_6p7b_param_stream_step_time",
+        "metric": f"offload_{name}_param_stream_step_time",
         "value": round(dt, 3), "unit": "s/step",
         "tokens_per_sec": round(batch * seq / dt, 1),
         "n_params_b": round(n_params / 1e9, 2),
         "loss_first_to_last": [round(l0, 3), round(l_final, 3)],
         "init_s": round(init_s, 1),
         "param_memory": sorted(kinds),
-        "config": f"GPT-3 {n_params/1e9:.2f}B bf16 (H={cfg.hidden_size}, "
+        "config": f"{name} {n_params/1e9:.2f}B bf16 (H={cfg.hidden_size}, "
                   f"L={cfg.num_layers}, heads={cfg.num_heads}, "
                   f"vocab={cfg.vocab_size}), seq {seq}, batch {batch}; "
                   "params+moments in pinned_host, streamed per block "
@@ -159,12 +175,15 @@ def run_param_stream(on_tpu):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--size", choices=["2.85b", "6.7b"], default="2.85b")
+    ap.add_argument("--size", choices=["2.85b", "6.7b", "llama7b"],
+                    default="2.85b")
     args = ap.parse_args()
     import jax
     on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
     if args.size == "2.85b":
         run_moments_offload(on_tpu)
+    elif args.size == "llama7b":
+        run_param_stream(on_tpu, model="llama")
     else:
         run_param_stream(on_tpu)
 

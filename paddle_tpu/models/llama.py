@@ -40,7 +40,8 @@ from .gpt import _vocab_parallel_ce, _vocab_parallel_embed
 __all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama2_7b", "llama2_13b",
            "llama3_8b", "init_hybrid_params", "hybrid_param_specs",
            "hybrid_loss_fn", "build_hybrid_train_step", "dense_forward",
-           "dense_loss"]
+           "dense_loss", "split_streamed_params", "init_streamed_params",
+           "streamed_fns"]
 
 
 @dataclasses.dataclass
@@ -297,29 +298,51 @@ def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp"):
     return x + mp_ops.mp_allreduce(m, mp_axis)
 
 
+def dense_embed(params, tokens, cfg: LlamaConfig):
+    return jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+
+
+def dense_block(p, x, cfg: LlamaConfig):
+    """One decoder layer on an UNstacked per-layer tree — shared by the
+    scan in dense_forward and the param-streaming trainer (RoPE tables
+    are a deterministic function of static cfg + S; XLA folds them)."""
+    cd = cfg.dtype
+    B, S, H = x.shape
+    cos, sin = rope_tables(cfg, S)
+    h = _rms(x, p["ln1_g"], cfg.rms_eps).astype(cd)
+    q = (h @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
+                                          cfg.head_dim)
+    k = (h @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                          cfg.head_dim)
+    v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
+                                          cfg.head_dim)
+    q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+    attn = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+    x = x + attn.reshape(B, S, H) @ p["o_w"].astype(cd)
+    h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
+    m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
+                    ).astype(cd) * (h @ p["up_w"].astype(cd))
+    return x + m @ p["down_w"].astype(cd)
+
+
+def dense_head_loss(params, x, labels, cfg: LlamaConfig):
+    """Final RMSNorm + LM head + logsumexp CE over the head sub-tree —
+    identical math to dense_loss's tail."""
+    x = _rms(x, params["lnf_g"], cfg.rms_eps)
+    logits = (x.astype(cfg.dtype)
+              @ params["head_w"].astype(cfg.dtype)).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
+
+
 def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
     """Single-device forward over the stacked pytree (no collectives); same
     math/layout as the hybrid engine."""
-    cos, sin = rope_tables(cfg, tokens.shape[1])
-    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
-    cd = cfg.dtype
+    x = dense_embed(params, tokens, cfg)
 
     def block(p, x):
-        B, S, H = x.shape
-        h = _rms(x, p["ln1_g"], cfg.rms_eps).astype(cd)
-        q = (h @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
-                                              cfg.head_dim)
-        k = (h @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                              cfg.head_dim)
-        v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                              cfg.head_dim)
-        q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-        attn = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
-        x = x + attn.reshape(B, S, H) @ p["o_w"].astype(cd)
-        h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
-        m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
-                        ).astype(cd) * (h @ p["up_w"].astype(cd))
-        return x + m @ p["down_w"].astype(cd)
+        return dense_block(p, x, cfg)
 
     blk = jax.checkpoint(block) if remat else block
 
@@ -329,6 +352,63 @@ def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
     x, _ = lax.scan(body, x, params["blocks"])
     x = _rms(x, params["lnf_g"], cfg.rms_eps)
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Param-streaming (bigger-than-HBM) form — Llama-2 7B on one v5e
+# ---------------------------------------------------------------------------
+def split_streamed_params(params, cfg: LlamaConfig):
+    """Stacked hybrid tree → segmented {embed, blocks: [per-layer], head}
+    layout for build_param_streamed_train_step (tests / small models)."""
+    blocks = [jax.tree.map(lambda a: a[i], params["blocks"])
+              for i in range(cfg.num_layers)]
+    return {"embed": {"wte": params["wte"]},
+            "blocks": blocks,
+            "head": {"lnf_g": params["lnf_g"], "head_w": params["head_w"]}}
+
+
+def init_streamed_params(cfg: LlamaConfig, key, park=lambda t: t):
+    """Segmented init, ONE segment on device at a time (cf. gpt.py —
+    a 7B whole-tree init would OOM HBM before the first step)."""
+    H, L, I, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                  cfg.vocab_size)
+    D, nkv = cfg.head_dim, cfg.num_kv_heads
+    std, pd = 0.02, cfg.param_dtype
+    k_embed, k_head, *k_blocks = jax.random.split(key, 2 + L)
+
+    def nrm(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(pd)
+
+    @jax.jit
+    def one_block(key):
+        ks = jax.random.split(key, 7)
+        return {
+            "ln1_g": jnp.ones((H,), pd),
+            "q_w": nrm(ks[0], (H, H)),
+            "k_w": nrm(ks[1], (H, nkv * D)),
+            "v_w": nrm(ks[2], (H, nkv * D)),
+            "o_w": nrm(ks[3], (H, H), std / math.sqrt(2 * L)),
+            "ln2_g": jnp.ones((H,), pd),
+            "gate_w": nrm(ks[4], (H, I)),
+            "up_w": nrm(ks[5], (H, I)),
+            "down_w": nrm(ks[6], (I, H), std / math.sqrt(2 * L)),
+        }
+
+    return {
+        "embed": park(jax.jit(lambda k: {"wte": nrm(k, (V, H))})(k_embed)),
+        "blocks": [park(one_block(k)) for k in k_blocks],
+        "head": park(jax.jit(lambda k: {
+            "lnf_g": jnp.ones((H,), pd),
+            "head_w": nrm(k, (H, V))})(k_head)),
+    }
+
+
+def streamed_fns(cfg: LlamaConfig):
+    """(embed_fn, block_fn, head_loss_fn) for
+    build_param_streamed_train_step — same math as dense_loss."""
+    return (lambda p, tokens: dense_embed(p, tokens, cfg),
+            lambda p, x: dense_block(p, x, cfg),
+            lambda p, x, labels: dense_head_loss(p, x, labels, cfg))
 
 
 def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
