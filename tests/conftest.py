@@ -96,6 +96,7 @@ _SLOW_TESTS = {
     "test_flash_bias_grad_broadcast_shapes",
     "test_flash_learned_bias_grad",
     "test_streamed_matches_dense_training",
+    "test_streamed_llama_matches_dense_training",
     "test_ptq_calibrated_gpt_matches_fp",
 }
 
