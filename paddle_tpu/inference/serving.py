@@ -64,20 +64,55 @@ def _embed(params, tokens, pos, cfg):
             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
 
+def _mm(x, p, name, cfg, out_dtype=None):
+    """x @ p[name], riding the int8 MXU when the engine quantized this
+    weight (W8A8 dynamic with PER-ROW activation scales — a per-tensor
+    absmax would couple a request's quantization grid to its co-scheduled
+    batchmates; reference: fused_multi_transformer_int8)."""
+    wq = p.get(name + "@q")
+    if wq is None:
+        x = x @ p[name].astype(cfg.dtype)
+        return x.astype(out_dtype) if out_dtype is not None else x
+    from ..quantization import qlinear
+    return qlinear(x, wq, p[name + "@s"],
+                   out_dtype=out_dtype or cfg.dtype, per_row=True)
+
+
+def quantize_serving_params(params):
+    """Per-layer, per-output-channel int8 quantization of every block
+    matmul weight + the LM head; embeddings/norm vectors stay fp. The
+    quantized tree swaps each weight for ('<name>@q' int8, '<name>@s'
+    scales) — _mm dispatches on presence."""
+    from ..quantization import quantize_to_int8
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+        w = blocks.pop(name)  # [L, in, out] — scale per (layer, channel)
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=1, keepdims=True), 1e-8)
+        q, _ = quantize_to_int8(w, scale=s)
+        blocks[name + "@q"] = q
+        blocks[name + "@s"] = s[:, 0, :]  # [L, out]
+    out["blocks"] = blocks
+    hq, hs = quantize_to_int8(params["head_w"], axis=1)
+    del out["head_w"]
+    out["head_w@q"] = hq
+    out["head_w@s"] = hs[0]
+    return out
+
+
 def _block_math(p, x, attn, cfg, mp_axis=None):
     """Post-attention half of the GPT block (shared by both programs).
     mp_axis: Megatron TP inside shard_map — proj/fc2 are row-parallel
     (partial matmul + psum), fc1 column-parallel."""
     B, S, _ = x.shape
-    out = attn.reshape(B, S, -1) @ p["proj_w"].astype(cfg.dtype)
+    out = _mm(attn.reshape(B, S, -1), p, "proj_w", cfg)
     if mp_axis is not None:
         out = lax.psum(out, mp_axis)
     x = x + out + p["proj_b"].astype(cfg.dtype)
     h = G._ln(x, p["ln2_g"], p["ln2_b"])
-    m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
-         + p["fc1_b"].astype(cfg.dtype))
+    m = _mm(h.astype(cfg.dtype), p, "fc1_w", cfg) + p["fc1_b"].astype(cfg.dtype)
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-    m = m @ p["fc2_w"].astype(cfg.dtype)
+    m = _mm(m, p, "fc2_w", cfg)
     if mp_axis is not None:
         m = lax.psum(m, mp_axis)
     return x + m + p["fc2_b"].astype(cfg.dtype)
@@ -89,7 +124,7 @@ def _qkv(p, x, cfg, mp_axis=None):
     the LOCAL head count."""
     B, S, _ = x.shape
     h = G._ln(x, p["ln1_g"], p["ln1_b"])
-    qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+    qkv = (_mm(h.astype(cfg.dtype), p, "qkv_w", cfg)
            + p["qkv_b"].astype(cfg.dtype))
     heads = qkv.shape[-1] // (3 * cfg.head_dim)
     qkv = qkv.reshape(B, S, heads, 3, cfg.head_dim)
@@ -101,8 +136,13 @@ def _head_logits(params, x_last, cfg, mp_axis=None):
     partial logits all-gathered — [B, V] is tiny at decode time). When
     the vocab does not divide the axis, head_w rides replicated and the
     local product is already full-width."""
-    logits = x_last.astype(jnp.float32) @ params["head_w"].astype(
-        jnp.float32)
+    if "head_w@q" in params:
+        from ..quantization import qlinear
+        logits = qlinear(x_last, params["head_w@q"], params["head_w@s"],
+                         out_dtype=jnp.float32, per_row=True)
+    else:
+        logits = x_last.astype(jnp.float32) @ params["head_w"].astype(
+            jnp.float32)
     if mp_axis is not None and logits.shape[-1] < cfg.vocab_size:
         logits = lax.all_gather(logits, mp_axis, axis=logits.ndim - 1,
                                 tiled=True)
@@ -237,7 +277,8 @@ class ServingEngine:
                  block_size: int = None, num_blocks: int = 256,
                  max_blocks_per_seq: int = 32, chunk: int = None,
                  decode_burst: int = None, seed: int = 0, mesh=None,
-                 mp_axis: str = "mp", adaptive_burst: bool = False):
+                 mp_axis: str = "mp", adaptive_burst: bool = False,
+                 int8: bool = False):
         from ..flags import flag
         block_size = (int(flag("paged_block_size")) if block_size is None
                       else block_size)
@@ -245,6 +286,15 @@ class ServingEngine:
                  else chunk)
         decode_burst = (int(flag("serving_decode_burst"))
                         if decode_burst is None else decode_burst)
+        if int8:
+            from ..enforce import UnimplementedError, enforce
+            enforce(mesh is None, "int8 + TP serving not wired yet — "
+                    "quantized trees need sharded-scale specs",
+                    error=UnimplementedError, op="ServingEngine")
+            # W8A8 decode: weights stored int8 with per-output-channel
+            # scales; decode reads every weight per token, so halving the
+            # bytes attacks its memory-bound cost directly
+            params = quantize_serving_params(params)
         self.params, self.cfg = params, cfg
         self.bs, self.chunk = block_size, chunk
         self.max_batch = max_batch

@@ -315,12 +315,21 @@ def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32):
             ).astype(out_dtype)
 
 
-def qlinear(x, w_q, w_scale, bias=None, out_dtype=None):
-    """Dynamic-activation-quant linear: quantize x per call (absmax),
-    run the int8 MXU matmul, dequantize (W8A8 dynamic — the
-    llm.int8-style serving path)."""
+def qlinear(x, w_q, w_scale, bias=None, out_dtype=None, per_row=False):
+    """Dynamic-activation-quant linear: quantize x per call, run the int8
+    MXU matmul, dequantize (W8A8 dynamic — the llm.int8-style serving
+    path). per_row=True scales each row (reduce only the contraction
+    dim) instead of the whole tensor — REQUIRED when x batches
+    independent requests (continuous batching): a per-tensor absmax would
+    make one request's quantization grid depend on its co-scheduled
+    batchmates' outliers."""
     out_dtype = out_dtype or x.dtype
-    x_q, x_scale = quantize_to_int8(x)
+    if per_row:
+        x_scale = jnp.maximum(
+            jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-8)
+        x_q, _ = quantize_to_int8(x, scale=x_scale)
+    else:
+        x_q, x_scale = quantize_to_int8(x)
     out = int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32)
     if bias is not None:
         out = out + bias.astype(jnp.float32)

@@ -149,6 +149,34 @@ def test_adaptive_burst_frees_slots_early(params):
         assert res[rid] == golden(params, p, n), rid
 
 
+def test_int8_serving_close_to_fp(params):
+    """W8A8 serving (int8=True): weights quantized per output channel,
+    activations per call — generated tokens track the fp engine closely
+    (greedy, short decodes; W8A8 error can flip late low-margin tokens,
+    so assert high agreement rather than exact match)."""
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(0, CFG.vocab_size, (n,)) for n in (9, 13)]
+    news = [6, 6]
+
+    def run(int8):
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            num_blocks=24, max_blocks_per_seq=8, chunk=8,
+                            int8=int8)
+        rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+        res = eng.run()
+        return [res[r] for r in rids]
+
+    fp = run(False)
+    q8 = run(True)
+    total = sum(len(o) for o in fp)
+    agree = sum(a == b for o1, o2 in zip(fp, q8)
+                for a, b in zip(o1, o2))
+    assert agree / total >= 0.75, (fp, q8)
+    # first token (largest margin) must agree per request
+    for o1, o2 in zip(fp, q8):
+        assert o1[0] == o2[0]
+
+
 def test_static_batch_mixed_prompt_lengths(params):
     """The static baseline buckets mixed-length prompts by length and pads
     to the bucket max; equal-length groups still match goldens exactly."""
