@@ -125,11 +125,50 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
 # process boundary — each stage lives on its own process and the 1F1B/ZBH1
 # ppermute hops cross it, the reference's dominant multi-node integration
 # (fleet/meta_parallel/pp_utils/p2p_communication.py:570 cross-node p2p).
+# "sepring" runs ring attention with the SEP axis spanning both processes —
+# every kv-block rotation is a cross-process ppermute (the long-context
+# DCN path).
 _MODES = {
     "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B"),
     "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B"),
     "ppzbh1": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "ZBH1"),
+    "sepring": (lambda n: {"sep": n}, 1, "1F1B"),
 }
+
+
+def run_ring(mesh, steps: int = 3):
+    """Seed-deterministic ring-attention fwd+grad over the mesh's 'sep'
+    axis (einsum tier — portable to the gloo CPU backend); returns a
+    per-step scalar series every rank can compare against the
+    single-process golden."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from .fleet.meta_parallel import ring_attention
+    from ..utils import shard_map
+
+    B, S, H, D = 2, 8 * mesh.devices.size, 2, 8
+    rng = np.random.RandomState(0)
+    qkv = [jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+           for _ in range(3)]
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, axis="sep", causal=True,
+                             impl="einsum")
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), "sep")
+
+    spec = P(None, "sep")
+    f = shard_map(loss, mesh=mesh, in_specs=(spec,) * 3, out_specs=P())
+    gfn = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+    vals = []
+    q, k, v = qkv
+    for _ in range(steps):
+        gq, gk, gv = gfn(q, k, v)
+        q = q - 0.05 * gq
+        vals.append(float(jax.device_get(f(q, k, v))))
+    return vals
 
 
 def main():
@@ -143,6 +182,15 @@ def main():
     dims_of, M, schedule = _MODES[mode]
     n = len(jax.devices())
     mesh = build_mesh(dims_of(n))
+    if mode == "sepring":
+        # sep axis spans BOTH processes: every ring rotation crosses
+        assert (mesh.devices[0].process_index
+                != mesh.devices[-1].process_index)
+        vals = run_ring(mesh)
+        print("MPSMOKE " + json.dumps(
+            {"rank": jax.process_index(), "mode": mode, "losses": vals}),
+            flush=True)
+        return
     ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
     dev = np.moveaxis(mesh.devices,
                       (ax["dp"], ax["pp"], ax["mp"]), (0, 1, 2))
@@ -185,6 +233,8 @@ def golden_for(n_devices: int, mode: str = "dpmp", devices=None):
     from .topology import build_mesh
     dims_of, M, schedule = _MODES[mode]
     mesh = build_mesh(dims_of(n_devices), devices=devices)
+    if mode == "sepring":
+        return run_ring(mesh)
     return run_training(mesh, num_microbatches=M, schedule=schedule)
 
 

@@ -89,6 +89,7 @@ _SLOW_TESTS = {
     # round 4 (fast tier re-budgeted to <= 10 min: the heaviest spawns and
     # interpret-mode kernel tests move here; `pytest -m slow` is nightly)
     "test_two_process_pipeline_parity",
+    "test_two_process_ring_attention_parity",
     "test_tp_sharded_decode_matches_generate",
     "test_adaptive_burst_frees_slots_early",
     "test_static_batch_mixed_prompt_lengths",

@@ -71,6 +71,18 @@ def test_two_process_distributed_checkpoint(cluster_results):
         assert res["ckpt_ok"] is True
 
 
+def test_two_process_ring_attention_parity():
+    """Ring attention with the SEP axis spanning both processes: every
+    kv-block ppermute rotation crosses the process boundary (the
+    long-context DCN path) — loss+grad-descent series must match the
+    single-process run."""
+    from paddle_tpu.distributed import mp_smoke
+
+    golden = mp_smoke.golden_for(8, "sepring")
+    assert all(np.isfinite(golden)), golden
+    mp_smoke.spawn_and_check(8, golden, mode="sepring", timeout=240)
+
+
 @pytest.mark.parametrize("mode", ["pp1f1b", "ppzbh1"])
 def test_two_process_pipeline_parity(mode):
     """pp2 x mp4 with the PIPELINE axis on the process boundary: each stage
