@@ -126,8 +126,9 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
 # ppermute hops cross it, the reference's dominant multi-node integration
 # (fleet/meta_parallel/pp_utils/p2p_communication.py:570 cross-node p2p).
 # "sepring" runs ring attention with the SEP axis spanning both processes —
-# every kv-block rotation is a cross-process ppermute (the long-context
-# DCN path).
+# the ring's neighbor hops at the process edges are cross-process ppermutes
+# (2 of n hops with the contiguous hybrid layout; the long-context DCN
+# path at this box's fidelity).
 _MODES = {
     "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B"),
     "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B"),
@@ -141,8 +142,6 @@ def run_ring(mesh, steps: int = 3):
     axis (einsum tier — portable to the gloo CPU backend); returns a
     per-step scalar series every rank can compare against the
     single-process golden."""
-    import functools
-
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -161,12 +160,11 @@ def run_ring(mesh, steps: int = 3):
 
     spec = P(None, "sep")
     f = shard_map(loss, mesh=mesh, in_specs=(spec,) * 3, out_specs=P())
-    gfn = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+    gfn = jax.jit(jax.grad(f))  # descend on q only
     vals = []
     q, k, v = qkv
     for _ in range(steps):
-        gq, gk, gv = gfn(q, k, v)
-        q = q - 0.05 * gq
+        q = q - 0.05 * gfn(q, k, v)
         vals.append(float(jax.device_get(f(q, k, v))))
     return vals
 
@@ -183,9 +181,12 @@ def main():
     n = len(jax.devices())
     mesh = build_mesh(dims_of(n))
     if mode == "sepring":
-        # sep axis spans BOTH processes: every ring rotation crosses
-        assert (mesh.devices[0].process_index
-                != mesh.devices[-1].process_index)
+        # the sep ring must CROSS the process boundary somewhere: count
+        # neighbor pairs (incl. the wraparound) on different processes
+        procs = [d.process_index for d in mesh.devices]
+        crossings = sum(procs[i] != procs[(i + 1) % len(procs)]
+                        for i in range(len(procs)))
+        assert crossings >= 2, procs  # contiguous layout: 2 of n hops
         vals = run_ring(mesh)
         print("MPSMOKE " + json.dumps(
             {"rank": jax.process_index(), "mode": mode, "losses": vals}),

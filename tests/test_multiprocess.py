@@ -72,9 +72,10 @@ def test_two_process_distributed_checkpoint(cluster_results):
 
 
 def test_two_process_ring_attention_parity():
-    """Ring attention with the SEP axis spanning both processes: every
-    kv-block ppermute rotation crosses the process boundary (the
-    long-context DCN path) — loss+grad-descent series must match the
+    """Ring attention with the SEP axis spanning both processes: the
+    ring's edge hops (2 of n with the contiguous hybrid layout) are
+    cross-process ppermutes — the long-context DCN path at this box's
+    fidelity — with loss+grad-descent series parity vs the
     single-process run."""
     from paddle_tpu.distributed import mp_smoke
 
