@@ -160,6 +160,25 @@ def _mask_scores(s, i, j, *, block_q, block_k, causal, offset, window,
     return s
 
 
+def _seg_block_overlap(masks):
+    """Dynamic per-tile gate for packed-varlen inputs: False iff the q and
+    k segment-id RANGES in this tile are disjoint — every (q, k) pair then
+    has qseg != kseg, the tile is fully masked, and skipping its matmuls/
+    softmax entirely is exact. Range overlap is conservative for arbitrary
+    id layouts; for first-fit packing (ids ascend within a row,
+    models/bert.py pack_sequences) it skips ~1 - sum(len_i^2)/S^2 of the
+    tiles — the TPU analogue of the reference varlen kernel launching
+    per-sequence (flash_attn_kernel.cu cu_seqlens). Pad tails (-1) keep
+    their current semantics: all-pad x all-pad tiles still run."""
+    _, qseg_ref, kseg_ref, _, _ = masks
+    if qseg_ref is None:
+        return None
+    qcol = qseg_ref[0][:, :1]   # [bq, 1] sublane column
+    klane = kseg_ref[0, 0]      # [bk] lane vector
+    return ((jnp.min(qcol) <= jnp.max(klane))
+            & (jnp.max(qcol) >= jnp.min(klane)))
+
+
 def _block_run(i, j, *, block_q, block_k, causal, offset, window):
     """True iff block (i, j) can contain any unmasked score, from the
     causal diagonal and window band alone (segments/flashmask/bias are
@@ -267,6 +286,8 @@ def _fwd_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
     run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
                      offset=offset, window=window)
+    if has_seg:
+        run = jnp.logical_and(run, _seg_block_overlap(masks))
 
     @pl.when(run)
     def _compute():
@@ -490,7 +511,7 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
     seed_ref, main, masks, rest = _unpack_refs(
         refs, n_main=6, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
         dropout_p=dropout_p)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = main
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = main
     dk_ref, dv_ref, dk_sc, dv_sc = rest
 
     bkv = pl.program_id(0)
@@ -506,6 +527,8 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
     run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
                      offset=offset, window=window)
+    if has_seg:
+        run = jnp.logical_and(run, _seg_block_overlap(masks))
 
     @pl.when(run)
     def _compute():
@@ -514,7 +537,11 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0][:, 0]      # [bq]
-        delta = delta_ref[0][:, 0]  # [bq]
+        # delta = rowsum(dO * O) computed in-VMEM: a [bq] reduce over d is
+        # ~free here, while the precomputed lane-replicated delta tensor
+        # cost a [bh, sq, 128] fp32 broadcast + two big HBM reads per layer
+        delta = jnp.sum(o_ref[0].astype(jnp.float32)
+                        * do.astype(jnp.float32), axis=-1)
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
@@ -558,7 +585,7 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
     seed_ref, main, masks, rest = _unpack_refs(
         refs, n_main=6, has_bias=has_bias, has_seg=has_seg, has_fm=has_fm,
         dropout_p=dropout_p)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = main
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = main
     if bias_grad:
         dq_ref, db_ref, dq_sc = rest
     else:
@@ -575,6 +602,8 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 
     run = _block_run(i, j, block_q=block_q, block_k=block_k, causal=causal,
                      offset=offset, window=window)
+    if has_seg:
+        run = jnp.logical_and(run, _seg_block_overlap(masks))
 
     if bias_grad:
         @pl.when(jnp.logical_not(run))
@@ -590,7 +619,8 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0][:, 0]
-        delta = delta_ref[0][:, 0]
+        delta = jnp.sum(o_ref[0].astype(jnp.float32)
+                        * do.astype(jnp.float32), axis=-1)  # [bq], in-VMEM
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -634,10 +664,10 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
     g = h // h_kv
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [bh, sq]
+    # delta = rowsum(dO * O) is computed inside the kernels (o rides the
+    # same BlockSpec as do — 4 bytes/elem bf16 read vs a lane-replicated
+    # [bh, sq, 128] fp32 broadcast + two reads)
     lse_r = jnp.broadcast_to(lse[:, :, None], (bh, sq, _LANES))
-    delta_r = jnp.broadcast_to(delta[:, :, None], (bh, sq, _LANES))
 
     qseg, kseg, fm_start, fm_end, fm_mh = _prep_mask_operands(
         qseg, kseg, fm_start, fm_end)
@@ -671,7 +701,7 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
             num_t=num_t, h=h, h_kv=h_kv, g=g, has_bias=bias is not None,
             has_seg=has_seg, has_fm=has_fm, dropout_p=dropout_p),
         grid=(bh_kv, nk, num_t),
-        in_specs=head + [qspec, kspec, kspec, qspec, rspec, rspec] + tail,
+        in_specs=head + [qspec, kspec, kspec, qspec, qspec, rspec] + tail,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
@@ -685,7 +715,7 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)
+    )(*seed_inputs, q, k, v, out, do, lse_r, *extra_inputs)
 
     # ---- dq: grid (B*H, q blocks, k blocks)
     kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
@@ -717,13 +747,13 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
             has_bias=bias is not None, has_seg=has_seg, has_fm=has_fm,
             dropout_p=dropout_p, bias_grad=emit_db),
         grid=(bh, nq, nk),
-        in_specs=head + [qspec2, kspec2, kspec2, qspec2, rspec2, rspec2]
+        in_specs=head + [qspec2, kspec2, kspec2, qspec2, qspec2, rspec2]
         + tail,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(*seed_inputs, q, k, v, do, lse_r, delta_r, *extra_inputs)
+    )(*seed_inputs, q, k, v, out, do, lse_r, *extra_inputs)
     if emit_db:
         dq, db_full = res
         return dq, dk, dv, db_full

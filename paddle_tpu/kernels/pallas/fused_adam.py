@@ -1,0 +1,166 @@
+"""Fused Adam/AdamW update (Pallas).
+
+TPU-native equivalent of the reference's fused optimizer kernels
+(reference: paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu,
+paddle/phi/kernels/gpu/adamw_kernel.cu): ONE pass over each parameter
+leaf — read p, g, m1, m2, write p', m1', m2' — with the fp32 Adam math,
+bias correction, L2/decoupled decay, and the stochastic-rounding bits for
+bf16 moment2 all generated *inside* the kernel (pltpu.prng_random_bits),
+so no u32 noise tensor or fp32 intermediate ever round-trips through HBM.
+
+Why it exists: the XLA per-leaf update splits into convert fusions with
+fp32 intermediates + a materialized u32 rng tensor — measured 8.9 ms/step
+on BERT-base (110M params) vs the ~2.4 ms HBM floor. This kernel is the
+floor.
+
+Math parity: identical to optimizer.Adam._adam_core / _sr_to_bf16 —
+golden-tested against the XLA path in tests/test_fused_adam.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import LANES, interpret as _interpret
+
+__all__ = ["supported", "adam_update"]
+
+_BLOCK_ROWS = 2048  # (2048, 128) fp32 working set ~1MB/buffer in VMEM
+
+
+def supported(p, g, slot) -> bool:
+    """Fast-path eligibility for one dense leaf. Small leaves (biases, LN
+    affine) stay on the XLA path — they are a rounding error of the
+    traffic. The kernel runs on the leaf's NATIVE trailing dim (leading
+    dims collapsed — a layout-free reshape) with cdiv-masked edge blocks:
+    a flat (n/128, 128) view would relayout the (8,128)-tiled buffer,
+    which XLA lowers to a while+dynamic-update-slice copy loop costing
+    more than the fused pass saves (measured round 4)."""
+    if g is None or not hasattr(g, "dtype"):
+        return False
+    n = p.size
+    if n < (1 << 16) or p.ndim < 2:
+        return False
+    if p.shape != g.shape:
+        return False
+    for k in ("moment1", "moment2"):
+        if k not in slot or slot[k].shape != p.shape:
+            return False
+    master = slot.get("master")
+    if master is not None and (master.shape != p.shape
+                               or master.dtype != jnp.float32):
+        return False
+    return all(jnp.issubdtype(jnp.dtype(a.dtype), jnp.floating)
+               for a in (p, g, slot["moment1"], slot["moment2"]))
+
+
+def _kernel(sc_ref, seed_ref, p_ref, g_ref, m1_ref, m2_ref, *rest,
+            b1, b2, eps, l2, dec, sr, has_master):
+    if has_master:
+        mst_ref, op_ref, om1_ref, om2_ref, omst_ref = rest
+        pf = mst_ref[:]
+    else:
+        op_ref, om1_ref, om2_ref = rest
+        pf = p_ref[:].astype(jnp.float32)
+    lr = sc_ref[0]
+    c1 = sc_ref[1]  # 1 - beta1**step
+    c2 = sc_ref[2]  # 1 - beta2**step
+    gf = g_ref[:].astype(jnp.float32)
+    if l2:
+        gf = gf + jnp.float32(l2) * pf
+    m1 = b1 * m1_ref[:].astype(jnp.float32) + (1.0 - b1) * gf
+    m2 = b2 * m2_ref[:].astype(jnp.float32) + (1.0 - b2) * gf * gf
+    upd = (m1 / c1) / (jnp.sqrt(m2 / c2) + eps)
+    new_pf = pf - lr * upd
+    if dec:
+        new_pf = new_pf - lr * jnp.float32(dec) * pf
+    op_ref[:] = new_pf.astype(op_ref.dtype)
+    om1_ref[:] = m1.astype(om1_ref.dtype)
+    if sr:
+        # unbiased stochastic rounding f32 -> bf16 (optimizer._sr_to_bf16
+        # in integer space), bits generated in-VMEM per block
+        blk = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        pltpu.prng_seed(seed_ref[0], seed_ref[1] ^ blk)
+        noise = pltpu.prng_random_bits(m2.shape).astype(jnp.uint32) \
+            & jnp.uint32(0xFFFF)
+        bits = jax.lax.bitcast_convert_type(m2, jnp.uint32)
+        rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+        om2_ref[:] = jax.lax.bitcast_convert_type(
+            rounded, jnp.float32).astype(jnp.bfloat16)
+    else:
+        om2_ref[:] = m2.astype(om2_ref.dtype)
+    if has_master:
+        omst_ref[:] = new_pf
+
+
+def adam_update(p, g, slot, lr, step, rng, *, beta1, beta2, epsilon,
+                l2=0.0, decoupled=0.0):
+    """One fused update for one leaf. Returns (new_p, new_slot) with the
+    same structure/dtypes as optimizer.Adam._update. `l2` folds decay into
+    the gradient (Adam semantics); `decoupled` applies AdamW-style decay.
+    SR engages when moment2 is stored bf16 and an rng key is given."""
+    shape = p.shape
+    last = shape[-1]
+    rows = p.size // last
+    m1s, m2s = slot["moment1"], slot["moment2"]
+    master = slot.get("master")
+    sr = bool(rng is not None and m2s.dtype == jnp.bfloat16)
+    stepf = step.astype(jnp.float32)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        1.0 - jnp.float32(beta1) ** stepf,
+        1.0 - jnp.float32(beta2) ** stepf,
+    ])
+    if sr:
+        seed = jax.random.key_data(rng).astype(jnp.uint32)[-2:] \
+            .astype(jnp.int32)
+    else:
+        seed = jnp.zeros((2,), jnp.int32)
+
+    def flat(a):
+        # collapse leading dims only — layout-free for row-major tiling
+        # (the trailing dim's (8,128) tiles are untouched)
+        return a.reshape(rows, last)
+
+    bc = min(512, ((last + LANES - 1) // LANES) * LANES)
+    br = max(8, min(rows, (_BLOCK_ROWS * LANES) // bc))
+    grid = (pl.cdiv(rows, br), pl.cdiv(last, bc))
+    blk = pl.BlockSpec((br, bc), lambda i, j: (i, j))
+    ins = [flat(p), flat(g), flat(m1s), flat(m2s)]
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM)] + [blk] * 4
+    outs = [jax.ShapeDtypeStruct((rows, last), p.dtype),
+            jax.ShapeDtypeStruct((rows, last), m1s.dtype),
+            jax.ShapeDtypeStruct((rows, last), m2s.dtype)]
+    # alias the state buffers through (in-place update); operand indices
+    # count the two SMEM scalar inputs first
+    aliases = {2: 0, 4: 1, 5: 2}
+    if master is not None:
+        ins.append(flat(master))
+        in_specs.append(blk)
+        outs.append(jax.ShapeDtypeStruct((rows, last), jnp.float32))
+        aliases[6] = 3
+    kern = functools.partial(
+        _kernel, b1=float(beta1), b2=float(beta2), eps=float(epsilon),
+        l2=float(l2), dec=float(decoupled), sr=sr,
+        has_master=master is not None)
+    res = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[blk] * len(outs),
+        out_shape=outs,
+        input_output_aliases=aliases,
+        interpret=_interpret(),
+    )(scalars, seed, *ins)
+    new_p = res[0].reshape(shape)
+    out = {"moment1": res[1].reshape(shape),
+           "moment2": res[2].reshape(shape)}
+    if master is not None:
+        out["master"] = res[3].reshape(shape)
+    return new_p, out

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ...ops import register_pallas_impl
 import paddle_tpu.kernels.pallas.flash_attention as fa
+import paddle_tpu.kernels.pallas.layer_norm as ln
 import paddle_tpu.kernels.pallas.rms_norm as rn
 
 
@@ -148,6 +149,22 @@ def _flashmask_pallas(query, key, value, startend_row_indices=None,
                              startend_row_indices=fm, window=window,
                              dropout_p=p, dropout_seed=seed)
     return out, None
+
+
+def _ln_supported(x, normalized_shape, weight=None, bias=None,
+                  epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    return (weight is not None and len(tuple(normalized_shape)) == 1
+            and tuple(normalized_shape)[0] == x.shape[-1]
+            and ln.supported(x, weight, epsilon))
+
+
+@register_pallas_impl("layer_norm", supported=_ln_supported)
+def _layer_norm_pallas(x, normalized_shape, weight=None, bias=None,
+                       epsilon=1e-5, name=None):
+    del normalized_shape, name
+    return ln.layer_norm(x, weight, bias, epsilon)
 
 
 def _rms_supported(x, weight=None, bias=None, epsilon=1e-6,

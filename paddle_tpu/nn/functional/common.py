@@ -34,7 +34,9 @@ def linear(x, weight, bias=None, name=None):
             op="linear", x=x, weight=w)
     out = jnp.matmul(x, w)
     if bias is not None:
-        out = out + jnp.asarray(bias)
+        # bias in the matmul's dtype: an fp32 bias next to bf16 x/W would
+        # promote the output (and everything downstream) to fp32
+        out = out + jnp.asarray(bias).astype(out.dtype)
     return out
 
 

@@ -27,7 +27,7 @@ def normalize(x, p=2, axis=1, epsilon=1e-12):
     return x / jnp.maximum(n, epsilon)
 
 
-@register_op("layer_norm", tags=["norm"])
+@register_op("layer_norm", tags=["norm", "fusion"], dispatch=True)
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     del name
     if isinstance(normalized_shape, int):
@@ -38,10 +38,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
     out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     out = out.astype(x.dtype)
+    # mixed-precision contract: output dtype == input dtype. The affine
+    # params commonly stay fp32 next to bf16 activations; multiplying in
+    # their dtype would silently re-promote every downstream activation
+    # (and the attention kernels) to fp32 — measured as the single biggest
+    # BERT-step cost before round 4.
     if weight is not None:
-        out = out * jnp.asarray(weight)
+        out = out * jnp.asarray(weight).astype(x.dtype)
     if bias is not None:
-        out = out + jnp.asarray(bias)
+        out = out + jnp.asarray(bias).astype(x.dtype)
     return out
 
 
@@ -54,10 +59,12 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=red, keepdims=True)
     out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    # same output-dtype contract as layer_norm (fp32 affine params must
+    # not promote bf16 activations)
     if weight is not None:
-        out = out * jnp.asarray(weight)
+        out = out * jnp.asarray(weight).astype(x.dtype)
     if bias is not None:
-        out = out + jnp.asarray(bias)
+        out = out + jnp.asarray(bias).astype(x.dtype)
     return out
 
 

@@ -372,10 +372,31 @@ class Adam(Optimizer):
         new_pf = pf - lr * upd - self._decoupled_decay(pf, lr)
         return new_pf, m1, m2
 
+    def _fused_coeffs(self):
+        """(l2_into_grad, decoupled) decay coefficients for the Pallas
+        fused kernel — must mirror _apply_l2/_decoupled_decay exactly."""
+        return self._decay_coeff(), 0.0
+
     def _update(self, p, g, slot, lr, step, rng=None):
         from ..framework.selected_rows import SelectedRows
         if isinstance(g, SelectedRows):
             return self._update_sparse(p, g, slot, lr, step, rng)
+        # Pallas fused single-pass update (reference:
+        # fusion/gpu/fused_adam_kernel.cu). Only for exact Adam/AdamW math
+        # (subclasses override pieces of _adam_core); XLA path otherwise.
+        from ..flags import flag
+        from ..ops.registry import _on_tpu
+        if type(self) in _FUSED_TYPES and _on_tpu() \
+                and flag("enable_pallas_kernels"):
+            from ..kernels.pallas import fused_adam as _fa
+            if _fa.supported(p, g, slot):
+                sr_rng = rng if (rng is not None and flag(
+                    "bf16_stochastic_rounding_moments")) else None
+                l2c, decc = self._fused_coeffs()
+                return _fa.adam_update(
+                    p, g, slot, lr, step, sr_rng, beta1=self._beta1,
+                    beta2=self._beta2, epsilon=self._epsilon, l2=l2c,
+                    decoupled=decc)
         gf = g.astype(jnp.float32)
         master = slot.get("master", None)
         pf = master if master is not None else p.astype(jnp.float32)
@@ -548,6 +569,11 @@ class AdamW(Adam):
                 and not self._apply_decay_param_fun(self._current_param_name)):
             return 0.0
         return lr * self._decay_coeff() * p
+
+    def _fused_coeffs(self):
+        # _decoupled_decay(p=1, lr=1) IS the scalar coefficient — one
+        # implementation of the decay-filter predicate, not two
+        return 0.0, float(self._decoupled_decay(1.0, 1.0))
 
     def apply(self, params, grads, state, lr=None):
         # Track param names (dict pytrees) so apply_decay_param_fun works.

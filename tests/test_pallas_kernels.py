@@ -277,6 +277,48 @@ def test_flash_attention_segment_ids():
                                    rtol=3e-4, atol=3e-4)
 
 
+def test_flash_attention_segment_skip_misaligned():
+    """Segment boundaries that do NOT align with tile boundaries: the
+    dynamic range-overlap tile skip (_seg_block_overlap) must stay exact —
+    partially-overlapping tiles run, fully-disjoint ones skip, and -1 pad
+    tails keep the composed path's semantics."""
+    b, s, h, d = 1, 384, 2, 64
+    q = _rand(b, s, h, d, seed=41) * 0.3
+    k = _rand(b, s, h, d, seed=42) * 0.3
+    v = _rand(b, s, h, d, seed=43)
+    # lengths chosen so some 64-wide tiles hold a SINGLE id: tile range
+    # pairs like [0,0] x [2,2] are disjoint and actually take the skip
+    # branch (with every segment shorter than a tile, all ranges overlap
+    # and the gate would never fire). 300 real tokens + 84 pad (-1).
+    seg_np = np.full((s,), -1, np.int32)
+    off = 0
+    for sid, ln in enumerate([140, 40, 120]):
+        seg_np[off:off + ln] = sid
+        off += ln
+    # sanity: at block 64 there must exist a fully-disjoint tile pair
+    t = seg_np.reshape(s // 64, 64)
+    lo, hi = t.min(1), t.max(1)
+    assert any(hi[i] < lo[j] or hi[j] < lo[i]
+               for i in range(len(lo)) for j in range(len(lo)) if i != j)
+    seg = jnp.asarray(seg_np[None])
+    out = flash_attention(q, k, v, False, None, 64, 64,
+                          q_segment_ids=seg, kv_segment_ids=seg)
+    mask = (seg[0][:, None] == seg[0][None, :])[None, None]
+    ref = _sdpa_reference(q, k, v, attn_mask=mask)
+    real = np.asarray(seg_np >= 0)
+    np.testing.assert_allclose(np.asarray(out)[:, real],
+                               np.asarray(ref)[:, real],
+                               rtol=2e-4, atol=2e-4)
+    gp = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, False, None, 64, 64, q_segment_ids=seg,
+        kv_segment_ids=seg)[:, real] ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(_sdpa_reference(
+        q, k, v, attn_mask=mask)[:, real] ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4)
+
+
 def test_flash_attention_window():
     b, s, h, d = 1, 256, 2, 64
     q = _rand(b, s, h, d, seed=34) * 0.3
