@@ -61,7 +61,9 @@ def run_moments_offload(on_tpu):
                                  else None)
 
     def loss_fn(p, tokens, labels):
-        return G.dense_loss(p, tokens, labels, cfg)
+        # full remat: this tier's contract is minimum activation memory
+        # (HBM holds params + grads + activations only)
+        return G.dense_loss(p, tokens, labels, cfg, remat_save=())
 
     _, place, compile_for = build_sharded_train_step(
         loss_fn, opt, mesh, level="os", data_axes="sharding", offload=True)

@@ -440,9 +440,21 @@ def _mask_input_list(bias, qseg, kseg, fm_start, fm_end):
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
          bias=None, qseg=None, kseg=None, fm_start=None, fm_end=None,
-         window=None, dropout_p=0.0, seed=None, save_lse=True, vma=None):
-    """q: [B*H, Sq, D]; k/v: [B*H_kv, Sk, D]."""
-    bh, sq, d = q.shape
+         window=None, dropout_p=0.0, seed=None, save_lse=True, vma=None,
+         native=False):
+    """q: [B*H, Sq, D]; k/v: [B*H_kv, Sk, D]. With native=True the main
+    tensors arrive HEAD-NATIVE as [B, Sq, H*D] / [B, Sk, H_kv*D] (a free
+    reshape of the model's [B, S, H, D]) and each program's (1, block, d)
+    tile is lane-sliced out of the fused head dim by the index map — no
+    host-side [B,S,H,D] -> [B*H,S,D] transpose copy ever happens. Only
+    legal when d % 128 == 0 (Mosaic lane-block divisibility); the kernel
+    body is identical either way."""
+    if native:
+        b_n, sq, hd = q.shape
+        d = hd // h
+        bh = b_n * h
+    else:
+        bh, sq, d = q.shape
     sk = k.shape[1]
     g = h // h_kv
     nq, nk = sq // block_q, sk // block_k
@@ -457,12 +469,20 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
         has_bias=bias is not None, has_seg=qseg is not None,
         has_fm=fm_start is not None, dropout_p=dropout_p, save_lse=save_lse)
 
-    kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), kv_idx),
-        pl.BlockSpec((1, block_k, d), kv_idx),
-    ]
+    if native:
+        kv_idx = lambda b, i, j: (b // h, j, (b % h) // g)
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b // h, i, b % h)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+        ]
+    else:
+        kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+        ]
     head, tail = _build_specs(
         grid_kind="fwd", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=None if bias is None else bias.shape,
@@ -473,15 +493,21 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, *, h, h_kv,
     inputs = ([seed] if dropout_p else []) + [q, k, v] + _mask_input_list(
         bias, qseg, kseg, fm_start, fm_end)
 
-    ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    if native:
+        ospec = pl.BlockSpec((1, block_q, d),
+                             lambda b, i, j: (b // h, i, b % h))
+        oshape = (bh // h, sq, h * d)
+    else:
+        ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+        oshape = (bh, sq, d)
     lspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     if save_lse:
         out_specs = [ospec, lspec]
-        out_shape = [_sds((bh, sq, d), q.dtype, vma),
+        out_shape = [_sds(oshape, q.dtype, vma),
                      _sds((bh, sq, _LANES), jnp.float32, vma)]
     else:
         out_specs = ospec
-        out_shape = _sds((bh, sq, d), q.dtype, vma)
+        out_shape = _sds(oshape, q.dtype, vma)
     res = pl.pallas_call(
         kernel,
         grid=grid,
@@ -658,9 +684,15 @@ def _dq_kernel(*refs, sm_scale, causal, offset, window, block_q, block_k,
 def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
               h, h_kv, bias=None, qseg=None, kseg=None, fm_start=None,
               fm_end=None, window=None, dropout_p=0.0, seed=None, vma=None,
-              bias_grad=False):
-    bh, sq, d = q.shape
-    bh_kv, sk, _ = k.shape
+              bias_grad=False, native=False):
+    if native:
+        b_n, sq, hd = q.shape
+        d = hd // h
+        bh, bh_kv = b_n * h, b_n * h_kv
+        sk = k.shape[1]
+    else:
+        bh, sq, d = q.shape
+        bh_kv, sk, _ = k.shape
     g = h // h_kv
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
@@ -681,11 +713,19 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
     # group is folded into the innermost axis so GQA reductions accumulate
     # in the VMEM scratch rather than racing on an HBM block.
     num_t = g * nq
-    qspec = pl.BlockSpec(
-        (1, block_q, d),
-        lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
-                           t % nq, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
+    if native:
+        qspec = pl.BlockSpec(
+            (1, block_q, d),
+            lambda bkv, j, t: (bkv // h_kv, t % nq,
+                               (bkv % h_kv) * g + t // nq))
+        kspec = pl.BlockSpec((1, block_k, d),
+                             lambda bkv, j, t: (bkv // h_kv, j, bkv % h_kv))
+    else:
+        qspec = pl.BlockSpec(
+            (1, block_q, d),
+            lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
+                               t % nq, 0))
+        kspec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
     rspec = pl.BlockSpec(
         (1, block_q, _LANES),
         lambda bkv, j, t: (bkv // h_kv * h + (bkv % h_kv) * g + t // nq,
@@ -702,13 +742,10 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
             has_seg=has_seg, has_fm=has_fm, dropout_p=dropout_p),
         grid=(bh_kv, nk, num_t),
         in_specs=head + [qspec, kspec, kspec, qspec, qspec, rspec] + tail,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0)),
-        ],
+        out_specs=[kspec, kspec],
         out_shape=[
-            _sds((bh_kv, sk, d), k.dtype, vma),
-            _sds((bh_kv, sk, d), v.dtype, vma),
+            _sds(k.shape, k.dtype, vma),
+            _sds(v.shape, v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -718,17 +755,23 @@ def _bwd_impl(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, *,
     )(*seed_inputs, q, k, v, out, do, lse_r, *extra_inputs)
 
     # ---- dq: grid (B*H, q blocks, k blocks)
-    kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), kv_idx)
+    if native:
+        qspec2 = pl.BlockSpec((1, block_q, d),
+                              lambda b, i, j: (b // h, i, b % h))
+        kspec2 = pl.BlockSpec((1, block_k, d),
+                              lambda b, i, j: (b // h, j, (b % h) // g))
+    else:
+        kv_idx = lambda b, i, j: (b // h * h_kv + (b % h) // g, j, 0)
+        qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+        kspec2 = pl.BlockSpec((1, block_k, d), kv_idx)
     rspec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     head, tail = _build_specs(
         grid_kind="dq", h=h, h_kv=h_kv, g=g, nq=nq, block_q=block_q,
         block_k=block_k, d=d, bias_shape=bias_shape, has_seg=has_seg,
         has_fm=has_fm, dropout_p=dropout_p, fm_mh=fm_mh)
     emit_db = bias_grad and bias is not None
-    dq_ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    dq_oshape = _sds((bh, sq, d), q.dtype, vma)
+    dq_ospec = qspec2
+    dq_oshape = _sds(q.shape, q.dtype, vma)
     if emit_db:
         # in-kernel dbias: each (b, i, j) tile writes its slice of the
         # full-resolution [B*H, Sq, Sk] gradient once (fp32); broadcast
@@ -796,12 +839,22 @@ def _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
     fm_start = fm_end = None
     if bias is not None and isinstance(bias, tuple):
         bias, fm_start, fm_end = bias
-    q, k, v = _prep(query), _prep(key), _prep(value)
+    # head-native lane slicing needs d % 128 == 0 (Mosaic lane blocks);
+    # smaller heads pay the [B,S,H,D] -> [B*H,S,D] transpose copy
+    native = d % 128 == 0
+    if native:
+        q = query.reshape(b, sq, h * d)
+        k = key.reshape(b, key.shape[1], h_kv * d)
+        v = value.reshape(b, value.shape[1], h_kv * d)
+    else:
+        q, k, v = _prep(query), _prep(key), _prep(value)
     out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, h=h,
                     h_kv=h_kv, bias=bias, qseg=q_seg, kseg=kv_seg,
                     fm_start=fm_start, fm_end=fm_end, window=window,
-                    dropout_p=dropout_p, seed=seed, save_lse=save_lse)
-    return _unprep(out, b, h), (q, k, v, out, lse, b, h, h_kv)
+                    dropout_p=dropout_p, seed=seed, save_lse=save_lse,
+                    native=native)
+    out4 = out.reshape(b, sq, h, d) if native else _unprep(out, b, h)
+    return out4, (q, k, v, out, lse, b, h, h_kv, native)
 
 
 def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
@@ -815,17 +868,18 @@ def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
                bias_grad, res, g):
-    q, k, v, out, lse, b, h, h_kv, bias, q_seg, kv_seg, seed = res
+    q, k, v, out, lse, b, h, h_kv, native, bias, q_seg, kv_seg, seed = res
     fm_start = fm_end = None
     is_fm = bias is not None and isinstance(bias, tuple)
     if is_fm:
         bias, fm_start, fm_end = bias
-    do = _prep(g)
+    bsq, d4 = g.shape[1], g.shape[3]
+    do = g.reshape(b, bsq, h * d4) if native else _prep(g)
     dq, dk, dv, db_full = _bwd_impl(
         q, k, v, out, lse, do, sm_scale, causal, block_q, block_k, h=h,
         h_kv=h_kv, bias=bias, qseg=q_seg, kseg=kv_seg, fm_start=fm_start,
         fm_end=fm_end, window=window, dropout_p=dropout_p, seed=seed,
-        bias_grad=bias_grad)
+        bias_grad=bias_grad, native=native)
     dbias = None
     if bias is not None or is_fm:
         if bias_grad and bias is not None:
@@ -849,6 +903,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, window, dropout_p,
             dbias = jax.tree_util.tree_map(jnp.zeros_like,
                                            (bias, fm_start, fm_end)
                                            if is_fm else bias)
+    if native:
+        sk = k.shape[1]
+        return (dq.reshape(b, bsq, h, d4), dk.reshape(b, sk, h_kv, d4),
+                dv.reshape(b, sk, h_kv, d4), dbias, None, None, None)
     return (_unprep(dq, b, h), _unprep(dk, b, h_kv), _unprep(dv, b, h_kv),
             dbias, None, None, None)
 

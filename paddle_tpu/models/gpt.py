@@ -196,6 +196,12 @@ def hybrid_param_specs(cfg: GPTConfig) -> Dict[str, Any]:
 
 
 def _ln(x, g, b, eps=1e-5):
+    # deliberately the COMPOSED form, not the Pallas fused LayerNorm the
+    # registry dispatches for nn-level users: inside this model's
+    # scan-over-layers + remat structure the kernel's call overhead and
+    # saved-stats traffic cost more than the fusion saves (measured
+    # 597.7 vs 582.2 ms/step on the 1.3B flagship, one v5e, round 4 —
+    # the kernel wins on the unscanned BERT graph instead)
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
@@ -296,47 +302,78 @@ def dense_embed(params, tokens, cfg: GPTConfig):
 def dense_block(p, x, cfg: GPTConfig):
     """One transformer block on an UNstacked per-layer param tree — shared
     by the scan in dense_forward and the param-streaming trainer."""
+    from jax.ad_checkpoint import checkpoint_name
     B, S, H = x.shape
     h = _ln(x, p["ln1_g"], p["ln1_b"])
     qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
            + p["qkv_b"].astype(cfg.dtype))
+    # checkpoint_name tags are inert under plain jax.checkpoint; the
+    # selective remat policy (dense_forward remat_save=) keys on them
+    qkv = checkpoint_name(qkv, "qkv")
     qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
     # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
     # composition elsewhere — same math as the hybrid engine's
     attn = F.scaled_dot_product_attention(
         qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
         is_causal=True)
+    attn = checkpoint_name(attn, "attn_out")
     out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
     x = x + out + p["proj_b"].astype(cfg.dtype)
     h = _ln(x, p["ln2_g"], p["ln2_b"])
     m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
          + p["fc1_b"].astype(cfg.dtype))
+    m = checkpoint_name(m, "fc1")
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
     return x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+
+
+def lm_logsumexp_ce(logits, labels):
+    """Mean next-token CE in logsumexp+gather form, shared by the GPT and
+    Llama dense losses. Logits stay in their compute dtype (bf16 on TPU)
+    in HBM — the f32 convert fuses into the reduction, so the V-length
+    accumulation is fp32 without a whole-tensor [B, S, V] fp32 copy (the
+    largest write of the step) ever materializing; no [B, S, V]
+    log_softmax either."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
+    return jnp.mean(lse - picked)
 
 
 def dense_head_loss(params, x, labels, cfg: GPTConfig):
     """Final LN + LM head + logsumexp CE over the head sub-tree
     {lnf_g, lnf_b, head_w}. Identical math to dense_loss's tail."""
     x = _ln(x, params["lnf_g"], params["lnf_b"])
-    logits = (x.astype(cfg.dtype)
-              @ params["head_w"].astype(cfg.dtype)).astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - picked)
+    logits = x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+    return lm_logsumexp_ce(logits, labels)
 
 
-def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
+def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
+                  remat_save=("attn_out", "qkv")):
     """Single-device forward over the stacked-parameter pytree (no
     collectives). Same math/layout as the hybrid engine — head-major QKV.
     remat=True checkpoints each block (recompute in backward) — the memory/
-    FLOPs trade that keeps long-sequence training inside HBM."""
+    FLOPs trade that keeps long-sequence training inside HBM.
+    remat_save: checkpoint_name'd intermediates kept instead of recomputed
+    (see dense_block tags). Saving attn_out+qkv measured fastest on the
+    1.3B flagship (578.6 vs 600.7 ms/step full-remat, one v5e, round 4:
+    skips recomputing the qkv projection and the flash forward at
+    ~128 MB/layer of saved activations); pass remat_save=() for the
+    minimum-memory full-remat form (bigger-than-HBM configs)."""
     x = dense_embed(params, tokens, cfg)
 
     def block(p, x):
         return dense_block(p, x, cfg)
 
-    blk = jax.checkpoint(block) if remat else block
+    if remat and remat_save:
+        blk = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                *remat_save))
+    elif remat:
+        blk = jax.checkpoint(block)
+    else:
+        blk = block
 
     def body(carry, p):
         return blk(p, carry), None
@@ -346,13 +383,14 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True):
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
 
 
-def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True):
-    logits = dense_forward(params, tokens, cfg, remat=remat).astype(jnp.float32)
-    # logsumexp form: avoids materializing a second [B, S, V] fp32 buffer
-    # (log_softmax) — the big-vocab CE is HBM-bound
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - picked)
+def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True,
+               remat_save=("attn_out", "qkv")):
+    """remat_save threads through to dense_forward — bigger-than-HBM
+    callers (benchmarks/offload_bench.py moments tier) pass () for the
+    minimum-memory full-remat form."""
+    logits = dense_forward(params, tokens, cfg, remat=remat,
+                           remat_save=remat_save)
+    return lm_logsumexp_ce(logits, labels)
 
 
 # ---------------------------------------------------------------------------

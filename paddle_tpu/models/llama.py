@@ -412,11 +412,10 @@ def streamed_fns(cfg: LlamaConfig):
 
 
 def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
-    logits = dense_forward(params, tokens, cfg, remat=remat).astype(jnp.float32)
-    # logsumexp form — see gpt.dense_loss
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - picked)
+    logits = dense_forward(params, tokens, cfg, remat=remat)
+    # bf16-logit logsumexp CE (one shared implementation — gpt.py)
+    from .gpt import lm_logsumexp_ce
+    return lm_logsumexp_ce(logits, labels)
 
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,

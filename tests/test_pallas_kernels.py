@@ -319,6 +319,33 @@ def test_flash_attention_segment_skip_misaligned():
                                    rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.parametrize("h,hkv", [(4, 4), (4, 2)])
+def test_flash_attention_head_native_d128(h, hkv):
+    """d % 128 == 0 takes the HEAD-NATIVE lane-sliced path: [B, S, H, D]
+    is viewed as [B, S, H*D] and each program's tile is lane-indexed out
+    of the fused head dim (no transpose copy). Exercises the native
+    BlockSpec index maps in all three kernels (fwd/dq/dkv), incl. GQA —
+    every other flash test uses d=64, which runs only the legacy branch."""
+    b, s, d = 2, 256, 128
+    q = _rand(b, s, h, d, seed=51) * 0.3
+    k = _rand(b, s, hkv, d, seed=52) * 0.3
+    v = _rand(b, s, hkv, d, seed=53)
+    out = flash_attention(q, k, v, True, None, 128, 128)
+    rep = h // hkv
+    ref = _sdpa_reference(q, jnp.repeat(k, rep, axis=2),
+                          jnp.repeat(v, rep, axis=2), is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    gp = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, True, None, 128, 128) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(_sdpa_reference(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+        is_causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
 def test_flash_attention_window():
     b, s, h, d = 1, 256, 2, 64
     q = _rand(b, s, h, d, seed=34) * 0.3
