@@ -1,29 +1,41 @@
-"""Streams/events API shims (reference: python/paddle/device/cuda/streams
+"""Streams/events (reference: python/paddle/device/cuda/streams
 Stream/Event + synchronize; C++ per-device streams in
 paddle/phi/core/device_context.h).
 
-TPU design: XLA owns scheduling — a compiled program's internal
+TPU design: XLA owns device scheduling — a compiled program's internal
 parallelism, collective overlap and transfer pipelining replace
-hand-managed streams (there is exactly one logical stream per core).
-These classes keep stream-shaped reference code running. What is REAL:
-Event.record(tokens=...)/synchronize/query (block_until_ready over the
-recorded arrays), Event.elapsed_time (host clock), and synchronize()
-(drains the device). What is intentionally a NO-OP because the concept
-does not exist on TPU: Stream identity/priority, stream_guard, wait_stream
-ordering (XLA already orders the one logical stream). Nothing here
-schedules anything — do not port stream-overlap optimizations through this
-API; express overlap with sharding/donation and let XLA schedule.
+hand-managed streams (there is exactly one hardware queue per core). What
+a Stream here IS: a real host-side work-tracking handle. While a stream
+is current (``stream_guard``), every registry-dispatched op registers its
+output arrays on it, so ``Stream.query/synchronize``, ``Event.record``
+(snapshot of the stream's in-flight work), ``Event.query/synchronize``,
+``wait_event`` and ``wait_stream`` all observe and order REAL dispatched
+work — jax dispatch is asynchronous, so blocking the host before the next
+dispatch is a faithful (conservative) implementation of cross-stream
+ordering. What stays a NO-OP because the concept does not exist on TPU:
+stream *priority* and any claim of a second hardware queue — two Streams
+give you bookkeeping, not extra device parallelism. Do not port
+stream-overlap optimizations through this API; express overlap with
+sharding/donation and let XLA schedule.
+
+Inside jit tracing, outputs are tracers and are not recorded (the traced
+program is one schedule; record events around the jitted CALL instead).
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from typing import Any, List, Optional
 
 import jax
 
 __all__ = ["Stream", "Event", "current_stream", "stream_guard",
            "synchronize"]
+
+_TLS = threading.local()
+_INFLIGHT_CAP = 256  # per stream; oldest (almost surely done) pruned first
 
 
 def synchronize(device=None) -> None:
@@ -32,6 +44,46 @@ def synchronize(device=None) -> None:
     synchronize."""
     from . import synchronize as _device_synchronize
     _device_synchronize(device)
+
+
+def _is_trackable(x) -> bool:
+    return isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer)
+
+
+def _note_outputs(out) -> None:
+    """Registry hook (ops.registry.STREAM_NOTE): record a dispatched op's
+    output arrays on the current stream."""
+    s = getattr(_TLS, "stream", None)
+    if s is None:
+        return
+    leaves = [x for x in jax.tree.leaves(out) if _is_trackable(x)]
+    if leaves:
+        s._note_many(leaves)
+
+
+def _install_hook() -> None:
+    from ..ops import registry
+    if registry.STREAM_NOTE is None:
+        registry.STREAM_NOTE = _note_outputs
+
+
+def _ready(arr) -> bool:
+    try:
+        return bool(arr.is_ready())
+    except Exception:
+        return True  # deleted/donated buffers count as complete
+
+
+def _block_all(tokens) -> None:
+    """block_until_ready tolerant of deleted/donated buffers (donation is
+    this module's own recommended overlap mechanism — a tracked output
+    later donated into a jitted update must count as complete, matching
+    query())."""
+    for t in tokens:
+        try:
+            jax.block_until_ready(t)
+        except Exception:
+            pass
 
 
 class Event:
@@ -43,26 +95,24 @@ class Event:
         self._time: Optional[float] = None
 
     def record(self, stream: Optional["Stream"] = None, tokens=None):
-        """Snapshot the work dispatched so far. Optionally pass the arrays
-        whose completion this event represents."""
-        del stream
-        self._tokens = list(tokens) if tokens is not None else []
+        """Snapshot the work the stream has dispatched so far (or the
+        explicitly passed arrays). The event then represents completion of
+        exactly that work."""
+        if tokens is not None:
+            self._tokens = list(tokens)
+        else:
+            s = stream or current_stream()
+            self._tokens = s._snapshot()
         self._time = time.perf_counter()
 
     def synchronize(self):
         if self._tokens:
-            jax.block_until_ready(self._tokens)
+            _block_all(self._tokens)
         else:
             synchronize()
 
     def query(self) -> bool:
-        try:
-            for t in self._tokens:
-                if hasattr(t, "is_ready") and not t.is_ready():
-                    return False
-            return True
-        except Exception:
-            return True
+        return all(_ready(t) for t in self._tokens)
 
     def elapsed_time(self, end: "Event") -> float:
         """Milliseconds between two recorded events (host clock — device
@@ -72,16 +122,47 @@ class Event:
 
 
 class Stream:
-    """No-op stream handle (one logical stream per TPU core)."""
+    """Host-side work-tracking stream (one hardware queue per TPU core —
+    see module docstring for what is and is not real)."""
 
     def __init__(self, device=None, priority: int = 2):
         self.device = device
-        self.priority = priority
+        self.priority = priority  # accepted for API parity; no-op on TPU
+        self._inflight: deque = deque(maxlen=_INFLIGHT_CAP)
+        self._lock = threading.Lock()
 
+    # -- tracking ----------------------------------------------------------
+    def _note_many(self, arrs) -> None:
+        with self._lock:
+            self._prune()  # keep the window bounded by completion, not cap
+            self._inflight.extend(arrs)
+
+    def _note(self, arr) -> None:
+        self._note_many((arr,))
+
+    def _snapshot(self) -> List[Any]:
+        """All tracked work, INCLUDING already-completed arrays — an Event
+        records 'work dispatched so far', and on fast backends everything
+        may already be done by snapshot time."""
+        with self._lock:
+            return list(self._inflight)
+
+    def _prune(self) -> None:
+        while self._inflight and _ready(self._inflight[0]):
+            self._inflight.popleft()
+
+    # -- public API --------------------------------------------------------
     def synchronize(self):
-        synchronize(self.device)
+        toks = self._snapshot()
+        if toks:
+            _block_all(toks)
+        else:
+            synchronize(self.device)
 
     def wait_event(self, event: Event):
+        """Order this stream's FUTURE dispatches after `event`: dispatch is
+        host-driven, so blocking the host here is a correct (conservative)
+        ordering."""
         event.synchronize()
 
     def wait_stream(self, stream: "Stream"):
@@ -93,29 +174,47 @@ class Stream:
         return event
 
     def query(self) -> bool:
-        return True
+        with self._lock:
+            self._prune()
+            return not self._inflight
 
     def __enter__(self):
+        # thread-local restore state directly (a shared self._guard would
+        # corrupt nesting / racing threads entering the same Stream)
+        prev = getattr(_TLS, "stream", None)
+        if not hasattr(_TLS, "prev_stack"):
+            _TLS.prev_stack = []
+        _TLS.prev_stack.append(prev)
+        _install_hook()
+        _TLS.stream = self
         return self
 
     def __exit__(self, *exc):
+        _TLS.stream = _TLS.prev_stack.pop()
         return False
 
 
-_CURRENT = Stream()
+_DEFAULT = Stream()
 
 
 def current_stream(device=None) -> Stream:
     del device
-    return _CURRENT
+    return getattr(_TLS, "stream", None) or _DEFAULT
 
 
 class stream_guard:
+    """Make `stream` current on this thread: registry-dispatched ops
+    record their outputs on it until exit."""
+
     def __init__(self, stream: Stream):
         self.stream = stream
 
     def __enter__(self):
+        _install_hook()
+        self._prev = getattr(_TLS, "stream", None)
+        _TLS.stream = self.stream
         return self.stream
 
     def __exit__(self, *exc):
+        _TLS.stream = self._prev
         return False

@@ -181,7 +181,13 @@ def build_sharded_train_step(
             if jax.tree.structure(expect) == jax.tree.structure(got):
                 return params, state
             # optimizer with a custom state layout: whole-tree fallback
-            # (documented HBM spike)
+            # (documented HBM spike). Every base-class optimizer builds
+            # init_state as {step, slots=tree(_init_slot)} so the per-leaf
+            # path above covers the whole standard family (tested:
+            # tests/test_offload.py per_leaf_init_covers_standard) — only
+            # WRAPPER optimizers with extra tree-wide state (GradientMerge
+            # acc buffers) land here, and their apply() is tree-wide too,
+            # so leaf streaming could not run them anyway.
         s_specs = _state_specs(optimizer, params, mesh, shard_axis)
         init = jax.jit(
             optimizer.init_state,

@@ -54,9 +54,13 @@ class OpSchema:
             and (self.pallas_supported is None or self.pallas_supported(*args, **kwargs))
         ):
             stats["pallas"] += 1
-            return self.pallas_impl(*args, **kwargs)
-        stats["reference"] += 1
-        return self.fn(*args, **kwargs)
+            out = self.pallas_impl(*args, **kwargs)
+        else:
+            stats["reference"] += 1
+            out = self.fn(*args, **kwargs)
+        if STREAM_NOTE is not None:  # device.streams work tracking
+            STREAM_NOTE(out)
+        return out
 
 
 _OPS: Dict[str, OpSchema] = {}
@@ -66,6 +70,11 @@ _OPS: Dict[str, OpSchema] = {}
 # a model that retraces per shape counts per shape. reset=True starts a
 # fresh window around a run under test.
 DISPATCH_STATS: Dict[str, Dict[str, int]] = {}
+
+# device.streams installs its output-tracking hook here the first time a
+# non-default stream becomes current (None = zero-overhead default path).
+# Called with each dispatched op's output pytree.
+STREAM_NOTE: Optional[Callable[[Any], None]] = None
 
 
 def dispatch_stats(reset: bool = False) -> Dict[str, Dict[str, int]]:
