@@ -67,23 +67,28 @@ def _install_hook() -> None:
         registry.STREAM_NOTE = _note_outputs
 
 
-def _ready(arr) -> bool:
+def _deleted(arr) -> bool:
     try:
-        return bool(arr.is_ready())
+        return bool(arr.is_deleted())
     except Exception:
+        return False
+
+
+def _ready(arr) -> bool:
+    if _deleted(arr):
         return True  # deleted/donated buffers count as complete
+    return bool(arr.is_ready())
 
 
 def _block_all(tokens) -> None:
-    """block_until_ready tolerant of deleted/donated buffers (donation is
-    this module's own recommended overlap mechanism — a tracked output
-    later donated into a jitted update must count as complete, matching
-    query())."""
+    """block_until_ready tolerant of deleted/donated buffers ONLY
+    (donation is this module's own recommended overlap mechanism — a
+    tracked output later donated into a jitted update must count as
+    complete, matching query()). Real async device errors still
+    propagate."""
     for t in tokens:
-        try:
+        if not _deleted(t):
             jax.block_until_ready(t)
-        except Exception:
-            pass
 
 
 class Event:
@@ -204,17 +209,15 @@ def current_stream(device=None) -> Stream:
 
 class stream_guard:
     """Make `stream` current on this thread: registry-dispatched ops
-    record their outputs on it until exit."""
+    record their outputs on it until exit. Delegates to Stream's own
+    context-manager protocol (one thread-local prev-stack — a guard
+    instance holds no restore state, so reuse/nesting is safe)."""
 
     def __init__(self, stream: Stream):
         self.stream = stream
 
     def __enter__(self):
-        _install_hook()
-        self._prev = getattr(_TLS, "stream", None)
-        _TLS.stream = self.stream
-        return self.stream
+        return self.stream.__enter__()
 
     def __exit__(self, *exc):
-        _TLS.stream = self._prev
-        return False
+        return self.stream.__exit__(*exc)

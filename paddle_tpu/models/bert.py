@@ -87,7 +87,18 @@ class BertModel(nn.Layer):
         pass position_ids that restart at each sequence start so learned
         position embeddings match the unpacked layout."""
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        if attention_mask is not None and attention_mask.ndim == 2:
+        if (attention_mask is not None and attention_mask.ndim == 2
+                and pack_segment_ids is None):
+            # [B, S] padding mask == packing with ONE segment: express it
+            # as segment ids (valid -> 0, pad -> -1) so the attention
+            # kernel compares int ids per tile instead of loading an
+            # additive [bq, bk] fp32 mask — the padded path rides the
+            # packed infrastructure. Valid tokens never attend pads
+            # (0 != -1); pad rows are ignored by the loss either way.
+            pack_segment_ids = jnp.where(attention_mask > 0, 0, -1) \
+                .astype(jnp.int32)
+            attention_mask = None
+        elif attention_mask is not None and attention_mask.ndim == 2:
             # [B, S] padding mask → additive [B, 1, 1, S]
             attention_mask = jnp.where(
                 attention_mask[:, None, None, :] > 0, 0.0, -1e30)
