@@ -77,18 +77,31 @@ def _deleted(arr) -> bool:
 def _ready(arr) -> bool:
     if _deleted(arr):
         return True  # deleted/donated buffers count as complete
-    return bool(arr.is_ready())
+    try:
+        return bool(arr.is_ready())
+    except AttributeError:
+        return True  # user-passed non-jax tokens count as complete
+    except Exception:
+        if _deleted(arr):  # deleted by another thread mid-check
+            return True
+        raise
 
 
 def _block_all(tokens) -> None:
     """block_until_ready tolerant of deleted/donated buffers ONLY
     (donation is this module's own recommended overlap mechanism — a
     tracked output later donated into a jitted update must count as
-    complete, matching query()). Real async device errors still
+    complete, matching query()); the deleted re-check handles a donation
+    landing from another thread mid-wait. Real async device errors still
     propagate."""
     for t in tokens:
-        if not _deleted(t):
+        if _deleted(t):
+            continue
+        try:
             jax.block_until_ready(t)
+        except Exception:
+            if not _deleted(t):
+                raise
 
 
 class Event:

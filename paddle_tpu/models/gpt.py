@@ -238,7 +238,12 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp"):
     qkv = (hi.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
            + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
     qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
-    attn = _attention(qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+    # registry op: Pallas flash on TPU (the engine's shard_map runs with
+    # check_vma=False, so the kernel traces inside it); composed O(S^2)
+    # fallback elsewhere — heads are fully local under TP, so per-shard
+    # attention is the whole computation
+    attn = F.scaled_dot_product_attention(
+        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
     attn = attn.reshape(B, S, H // mp)
     out = attn @ p["proj_w"].astype(cfg.dtype)  # row-parallel: [B, S, H]
     out = mp_ops.mp_allreduce(out, mp_axis) + p["proj_b"].astype(cfg.dtype)

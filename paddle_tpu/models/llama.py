@@ -116,11 +116,11 @@ def _rope(x, cos, sin):
                            axis=-1).astype(x.dtype)
 
 
-def _flash_gqa(q, k, v, num_heads: int, num_kv_heads: int):
+def _flash_gqa(q, k, v):
     """Ride the registry attention with native GQA — the Pallas kernel
     indexes KV heads per query-head group (no HBM head repeat); the
-    XLA-composed fallback repeats on the fly."""
-    del num_heads, num_kv_heads
+    XLA-composed fallback repeats on the fly. Grouping is inferred from
+    the q/k head dims."""
     return F.scaled_dot_product_attention(q, k, v, is_causal=True)
 
 
@@ -159,7 +159,7 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         v = self.v_proj(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-        out = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+        out = _flash_gqa(q, k, v)
         return self.o_proj(out.reshape(B, S, -1))
 
 
@@ -286,7 +286,10 @@ def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp"):
     kk = (hi @ p["k_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
     vv = (hi @ p["v_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
     q, kk = _rope(q, cos, sin), _rope(kk, cos, sin)
-    attn = _gqa_attention(q, kk, vv).reshape(B, S, H // mp)
+    # registry attention (Pallas flash with native GQA on TPU — the
+    # engine's shard_map runs check_vma=False so the kernel traces inside
+    # it; composed fallback elsewhere). Heads are rank-local under TP.
+    attn = _flash_gqa(q, kk, vv).reshape(B, S, H // mp)
     out = attn @ p["o_w"].astype(cd)  # row-parallel
     x = x + mp_ops.mp_allreduce(out, mp_axis)
 
@@ -317,7 +320,7 @@ def dense_block(p, x, cfg: LlamaConfig):
     v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
                                           cfg.head_dim)
     q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-    attn = _flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+    attn = _flash_gqa(q, k, v)
     x = x + attn.reshape(B, S, H) @ p["o_w"].astype(cd)
     h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
     m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
