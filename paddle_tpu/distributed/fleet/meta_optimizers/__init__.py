@@ -1,6 +1,8 @@
+from .dgc import DGCMomentum
 from .hybrid_parallel_optimizer import (HybridParallelClipGrad,
                                         HybridParallelGradScaler,
                                         HybridParallelOptimizer)
+from .localsgd import LocalSGD
 
 __all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad",
-           "HybridParallelGradScaler"]
+           "HybridParallelGradScaler", "LocalSGD", "DGCMomentum"]

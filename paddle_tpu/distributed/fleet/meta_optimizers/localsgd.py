@@ -1,0 +1,53 @@
+"""LocalSGD meta-optimizer (reference:
+python/paddle/distributed/fleet/meta_optimizers/localsgd_optimizer.py
+LocalSGDOptimizer: k_steps local updates, then parameter averaging across
+the data-parallel group — trades per-step gradient all-reduce bandwidth
+for periodic parameter synchronization).
+
+TPU design: a pure optimizer WRAPPER that runs inside the explicit-SPMD
+engine's shard_map. It sets ``_skips_grad_sync`` so
+models.hybrid_engine.build_train_step hands it the UNreduced local
+gradients; the inner optimizer advances each replica independently and
+every ``k_steps`` the parameters are averaged with one ``lax.pmean`` over
+the dp axis. Optimizer MOMENTS stay local (reference semantics — only
+parameters synchronize); after a sync step all replicas hold identical
+parameters, between syncs they drift on their local shards.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["LocalSGD"]
+
+
+class LocalSGD:
+    _skips_grad_sync = True
+
+    def __init__(self, inner, k_steps: int = 4, dp_axis: str = "dp"):
+        assert k_steps >= 1
+        self._inner = inner
+        self.k_steps = int(k_steps)
+        self.dp_axis = dp_axis
+
+    def init_state(self, params):
+        return {"inner": self._inner.init_state(params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def apply(self, params, grads, state, lr=None):
+        new_p, new_inner = self._inner.apply(params, grads,
+                                             state["inner"], lr)
+        count = state["count"] + 1
+
+        def sync(p):
+            return jax.tree.map(
+                lambda x: lax.pmean(x, self.dp_axis).astype(x.dtype), p)
+
+        new_p = lax.cond(count % self.k_steps == 0, sync, lambda p: p,
+                         new_p)
+        return new_p, {"inner": new_inner, "count": count}

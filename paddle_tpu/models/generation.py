@@ -232,7 +232,7 @@ def _llama_stack(params, x, cache: KVCache, pos, seq, cos, sin, attn_fn,
 def _llama_gqa_prefill_attn(cfg):
     def attn(q, k, v, ck, cv):
         del ck, cv
-        return L._flash_gqa(q, k, v, cfg.num_heads, cfg.num_kv_heads)
+        return L._flash_gqa(q, k, v)
     return attn
 
 
