@@ -66,7 +66,12 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
 
 _SWITCHES = ("amp", "recompute", "pipeline", "sharding", "gradient_merge",
              "sequence_parallel", "bf16", "fuse_all_reduce_ops",
-             "find_unused_parameters", "heter_ccl_mode", "without_graph_optimization")
+             "find_unused_parameters", "heter_ccl_mode",
+             "without_graph_optimization",
+             # reference fp16_allreduce meta-optimizer: compress the dp
+             # gradient all-reduce (bf16 on TPU — see
+             # models.hybrid_engine.build_train_step grad_reduce_dtype)
+             "fp16_allreduce")
 
 
 class DistributedStrategy:

@@ -130,6 +130,17 @@ class Fleet:
                     optimizer, k_steps=k, avg=s.gradient_merge_configs["avg"])
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
+    def grad_reduce_dtype(self):
+        """Reduction dtype implied by the strategy — bf16 when
+        ``strategy.fp16_allreduce`` is set (the reference fp16_allreduce
+        meta-optimizer; bf16 is the TPU-native half type). Pass the result
+        to build_hybrid_train_step/build_train_step(grad_reduce_dtype=)."""
+        import jax.numpy as jnp
+        s = self._strategy
+        if s is not None and getattr(s, "fp16_allreduce", False):
+            return jnp.bfloat16
+        return None
+
     def distributed_scaler(self, scaler):
         from .meta_optimizers import HybridParallelGradScaler
         return HybridParallelGradScaler(scaler, self._hcg)

@@ -67,7 +67,7 @@ def state_specs_for(optimizer, specs, example_params=None):
 def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
-                     grad_reduce_dtype=None):
+                     grad_reduce_dtype="auto"):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -75,8 +75,14 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     and back (the reference's fp16_allreduce meta-optimizer,
     fleet/meta_optimizers/fp16_allreduce_optimizer.py — halves the
     ICI/DCN bytes of the gradient all-reduce; bf16 recommended on TPU).
-    Optimizers that manage their own synchronization (LocalSGD — attribute
-    `_skips_grad_sync`) receive UNreduced local gradients."""
+    The default "auto" reads the active fleet strategy, so the reference
+    flow `strategy.fp16_allreduce = True; fleet.init(strategy=s)` engages
+    with no extra plumbing; pass None to force fp32 reduction. Optimizers
+    that manage their own synchronization (LocalSGD/DGC — attribute
+    `_skips_grad_sync`) receive dp-UNreduced local gradients."""
+    if grad_reduce_dtype == "auto":
+        from ..distributed.fleet.fleet import fleet as _fleet
+        grad_reduce_dtype = _fleet.grad_reduce_dtype()
     data_spec = P(dp_axis) if data_spec is None else data_spec
     sspec = state_specs_for(optimizer, specs, example_params)
 

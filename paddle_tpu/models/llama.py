@@ -458,7 +458,7 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
 def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
-                            virtual_pp: int = 1):
+                            virtual_pp: int = 1, grad_reduce_dtype="auto"):
     from .hybrid_engine import build_train_step
 
     def loss_fn(p, tokens, labels):
@@ -470,7 +470,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
-        extra_grad_axes=extra_grad_axes, example_params=example)
+        extra_grad_axes=extra_grad_axes, example_params=example,
+        grad_reduce_dtype=grad_reduce_dtype)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

@@ -57,6 +57,18 @@ def test_fp16_allreduce_matches_fp32_reduction():
                                rtol=2e-2, atol=2e-3)
 
 
+def test_strategy_fp16_allreduce_switch():
+    """Reference API: strategy.fp16_allreduce = True → the fleet facade
+    hands bf16 to the engine's grad_reduce_dtype."""
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    assert s.fp16_allreduce is False
+    s.fp16_allreduce = True
+    fleet.init(is_collective=True, strategy=s)
+    assert fleet.fleet.grad_reduce_dtype() == jnp.bfloat16
+
+
 def test_localsgd_syncs_params_every_k_steps():
     """Replicas drift on per-rank batches between syncs and converge to
     the average every k steps (reference localsgd_optimizer semantics)."""
