@@ -35,7 +35,8 @@ __all__ = ["Stream", "Event", "current_stream", "stream_guard",
            "synchronize"]
 
 _TLS = threading.local()
-_INFLIGHT_CAP = 256  # per stream; oldest (almost surely done) pruned first
+_INFLIGHT_CAP = 256  # per stream; completed work pruned first, and past
+# the cap the dispatcher BLOCKS on the oldest entry (never silent eviction)
 
 
 def synchronize(device=None) -> None:
@@ -146,7 +147,11 @@ class Stream:
     def __init__(self, device=None, priority: int = 2):
         self.device = device
         self.priority = priority  # accepted for API parity; no-op on TPU
-        self._inflight: deque = deque(maxlen=_INFLIGHT_CAP)
+        # unbounded on purpose: a maxlen deque would silently evict the
+        # OLDEST tracked work on overflow, letting query()/Event.record()
+        # report completion while that work still runs — breaking the
+        # conservative-ordering contract. Overflow blocks instead.
+        self._inflight: deque = deque()
         self._lock = threading.Lock()
 
     # -- tracking ----------------------------------------------------------
@@ -154,6 +159,14 @@ class Stream:
         with self._lock:
             self._prune()  # keep the window bounded by completion, not cap
             self._inflight.extend(arrs)
+            # window still over cap after pruning: the dispatching thread
+            # waits on the oldest work (the CUDA-queue-depth analogue) so
+            # tracking stays bounded WITHOUT forgetting live work. Lock is
+            # held — same-stream dispatchers queue behind the wait, which
+            # is the ordering a full hardware queue imposes anyway.
+            while len(self._inflight) > _INFLIGHT_CAP:
+                _block_all((self._inflight[0],))
+                self._inflight.popleft()
 
     def _note(self, arr) -> None:
         self._note_many((arr,))

@@ -62,6 +62,22 @@ class Fleet:
         self._is_initialized = True
         return self
 
+    def reset(self):
+        """Clear all process-global fleet state (strategy, HCG, init flag).
+
+        fleet.init is process-global by design (reference semantics: one
+        fleet per trainer process, test_dist_base.py:954 spawns a fresh
+        subprocess per scenario precisely so state can't leak). In-process
+        test suites must call this between scenarios — a leaked strategy
+        (e.g. fp16_allreduce=True) silently changes the reduction dtype of
+        every later engine built with grad_reduce_dtype="auto"."""
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+        self._is_collective = True
+        set_hybrid_communicate_group(None)
+        return self
+
     # -- identity ------------------------------------------------------------
     def is_first_worker(self) -> bool:
         return jax.process_index() == 0

@@ -24,10 +24,23 @@ moments down+up once — the step is host-link-bound by design. The point is
 capability: the north-star 6.7B GPT-3 shape trains end-to-end on a single
 16 GB v5e (benchmarks/offload_bench.py --size 6.7b).
 
-Five compiled programs total, each reused across all L blocks (identical
-shapes): embed fwd, block fwd, head vjp+update, block vjp+update, embed
-vjp+update. All params/state are passed as jit ARGUMENTS (closure
-constants would be baked into the serialized HLO).
+Five compiled programs in the unclipped step, each reused across all L
+blocks (identical shapes): embed fwd, block fwd, head vjp+update, block
+vjp+update, embed vjp+update. Global-norm clip adds four more (head/
+block/embed norm passes + the clip coefficient). All params/state are
+passed as jit ARGUMENTS (closure constants would be baked into the
+serialized HLO).
+
+Global-norm grad clip (the GPT-3 recipe's clip-at-1.0) works via a
+TWO-PASS backward: pass 1 re-streams the params through an update-free
+backward that only accumulates the fp32 global grad-norm² (the forward's
+cached boundary activations serve both passes — no second forward), then
+pass 2 is the normal fused update backward with every grad scaled by the
+shared clip coefficient. Cost: one extra param down-stream + backward
+flops (~+40% step time on the host-link-bound 6.7B tier). By-value clip
+is free — it fuses into the per-block update. Reference equivalents:
+GroupShardedStage3 param slicing with clip (group_sharded_stage3.py:85
+region) and HybridParallelClipGrad (hybrid_parallel_optimizer.py:41).
 """
 
 from __future__ import annotations
@@ -94,9 +107,10 @@ def build_param_streamed_train_step(
       step(hparams, hstate, inputs, targets, lr) -> (hparams, hstate, loss)
 
     The optimizer must follow the per-leaf `_init_slot`/`_update` protocol
-    (AdamW-family — same gate as the group_sharded offload tier); global
-    grad clipping is incompatible with per-block updates (the global norm
-    needs every grad at once) and raises loudly.
+    (AdamW-family — same gate as the group_sharded offload tier).
+    grad_clip: ClipGradByGlobalNorm engages the two-pass backward (module
+    docstring); ClipGradByValue fuses into the per-block update; other
+    clip types raise.
     """
     if not _leaf_streamable(optimizer):
         raise NotImplementedError(
@@ -104,19 +118,44 @@ def build_param_streamed_train_step(
             "the optimizer must follow the per-leaf _init_slot/_update "
             f"protocol (AdamW-family). Got {type(optimizer).__name__} with "
             "a custom apply(); use build_sharded_train_step(offload=True).")
-    if optimizer._grad_clip is not None:
+    from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+    clip = optimizer._grad_clip
+    global_clip = isinstance(clip, ClipGradByGlobalNorm)
+    value_clip = isinstance(clip, ClipGradByValue)
+    if clip is not None and not (global_clip or value_clip):
         raise NotImplementedError(
-            "global-norm grad clip needs every grad at once; the streamed "
-            "tier never materializes them together. Clip-by-value could be "
-            "fused per block; global-norm cannot. Drop grad_clip= or use "
-            "the moments-only offload tier (build_sharded_train_step).")
+            "the streamed tier supports ClipGradByGlobalNorm (two-pass "
+            "backward: norm pass then update pass) and ClipGradByValue "
+            f"(fused per block). Got {type(clip).__name__}; drop grad_clip= "
+            "or use the moments-only offload tier "
+            "(build_sharded_train_step).")
 
-    def _seg_update(p, g, slot, lr, step, offset):
+    def _seg_update(p, g, slot, lr, step, offset, scale):
         """Per-leaf optimizer update of one segment inside jit — the shared
         Optimizer._apply_leaves loop with a traced `offset` decorrelating
         the stochastic-rounding streams across segments (the five programs
-        are reused by every block)."""
+        are reused by every block). `scale` is the global-norm clip
+        coefficient, applied only when that clip mode is compiled in
+        (otherwise the argument is unused and traces to nothing);
+        by-value clip clamps here, inside the same fused program.
+
+        Global-norm clip matches the reference's sharded-mode discipline
+        (HybridParallelClipGrad, fleet/dygraph_optimizer/
+        hybrid_parallel_optimizer.py:41: partial norms combined across the
+        sharded axis before one shared coefficient) — here the "axis" is
+        the stream of per-block backward programs instead of ranks."""
+        if value_clip:
+            g = jax.tree.map(
+                lambda t: jnp.clip(t, clip.min, clip.max).astype(t.dtype), g)
+        if global_clip:
+            g = jax.tree.map(lambda t: (t * scale).astype(t.dtype), g)
         return optimizer._apply_leaves(p, g, slot, lr, step, offset=offset)
+
+    def _norm2(tree):
+        """fp32 sum of squares of a segment's grads (one term of the
+        global norm — nn.clip.global_norm semantics, per segment)."""
+        return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                   for g in jax.tree.leaves(tree))
 
     dn = (lambda *idx: {"donate_argnums": idx}) if donate else (
         lambda *idx: {})
@@ -133,26 +172,58 @@ def build_param_streamed_train_step(
         return block_fn(p, x)
 
     @functools.partial(jax.jit, **dn(0, 1, 3))
-    def jhead_step(hp, x, targets, slot, lr, step, offset):
+    def jhead_step(hp, x, targets, slot, lr, step, offset, scale):
         loss, vjp_fn = jax.vjp(lambda hp_, x_: head_loss_fn(hp_, x_, targets),
                                hp, x)
         dhp, dx = vjp_fn(jnp.ones_like(loss))
-        new_hp, new_slot = _seg_update(hp, dhp, slot, lr, step, offset)
+        new_hp, new_slot = _seg_update(hp, dhp, slot, lr, step, offset, scale)
         return loss, dx, new_hp, new_slot
 
     @functools.partial(jax.jit, **dn(0, 1, 2, 3))
-    def jblock_step(p, x_in, dx_out, slot, lr, step, offset):
+    def jblock_step(p, x_in, dx_out, slot, lr, step, offset, scale):
         _, vjp_fn = jax.vjp(block_fn, p, x_in)
         dp, dx_in = vjp_fn(dx_out)
-        new_p, new_slot = _seg_update(p, dp, slot, lr, step, offset)
+        new_p, new_slot = _seg_update(p, dp, slot, lr, step, offset, scale)
         return dx_in, new_p, new_slot
 
     @functools.partial(jax.jit, **dn(0, 2, 3))
-    def jembed_step(ep, inputs, dx, slot, lr, step, offset):
+    def jembed_step(ep, inputs, dx, slot, lr, step, offset, scale):
         _, vjp_fn = jax.vjp(lambda ep_: embed_fn(ep_, inputs), ep)
         (dep,) = vjp_fn(dx)
-        new_ep, new_slot = _seg_update(ep, dep, slot, lr, step, offset)
+        new_ep, new_slot = _seg_update(ep, dep, slot, lr, step, offset, scale)
         return new_ep, new_slot
+
+    # -- norm-pass programs (global-norm clip only) -------------------------
+    # A second, update-free backward that streams the params down once more
+    # and accumulates the fp32 global grad-norm² — the boundary activations
+    # cached by the forward serve BOTH backward passes, so the extra cost
+    # is one param down-stream plus the vjp flops, never a second forward.
+    # Params ARE donated (they're throwaway fetched copies); x / x_in are
+    # NOT (the update pass consumes them afterwards).
+    @functools.partial(jax.jit, **dn(0))
+    def jhead_norm(hp, x, targets):
+        loss, vjp_fn = jax.vjp(lambda hp_, x_: head_loss_fn(hp_, x_, targets),
+                               hp, x)
+        dhp, dx = vjp_fn(jnp.ones_like(loss))
+        return loss, dx, _norm2(dhp)
+
+    @functools.partial(jax.jit, **dn(0, 2))
+    def jblock_norm(p, x_in, dx_out, n2_acc):
+        _, vjp_fn = jax.vjp(block_fn, p, x_in)
+        dp, dx_in = vjp_fn(dx_out)
+        return dx_in, n2_acc + _norm2(dp)
+
+    @functools.partial(jax.jit, **dn(0, 2))
+    def jembed_norm(ep, inputs, dx, n2_acc):
+        _, vjp_fn = jax.vjp(lambda ep_: embed_fn(ep_, inputs), ep)
+        (dep,) = vjp_fn(dx)
+        return n2_acc + _norm2(dep)
+
+    @jax.jit
+    def jclip_scale(n2):
+        # exactly nn.clip.ClipGradByGlobalNorm's coefficient
+        norm = jnp.sqrt(n2)
+        return jnp.minimum(1.0, clip.clip_norm / jnp.maximum(norm, 1e-12))
 
     # -----------------------------------------------------------------------
     def place(params):
@@ -179,13 +250,12 @@ def build_param_streamed_train_step(
             },
         }
 
-    n_embed = None  # leaf-count offsets, resolved on first step
-
     def step(hparams, hstate, inputs, targets, lr):
-        nonlocal n_embed
         L = len(hparams["blocks"])
-        if n_embed is None:
-            n_embed = len(jax.tree.leaves(hparams["embed"]))
+        # leaf-count SR-stream offsets, derived per call (a cached count
+        # would silently mis-offset if one built step were reused across
+        # models with different embed leaf layouts)
+        n_embed = len(jax.tree.leaves(hparams["embed"]))
         n_block = len(jax.tree.leaves(hparams["blocks"][0]))
         off_head = jnp.int32(n_embed + L * n_block)
         step_no = hstate["step"] + 1
@@ -201,10 +271,28 @@ def build_param_streamed_train_step(
             x_ins.append(x)
             x = jblock_fwd(p_i, x)
 
+        # ---- pass 1 (global-norm clip only): update-free backward over
+        # the SAME cached boundary activations, accumulating grad-norm² ----
+        if global_clip:
+            _, dxn, n2 = jhead_norm(fetch(hparams["head"], device),
+                                    x, targets)
+            nxt = fetch(hparams["blocks"][L - 1], device)
+            for i in range(L - 1, -1, -1):
+                p_i = nxt
+                nxt = (fetch(hparams["blocks"][i - 1], device)
+                       if i > 0 else None)
+                dxn, n2 = jblock_norm(p_i, x_ins[i], dxn, n2)
+            n2 = jembed_norm(fetch(hparams["embed"], device), inputs,
+                             dxn, n2)
+            scale = jclip_scale(n2)
+        else:
+            scale = jnp.float32(1.0)
+
         # ---- head: loss + grads + update in one program ----
         loss, dx, new_hp, new_hs = jhead_step(
             fetch(hparams["head"], device), x, targets,
-            fetch(hstate["slots"]["head"], device), lr, step_no, off_head)
+            fetch(hstate["slots"]["head"], device), lr, step_no, off_head,
+            scale)
         new_head = park(new_hp, device)
         new_head_s = park(new_hs, device)
 
@@ -221,14 +309,14 @@ def build_param_streamed_train_step(
                    if i > 0 else None)
             dx, new_p, new_s = jblock_step(
                 p_i, x_ins.pop(), dx, s_i, lr, step_no,
-                jnp.int32(n_embed + i * n_block))
+                jnp.int32(n_embed + i * n_block), scale)
             new_blocks[i] = park(new_p, device)
             new_block_s[i] = park(new_s, device)
 
         new_ep, new_es = jembed_step(
             fetch(hparams["embed"], device), inputs, dx,
             fetch(hstate["slots"]["embed"], device), lr, step_no,
-            jnp.int32(0))
+            jnp.int32(0), scale)
 
         return (
             {"embed": park(new_ep, device), "blocks": new_blocks,

@@ -129,6 +129,11 @@ def adam_update(p, g, slot, lr, step, rng, *, beta1, beta2, epsilon,
 
     bc = min(512, ((last + LANES - 1) // LANES) * LANES)
     br = max(8, min(rows, (_BLOCK_ROWS * LANES) // bc))
+    if br < rows:
+        # Mosaic sublane divisibility: a partial block that isn't the
+        # array's own tail must sit on an 8-row boundary (same rounding as
+        # layer_norm._pick_rows) — bc=384 would otherwise give br=682
+        br = max(8, (br // 8) * 8)
     grid = (pl.cdiv(rows, br), pl.cdiv(last, bc))
     blk = pl.BlockSpec((br, bc), lambda i, j: (i, j))
     ins = [flat(p), flat(g), flat(m1s), flat(m2s)]

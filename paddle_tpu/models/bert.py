@@ -85,7 +85,16 @@ class BertModel(nn.Layer):
         path, flash_attention.py:242 cu_seqlens form). Distinct from BERT's
         token_type_ids ("segment A/B" within ONE sequence). When packing,
         pass position_ids that restart at each sequence start so learned
-        position embeddings match the unpacked layout."""
+        position embeddings match the unpacked layout.
+
+        PAD-POSITION semantics: a 2-D padding attention_mask is rewritten
+        as segment ids (below), under which pad QUERY positions attend
+        only to other pads — with the additive-mask form they attended to
+        all valid tokens. Loss, pooled output and every valid position are
+        unaffected (pads are masked out of the loss and valid queries
+        never look at pads either way); only callers that read hidden
+        states AT pad positions see different values, and those values
+        were never meaningful."""
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         if (attention_mask is not None and attention_mask.ndim == 2
                 and pack_segment_ids is None):

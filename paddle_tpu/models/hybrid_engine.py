@@ -104,14 +104,23 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         # extra axes (sep/context-parallel partial grads must always be
         # combined — skipping them would train on wrong gradients).
         skips_dp = getattr(optimizer, "_skips_grad_sync", False)
-        reduce_axes = ((() if skips_dp else (dp_axis,))
-                       + tuple(extra_grad_axes))
-        if reduce_axes:
+        dp_axes = () if skips_dp else (dp_axis,)
+        extra_axes = tuple(extra_grad_axes)
+        if dp_axes or extra_axes:
             def reduce_one(g):
-                if grad_reduce_dtype is not None:
-                    return lax.pmean(g.astype(grad_reduce_dtype),
-                                     reduce_axes).astype(g.dtype)
-                return lax.pmean(g, reduce_axes)
+                # extra axes (sep/context-parallel) combine genuinely
+                # PARTIAL gradients — always in the grad's own dtype; the
+                # reduced-dtype compression applies only to the dp
+                # all-reduce of identical replicas, matching the reference
+                # fp16_allreduce scope (dp grad allreduce only).
+                if extra_axes:
+                    g = lax.pmean(g, extra_axes)
+                if dp_axes:
+                    if grad_reduce_dtype is not None:
+                        return lax.pmean(g.astype(grad_reduce_dtype),
+                                         dp_axes).astype(g.dtype)
+                    return lax.pmean(g, dp_axes)
+                return g
 
             grads = jax.tree.map(reduce_one, grads)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)

@@ -303,16 +303,73 @@ class TestParamStreaming:
         from paddle_tpu import nn
 
         cfg = G.gpt_tiny()
-        with _pytest.raises(NotImplementedError, match="grad clip"):
+        # per-tensor ClipGradByNorm is the one clip family that stays out
+        # (its per-leaf norms would need the same two-pass machinery for
+        # zero recipe demand); global-norm and by-value are supported now
+        with _pytest.raises(NotImplementedError, match="ClipGradByNorm"):
             build_param_streamed_train_step(
                 *G.streamed_fns(cfg),
                 paddle.optimizer.AdamW(
-                    1e-3, grad_clip=nn.ClipGradByGlobalNorm(1.0)))
+                    1e-3, grad_clip=nn.ClipGradByNorm(1.0)))
         with _pytest.raises(NotImplementedError, match="_init_slot"):
             build_param_streamed_train_step(
                 *G.streamed_fns(cfg),
                 paddle.optimizer.Lars(1e-3,
                                       exclude_from_weight_decay=["w"]))
+
+    @pytest.mark.parametrize("mk_clip", [
+        lambda: paddle.nn.ClipGradByGlobalNorm(0.05),
+        lambda: paddle.nn.ClipGradByValue(1e-4),
+    ], ids=["global_norm", "by_value"])
+    def test_streamed_clip_matches_dense_clip(self, mk_clip):
+        """VERDICT r4 missing-1: the north-star recipe clips at global-norm
+        1.0 — the streamed tier must run it. Two-pass streamed backward
+        (norm pass + scaled update pass) == dense training with the same
+        clip, to the same tolerance as the unclipped parity test. Clip
+        thresholds are chosen small enough that clipping ENGAGES (asserted
+        below) — a scale of 1.0 would make this test vacuous."""
+        from paddle_tpu.distributed.sharding.param_stream import (
+            build_param_streamed_train_step)
+        from paddle_tpu.models import gpt as G
+        from paddle_tpu.nn.clip import global_norm
+
+        cfg, params, tokens, labels = self._jobs()
+
+        # clipping must actually bite at these thresholds
+        g0 = jax.grad(lambda p: G.dense_loss(p, tokens, labels, cfg))(params)
+        clip = mk_clip()
+        if hasattr(clip, "clip_norm"):
+            assert float(global_norm(g0)) > clip.clip_norm
+        else:
+            assert float(max(jnp.max(jnp.abs(g))
+                             for g in jax.tree.leaves(g0))) > clip.max
+
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, grad_clip=mk_clip())
+        state = opt.init_state(params)
+        jstep = jax.jit(lambda p, s, t, y: (
+            *opt.apply(p, jax.grad(
+                lambda p_: G.dense_loss(p_, t, y, cfg))(p), s, 1e-3),
+            G.dense_loss(p, t, y, cfg)))
+        dense_losses = []
+        for _ in range(3):
+            params2, state, l = jstep(params, state, tokens, labels)
+            dense_losses.append(float(l))
+            params = params2
+
+        cfg2, params, tokens, labels = self._jobs()
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                      grad_clip=mk_clip())
+        place, init_state, step = build_param_streamed_train_step(
+            *G.streamed_fns(cfg2), opt2)
+        hp = place(G.split_streamed_params(params, cfg2))
+        hs = init_state(hp)
+        stream_losses = []
+        for _ in range(3):
+            hp, hs, l = step(hp, hs, tokens, labels, 1e-3)
+            stream_losses.append(float(l))
+
+        np.testing.assert_allclose(stream_losses, dense_losses,
+                                   rtol=2e-5, atol=2e-5)
 
 
 def test_leaf_streamable_gate():
