@@ -44,12 +44,14 @@ _STAGE_OF = {"os": 1, "os_g": 2, "p_g_os": 3}
 
 def _leaf_streamable(optimizer) -> bool:
     """True when the offload path may re-implement the optimizer's update
-    as a per-leaf _update loop (the base Optimizer.apply semantics: step+1,
-    per-leaf rng fold_in, no per-parameter-name context). Optimizers whose
-    apply() threads names (AdamW apply_decay_param_fun, Lars
-    exclude_from_weight_decay) or restructures state (GradientMerge) must
-    run their own apply."""
-    from ...optimizer.optimizer import Adam, AdamW, Optimizer
+    as a per-leaf loop (the base Optimizer.apply semantics: step+1,
+    per-leaf rng fold_in). Name-dependent updates (AdamW
+    apply_decay_param_fun, Lars exclude_from_weight_decay) stream too —
+    the loops thread full-tree path names through the `_leaf_ctx`/
+    `_update_ctx` protocol. Only optimizers that restructure state or
+    apply tree-wide logic in a custom apply() (GradientMerge acc buffers)
+    must run their own apply."""
+    from ...optimizer.optimizer import Adam, Optimizer
 
     if not hasattr(optimizer, "_init_slot"):
         return False
@@ -58,11 +60,9 @@ def _leaf_streamable(optimizer) -> bool:
         return True
     if cls_apply is Adam.apply:
         # Adam.apply only adds the fused multi-tensor dispatch — the
-        # per-leaf _update math is unchanged (covers Adam/NAdam/RAdam)
+        # per-leaf _update math is unchanged (covers Adam/AdamW/NAdam/
+        # RAdam; AdamW's decay filter rides the ctx protocol)
         return True
-    if (isinstance(optimizer, AdamW) and cls_apply is AdamW.apply
-            and getattr(optimizer, "_apply_decay_param_fun", None) is None):
-        return True  # AdamW.apply falls through to the base/fused loop
     return False
 
 
@@ -248,13 +248,14 @@ def build_sharded_train_step(
         jgrad = jax.jit(grad_fn)
 
         if not _leaf_streamable(optimizer):
-            # optimizer threads per-parameter context through apply()
-            # (AdamW apply_decay_param_fun, Lars exclude lists) or uses a
-            # custom state layout (GradientMerge): per-leaf streaming
-            # would silently skip that logic, so go through the
+            # optimizer applies tree-wide logic or a custom state layout
+            # in its own apply() (GradientMerge acc buffers): per-leaf
+            # streaming would silently skip that logic, so go through the
             # optimizer's OWN apply — state still lives on the host
             # between steps, but the whole moment tree transits HBM at
-            # once during the update (documented spike).
+            # once during the update (documented spike). Name-dependent
+            # updates (AdamW decay filter, Lars excludes) no longer land
+            # here — the per-leaf loop threads names via _leaf_ctx.
             jfull = jax.jit(step, out_shardings=(
                 p_specs, jax.tree.map(_named, _state_specs(
                     optimizer, params, mesh, shard_axis)), _named(P())),
@@ -273,14 +274,19 @@ def build_sharded_train_step(
 
         needs_rng = getattr(optimizer, "_needs_update_rng", False)
         dn = {"donate_argnums": (0, 1, 2)} if donate else {}
+        # ctx (name-derived, hashable, tiny codomain — e.g. AdamW's
+        # decay-filter bool) is jit-STATIC: same shape + same ctx reuses
+        # the compiled program; a name-dependent update baked into a
+        # shape-keyed cache would silently reuse the wrong trace.
         if needs_rng:
             upd = jax.jit(
-                lambda p, g, s, lr, step, rng: optimizer._update(
-                    p, g, s, lr, step, rng=rng), **dn)
+                lambda p, g, s, lr, step, rng, ctx: optimizer._update_ctx(
+                    ctx, p, g, s, lr, step, rng=rng),
+                static_argnums=(6,), **dn)
         else:
             upd = jax.jit(
-                lambda p, g, s, lr, step: optimizer._update(p, g, s, lr,
-                                                            step), **dn)
+                lambda p, g, s, lr, step, ctx: optimizer._update_ctx(
+                    ctx, p, g, s, lr, step), static_argnums=(5,), **dn)
 
         def offload_step(params, opt_state, *batch_and_lr):
             lr = batch_and_lr[-1]
@@ -295,7 +301,10 @@ def build_sharded_train_step(
             step_no = opt_state["step"] + 1
             rng_base = (jax.random.key(step_no.astype(jnp.uint32),
                                        impl="rbg") if needs_rng else None)
-            leaves_p, treedef = jax.tree.flatten(params)
+            from ...optimizer.optimizer import _path_name
+            paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+            leaves_p = [leaf for _, leaf in paths_p]
+            names = [_path_name(path) for path, _ in paths_p]
             leaves_g = treedef.flatten_up_to(grads)
             leaves_s = treedef.flatten_up_to(opt_state["slots"])
             new_p, new_s = [], []
@@ -304,6 +313,7 @@ def build_sharded_train_step(
                     new_p.append(p)
                     new_s.append(s)
                     continue
+                ctx = optimizer._leaf_ctx(names[i])
                 s_dev = jax.tree.map(
                     lambda x: jax.device_put(
                         x, _state_sharding(x, kind="device")), s)
@@ -311,9 +321,9 @@ def build_sharded_train_step(
                     g = jax.device_put(g, _state_sharding(g, kind="device"))
                 if needs_rng:
                     np_, ns_ = upd(p, g, s_dev, lr, step_no,
-                                   jax.random.fold_in(rng_base, i))
+                                   jax.random.fold_in(rng_base, i), ctx)
                 else:
-                    np_, ns_ = upd(p, g, s_dev, lr, step_no)
+                    np_, ns_ = upd(p, g, s_dev, lr, step_no, ctx)
                 new_p.append(np_)
                 new_s.append(jax.tree.map(
                     lambda x: jax.device_put(x, _state_sharding(x)), ns_))

@@ -118,6 +118,15 @@ def build_param_streamed_train_step(
             "the optimizer must follow the per-leaf _init_slot/_update "
             f"protocol (AdamW-family). Got {type(optimizer).__name__} with "
             "a custom apply(); use build_sharded_train_step(offload=True).")
+    if getattr(optimizer, "_needs_leaf_names", False):
+        raise NotImplementedError(
+            "name-dependent updates (apply_decay_param_fun / "
+            "exclude_from_weight_decay) would see SEGMENT-relative names "
+            "here (the per-block programs update subtrees, e.g. 'qkv_w' "
+            "instead of 'blocks.3.qkv_w'), silently changing which "
+            "parameters the filter matches. Use the moments-offload tier "
+            "(build_sharded_train_step(offload=True) — threads full-tree "
+            "names), or drop the name filter.")
     from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
     clip = optimizer._grad_clip
     global_clip = isinstance(clip, ClipGradByGlobalNorm)
@@ -151,11 +160,7 @@ def build_param_streamed_train_step(
             g = jax.tree.map(lambda t: (t * scale).astype(t.dtype), g)
         return optimizer._apply_leaves(p, g, slot, lr, step, offset=offset)
 
-    def _norm2(tree):
-        """fp32 sum of squares of a segment's grads (one term of the
-        global norm — nn.clip.global_norm semantics, per segment)."""
-        return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                   for g in jax.tree.leaves(tree))
+    from ...nn.clip import sum_squares as _norm2  # per-segment norm² term
 
     dn = (lambda *idx: {"donate_argnums": idx}) if donate else (
         lambda *idx: {})
@@ -221,9 +226,7 @@ def build_param_streamed_train_step(
 
     @jax.jit
     def jclip_scale(n2):
-        # exactly nn.clip.ClipGradByGlobalNorm's coefficient
-        norm = jnp.sqrt(n2)
-        return jnp.minimum(1.0, clip.clip_norm / jnp.maximum(norm, 1e-12))
+        return clip.scale_from_norm(jnp.sqrt(n2))
 
     # -----------------------------------------------------------------------
     def place(params):

@@ -20,14 +20,20 @@ def _is_selected_rows(x) -> bool:
     return isinstance(x, SelectedRows)
 
 
-def global_norm(grads) -> jax.Array:
+def sum_squares(grads) -> jax.Array:
+    """fp32 sum of squares over a grad tree — global_norm²'s accumulation
+    term, exposed so split-backward tiers (param_stream's two-pass clip)
+    can accumulate it segment by segment with identical numerics."""
     leaves = [g for g in jax.tree.leaves(grads, is_leaf=_is_selected_rows)
               if g is not None]
     vals = [g.value if _is_selected_rows(g) else g for g in leaves]
     if not vals:
         return jnp.zeros(())
-    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in vals))
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in vals)
+
+
+def global_norm(grads) -> jax.Array:
+    return jnp.sqrt(sum_squares(grads))
 
 
 class ClipGradByValue:
@@ -57,9 +63,14 @@ class ClipGradByGlobalNorm:
     def __init__(self, clip_norm):
         self.clip_norm = clip_norm
 
+    def scale_from_norm(self, norm):
+        """Clip coefficient for a precomputed global norm — the ONE
+        definition of the formula (param_stream's two-pass streamed clip
+        must match this bit-for-bit for dense parity)."""
+        return jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+
     def __call__(self, grads):
-        n = global_norm(grads)
-        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        scale = self.scale_from_norm(global_norm(grads))
 
         def scale_one(g):
             if _is_selected_rows(g):

@@ -30,6 +30,23 @@ def _tree_map(f, *trees):
     return jax.tree.map(f, *trees)
 
 
+def _path_name(path) -> str:
+    """Dot-joined pytree path → parameter name (for a flat dict the name
+    IS the key, matching what apply_decay_param_fun-style predicates see
+    on the reference's named-parameter surface)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):          # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):        # SequenceKey
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):       # GetAttrKey (str() would add a dot)
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
+
+
 def _sr_to_bf16(x, key):
     """Unbiased stochastic rounding f32 → bf16: add uniform noise below the
     bf16 mantissa cutoff in integer space, then truncate. Needed for
@@ -91,6 +108,28 @@ class Optimizer:
     def _update(self, p, g, slot, lr, step):
         raise NotImplementedError
 
+    # -- per-leaf name/context protocol --------------------------------------
+    # Optimizers whose update depends on the PARAMETER NAME (AdamW
+    # apply_decay_param_fun, Lars exclude_from_weight_decay — reference:
+    # adamw.py / fleet LarsOptimizer) expose that dependence as a small
+    # hashable context so the per-leaf streaming loops (group_sharded
+    # offload, _apply_leaves) can thread it: `_leaf_ctx(name)` maps a
+    # pytree-path name to the context (None = name-independent, the
+    # default), and `_update_ctx(ctx, ...)` runs one leaf's update under
+    # it. Contexts are jit-static: distinct values trace distinct
+    # programs, so keep the codomain tiny (bools, not raw names).
+    _needs_leaf_names = False  # subclasses set True when ctx is active
+
+    def _leaf_ctx(self, name):
+        del name
+        return None
+
+    def _update_ctx(self, ctx, p, g, slot, lr, step, rng=None):
+        del ctx  # default: name-independent update
+        if rng is not None:
+            return self._update(p, g, slot, lr, step, rng=rng)
+        return self._update(p, g, slot, lr, step)
+
     def init_state(self, params) -> Dict[str, Any]:
         slots = _tree_map(lambda p: self._init_slot(p), params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
@@ -100,7 +139,9 @@ class Optimizer:
         tier (distributed/sharding/param_stream.py). `offset`: traced base
         leaf index decorrelating the stochastic-rounding rng streams when
         the loop is split across multiple jitted programs."""
-        leaves_p, treedef = jax.tree.flatten(params)
+        paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+        leaves_p = [leaf for _, leaf in paths_p]
+        names = [_path_name(path) for path, _ in paths_p]
         leaves_g = treedef.flatten_up_to(grads)
         leaves_s = treedef.flatten_up_to(slots)
         rng_base = None
@@ -116,12 +157,14 @@ class Optimizer:
                 new_p.append(p)
                 new_s.append(s)
                 continue
+            ctx = self._leaf_ctx(names[i])
             if rng_base is not None:
                 idx = i if offset is None else offset + i
-                np_, ns_ = self._update(p, g, s, lr, step,
-                                        rng=jax.random.fold_in(rng_base, idx))
+                np_, ns_ = self._update_ctx(
+                    ctx, p, g, s, lr, step,
+                    rng=jax.random.fold_in(rng_base, idx))
             else:
-                np_, ns_ = self._update(p, g, s, lr, step)
+                np_, ns_ = self._update_ctx(ctx, p, g, s, lr, step)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
@@ -555,7 +598,6 @@ class AdamW(Adam):
                          moment_dtype, use_multi_tensor, name)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
-        self._current_param_name = None
         if use_multi_tensor and (apply_decay_param_fun is not None
                                  or lr_ratio is not None):
             raise ValueError(
@@ -564,9 +606,9 @@ class AdamW(Adam):
                 "context the fused pass cannot")
 
     def _decoupled_decay(self, p, lr):
-        if (self._apply_decay_param_fun is not None
-                and self._current_param_name is not None
-                and not self._apply_decay_param_fun(self._current_param_name)):
+        # the apply_decay_param_fun filter reaches here ONLY via the ctx
+        # protocol (_leaf_ctx/_update_ctx — every per-leaf loop threads it)
+        if getattr(self, "_ctx_decay", None) is False:
             return 0.0
         return lr * self._decay_coeff() * p
 
@@ -575,21 +617,28 @@ class AdamW(Adam):
         # implementation of the decay-filter predicate, not two
         return 0.0, float(self._decoupled_decay(1.0, 1.0))
 
-    def apply(self, params, grads, state, lr=None):
-        # Track param names (dict pytrees) so apply_decay_param_fun works.
-        if isinstance(params, dict) and self._apply_decay_param_fun is not None:
-            lr = self.get_lr() if lr is None else lr
-            step = state["step"] + 1
-            grads2 = self._grad_clip(grads) if self._grad_clip is not None else grads
-            new_p, new_s = {}, {}
-            for k in params:
-                self._current_param_name = k
-                np_, ns_ = self._update(params[k], grads2[k], state["slots"][k], lr, step)
-                new_p[k] = np_
-                new_s[k] = ns_
-            self._current_param_name = None
-            return new_p, {"step": step, "slots": new_s}
-        return super().apply(params, grads, state, lr)
+    # -- per-leaf name protocol (base class hook): the decay filter is the
+    # only name dependence, so the context is a single bool. The base
+    # _apply_leaves threads it through every per-leaf path (dense apply,
+    # offload streaming) — the reference's adamw.py consults the predicate
+    # per parameter inside its C++ loop.
+    @property
+    def _needs_leaf_names(self):
+        return self._apply_decay_param_fun is not None
+
+    def _leaf_ctx(self, name):
+        fn = self._apply_decay_param_fun
+        if fn is None:
+            return None
+        return bool(fn(name)) if name is not None else True
+
+    def _update_ctx(self, ctx, p, g, slot, lr, step, rng=None):
+        prev = getattr(self, "_ctx_decay", None)
+        self._ctx_decay = ctx
+        try:
+            return super()._update_ctx(ctx, p, g, slot, lr, step, rng=rng)
+        finally:
+            self._ctx_decay = prev
 
 
 # exact-fusable types for the multi-tensor path (subclasses override the
@@ -650,31 +699,31 @@ class Lars(Optimizer):
     def _is_excluded(self, name) -> bool:
         return any(tok in name for tok in self._exclude) if name else False
 
-    def apply(self, params, grads, state, lr=None):
-        # thread dict-key names to _update so exclude_from_weight_decay
-        # works functionally. Only a FLAT dict of arrays gives reliable
-        # names (nested pytrees lose the key path; base apply also skips
-        # None-grad leaves, so those names must be skipped here too).
-        self._leaf_names = None
-        if self._exclude and isinstance(params, dict) and all(
-                not isinstance(v, (dict, list, tuple))
-                for v in params.values()):
-            self._leaf_names = [k for k in params.keys()
-                                if not (isinstance(grads, dict)
-                                        and grads.get(k) is None)]
+    # -- per-leaf name protocol: the exclude list is the only name
+    # dependence; ctx is "is this leaf excluded". The base _apply_leaves
+    # derives dotted pytree-path names (flat-dict keys unchanged, nested
+    # trees now get real paths instead of silently losing the filter).
+    @property
+    def _needs_leaf_names(self):
+        return bool(self._exclude)
+
+    def _leaf_ctx(self, name):
+        if not self._exclude:
+            return None
+        return self._is_excluded(name)
+
+    def _update_ctx(self, ctx, p, g, slot, lr, step, rng=None):
+        prev = getattr(self, "_ctx_excluded", None)
+        self._ctx_excluded = ctx
         try:
-            return super().apply(params, grads, state, lr)
+            return super()._update_ctx(ctx, p, g, slot, lr, step, rng=rng)
         finally:
-            self._leaf_names = None
+            self._ctx_excluded = prev
 
     def _update(self, p, g, slot, lr, step):
-        name = None
-        if getattr(self, "_leaf_names", None):
-            # base apply visits leaves in dict order; consume in step
-            name = self._leaf_names.pop(0)
         gf = g.astype(jnp.float32)
         pf = p.astype(jnp.float32)
-        if self._is_excluded(name):
+        if getattr(self, "_ctx_excluded", None):
             # excluded params: plain momentum SGD, no decay, no trust ratio
             v = self._momentum * slot["velocity"] + lr * gf
             return (pf - v).astype(p.dtype), {"velocity": v}

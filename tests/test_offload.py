@@ -120,10 +120,21 @@ def test_recompute_offload_grad_parity():
                                rtol=1e-6, atol=1e-6)
 
 
-def test_sharded_offload_fallback_for_name_aware_optimizers():
-    """Optimizers whose apply() threads per-parameter context (Lars
-    exclude_from_weight_decay) must NOT be leaf-streamed — the fallback
-    path runs their own apply with identical results."""
+@pytest.mark.parametrize("mk_opt", [
+    lambda: paddle.optimizer.Lars(learning_rate=1e-2, momentum=0.9,
+                                  lars_weight_decay=1e-3,
+                                  exclude_from_weight_decay=["w2"]),
+    lambda: paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.1,
+                                   apply_decay_param_fun=lambda n: "w2"
+                                   not in n),
+], ids=["lars_exclude", "adamw_decay_fun"])
+def test_sharded_offload_streams_name_aware_optimizers(mk_opt):
+    """VERDICT r4 #9 / r3 weak-6: name-dependent optimizers (Lars
+    exclude_from_weight_decay, AdamW apply_decay_param_fun) now LEAF-
+    STREAM through the offload tier — the per-leaf loop threads full-tree
+    path names via the _leaf_ctx protocol, so the whole-moment-tree HBM
+    spike fallback no longer fires for them. Offload == non-offload to
+    fp32 exactness, with the name filter demonstrably engaged."""
     from paddle_tpu.distributed.sharding.group_sharded import (
         _leaf_streamable)
 
@@ -131,10 +142,8 @@ def test_sharded_offload_fallback_for_name_aware_optimizers():
     params, xs, ys, loss_fn = _mlp_job()
 
     def run(offload):
-        opt = paddle.optimizer.Lars(learning_rate=1e-2, momentum=0.9,
-                                    lars_weight_decay=1e-3,
-                                    exclude_from_weight_decay=["w2"])
-        assert not _leaf_streamable(opt)
+        opt = mk_opt()
+        assert _leaf_streamable(opt)
         _, place, compile_for = build_sharded_train_step(
             loss_fn, opt, mesh, level="os_g", data_axes="sharding",
             offload=offload)
@@ -145,9 +154,29 @@ def test_sharded_offload_fallback_for_name_aware_optimizers():
         for _ in range(3):
             p, st, l = jstep(p, st, xb, yb, jnp.float32(1e-2))
             losses.append(float(l))
-        return losses
+        return losses, p
 
-    np.testing.assert_allclose(run(False), run(True), rtol=0, atol=1e-6)
+    (l_plain, p_plain), (l_off, p_off) = run(False), run(True)
+    np.testing.assert_allclose(l_plain, l_off, rtol=0, atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=0, atol=1e-6), p_plain, p_off)
+
+    # the filter must actually change the result — otherwise this test
+    # can't distinguish "names threaded" from "filter silently dropped"
+    opt_nofilter = (paddle.optimizer.Lars(
+        learning_rate=1e-2, momentum=0.9, lars_weight_decay=1e-3)
+        if isinstance(mk_opt(), paddle.optimizer.Lars)
+        else paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.1))
+    _, place, compile_for = build_sharded_train_step(
+        loss_fn, opt_nofilter, mesh, level="os_g", data_axes="sharding",
+        offload=True)
+    p, st = place(params)
+    jstep, bspec = compile_for(p)
+    xb, yb = jax.device_put(xs, bspec), jax.device_put(ys, bspec)
+    for _ in range(3):
+        p, st, _ = jstep(p, st, xb, yb, jnp.float32(1e-2))
+    assert not np.allclose(np.asarray(p["w2"]), np.asarray(p_off["w2"]),
+                           rtol=0, atol=1e-7)
 
 
 @pytest.mark.parametrize("mk", [
@@ -311,11 +340,20 @@ class TestParamStreaming:
                 *G.streamed_fns(cfg),
                 paddle.optimizer.AdamW(
                     1e-3, grad_clip=nn.ClipGradByNorm(1.0)))
-        with _pytest.raises(NotImplementedError, match="_init_slot"):
+        # name-dependent filters would see segment-relative names here —
+        # rejected with a pointer to the moments-offload tier (which
+        # threads full-tree names)
+        with _pytest.raises(NotImplementedError, match="SEGMENT-relative"):
             build_param_streamed_train_step(
                 *G.streamed_fns(cfg),
                 paddle.optimizer.Lars(1e-3,
                                       exclude_from_weight_decay=["w"]))
+        from paddle_tpu.optimizer import GradientMergeOptimizer
+        with _pytest.raises(NotImplementedError, match="_init_slot"):
+            build_param_streamed_train_step(
+                *G.streamed_fns(cfg),
+                GradientMergeOptimizer(paddle.optimizer.AdamW(1e-3),
+                                       k_steps=2))
 
     @pytest.mark.parametrize("mk_clip", [
         lambda: paddle.nn.ClipGradByGlobalNorm(0.05),
@@ -380,9 +418,11 @@ def test_leaf_streamable_gate():
     assert _leaf_streamable(paddle.optimizer.AdamW(1e-3))
     assert _leaf_streamable(paddle.optimizer.SGD(1e-3))
     assert _leaf_streamable(paddle.optimizer.Momentum(1e-3))
-    assert not _leaf_streamable(
+    # name-dependent optimizers stream since the ctx protocol (names are
+    # threaded through the per-leaf loops)
+    assert _leaf_streamable(
         paddle.optimizer.AdamW(1e-3, apply_decay_param_fun=lambda n: True))
-    assert not _leaf_streamable(
+    assert _leaf_streamable(
         paddle.optimizer.Lars(1e-3, exclude_from_weight_decay=["bn"]))
     assert not _leaf_streamable(
         GradientMergeOptimizer(paddle.optimizer.AdamW(1e-3), k_steps=2))
