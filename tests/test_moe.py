@@ -276,3 +276,78 @@ def test_switch_gate_jitter():
     c1, _, _ = gate(x)
     c2, _, _ = gate(x)  # fresh RNG key → different routing weights
     assert not np.allclose(np.asarray(c1), np.asarray(c2))
+
+
+@pytest.mark.parametrize("gate,kw", [
+    ("naive", dict(top_k=2, capacity_factor=2.0)),
+    ("switch", dict(capacity_factor=1.25)),
+    ("gshard", dict(capacity_factor=2.0)),
+])
+def test_index_dispatch_matches_einsum_dispatch(gate, kw):
+    """The gather/scatter (index) dispatch — the TPU analogue of the
+    reference's zero-flop CUDA scatter, default when experts are not
+    ep-split — must equal the dense [T,E,C] einsum dispatch exactly,
+    forward AND gradient, for every gate family."""
+    t, d, f, e = 64, 8, 16, 4
+    cf = kw.pop("capacity_factor")
+    paddle.seed(7)
+    lay_i = MoELayer(d, f, e, gate=gate, capacity_factor=cf,
+                     dispatch_mode="index", **kw)
+    paddle.seed(7)
+    lay_e = MoELayer(d, f, e, gate=gate, capacity_factor=cf,
+                     dispatch_mode="einsum", **kw)
+    for p_i, p_e in zip(lay_i.parameters(), lay_e.parameters()):
+        np.testing.assert_array_equal(np.asarray(p_i.value),
+                                      np.asarray(p_e.value))
+    x = jnp.asarray(np.random.RandomState(0).randn(t, d).astype(np.float32))
+
+    def loss(layer_, x_):
+        y, aux = layer_(x_, return_aux=True)
+        return jnp.sum(y ** 2) + aux
+
+    yi, ye = lay_i(x), lay_e(x)
+    np.testing.assert_allclose(np.asarray(yi), np.asarray(ye),
+                               rtol=1e-5, atol=1e-6)
+    gi = jax.grad(lambda x_: loss(lay_i, x_))(x)
+    ge = jax.grad(lambda x_: loss(lay_e, x_))(x)
+    np.testing.assert_allclose(np.asarray(gi), np.asarray(ge),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_legacy_forward_only_gate_still_works():
+    """A gate written against the pre-round-5 contract (override forward()
+    only, no _route) must keep working: auto mode falls back to the dense
+    einsum dispatch instead of crashing in forward_index."""
+    from paddle_tpu.incubate.distributed.models.moe.gate import BaseGate
+
+    class LegacyGate(BaseGate):
+        def forward(self, x):
+            t = x.shape[0]
+            cap = self.capacity(t)
+            combine = jnp.zeros((t, self.num_experts, cap), jnp.float32)
+            combine = combine.at[jnp.arange(t), jnp.arange(t) %
+                                 self.num_experts,
+                                 jnp.arange(t) // self.num_experts].set(1.0)
+            return combine, combine > 0, jnp.zeros((), jnp.float32)
+
+    gate = LegacyGate(8, 4, top_k=1, capacity_factor=8.0)
+    layer = MoELayer(8, 16, 4, gate=gate)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = layer(x)
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+    # explicit index mode names the missing hook
+    layer_i = MoELayer(8, 16, 4, gate=LegacyGate(8, 4, top_k=1,
+                                                 capacity_factor=8.0),
+                       dispatch_mode="index")
+    with pytest.raises(ValueError, match="_route"):
+        layer_i(x)
+
+
+def test_index_mode_rejects_ep_mesh(hcg_dp8):
+    """Explicit index dispatch over an ep-split expert bank would silently
+    defeat the all-to-all — must raise with guidance."""
+    layer = MoELayer(8, 16, 8, gate="naive", top_k=2, capacity_factor=8.0,
+                     ep_axis="dp", dispatch_mode="index")
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="ep"):
+        layer(x)
