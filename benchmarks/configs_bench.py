@@ -22,22 +22,13 @@ import time
 import numpy as np
 
 
-_FETCH_OVERHEAD = None
-
-
 def _fetch_overhead():
     """Measured cost of one dispatch+scalar-fetch (the axon tunnel's
     ~105 ms RTT; ~0 on local backends) — measured, not hardcoded, so the
-    subtraction can never push a local run negative."""
-    global _FETCH_OVERHEAD
-    if _FETCH_OVERHEAD is None:
-        import jax.numpy as jnp
-        x = jnp.zeros(())
-        float(x + 1)  # warm the dispatch path
-        t0 = time.perf_counter()
-        float(x + 2)
-        _FETCH_OVERHEAD = time.perf_counter() - t0
-    return _FETCH_OVERHEAD
+    subtraction can never push a local run negative. Single source:
+    paddle_tpu.utils.timing.dispatch_rtt_s."""
+    from paddle_tpu.utils.timing import dispatch_rtt_s
+    return dispatch_rtt_s()
 
 
 def _timed(step, carry, args, iters):
@@ -54,34 +45,86 @@ def _timed(step, carry, args, iters):
                1e-9) / iters
 
 
-def bench_resnet50(jax, jnp, paddle):
-    """Config 0: ResNet50 (paddle.vision.models), CIFAR10 shapes."""
+def bench_resnet50(jax, jnp, paddle, dtype_name="fp32"):
+    """Config 0: ResNet50 (paddle.vision.models), CIFAR10 shapes.
+
+    VERDICT r4 weak-3: a ~3-5 ms step is unmeasurable one-dispatch-at-a-
+    time through the ~105 ms axon tunnel (earlier rounds swung 2x run to
+    run). Protocol now matches BASELINE.md's chained methodology taken
+    further: K steps run inside ONE compiled lax.fori_loop (zero host
+    round-trips between steps), repeated 3x for a spread, with flops from
+    XLA's own cost analysis instead of a hand model."""
+    from jax import lax
+
     from paddle_tpu.nn import functional_call, functional_train_graph
     from paddle_tpu.vision.models import resnet50
 
+    dt_ = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
     model = resnet50(num_classes=10)
     params, _, buffers = functional_train_graph(model)
     params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
     opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
     state = jax.jit(opt.init_state)(params)
-    B = 256
+    B, K, REPS = 256, 400, 3  # ~1 s per rep: tunnel noise amortizes
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(B, 3, 32, 32).astype(np.float32))
+    x = jnp.asarray(rng.randn(B, 3, 32, 32), dt_)
     y = jnp.asarray(rng.randint(0, 10, (B,)))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, state, x, y):
+    def one_step(params, state, l_prev):
         def loss_fn(p):
-            out, _ = functional_call(model, p, buffers, x)
+            # AMP-style: bf16 activations, fp32 master params + update
+            pc = (jax.tree.map(lambda a: a.astype(dt_), p)
+                  if dtype_name == "bf16" else p)
+            out, _ = functional_call(model, pc, buffers, x)
             return paddle.nn.functional.cross_entropy(out, y)
         l, g = jax.value_and_grad(loss_fn)(params)
         params, state = opt.apply(params, g, state, 0.1)
-        return params, state, l
+        return params, state, l.astype(jnp.float32)
 
-    dt = _timed(step, (params, state), (x, y), 20)
-    return {"metric": "resnet50_images_per_sec_per_chip",
-            "value": round(B / dt, 1), "unit": "images/s",
-            "config": "CIFAR10 32x32, batch 256, Momentum, fp32"}
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def k_steps(params, state):
+        return lax.fori_loop(
+            0, K, lambda i, c: one_step(c[0], c[1], c[2]),
+            (params, state, jnp.zeros((), jnp.float32)))
+
+    # cost analysis on a SINGLE step (a fori_loop body may be counted
+    # once regardless of trip count — per-step flops are unambiguous here)
+    flops_per_step = None
+    try:
+        single = jax.jit(one_step)
+        ca = single.lower(params, state,
+                          jnp.zeros((), jnp.float32)).compile() \
+            .cost_analysis()
+        if ca and "flops" in ca:
+            flops_per_step = float(ca["flops"])
+    except Exception:
+        pass
+
+    params, state, l = k_steps(params, state)
+    float(l)  # compile + warm
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        params, state, l = k_steps(params, state)
+        float(l)
+        times.append(time.perf_counter() - t0 - _fetch_overhead())
+    per_step = [t / K for t in times]
+    med = sorted(per_step)[len(per_step) // 2]
+    spread = (max(per_step) - min(per_step)) / med * 100
+    out = {"metric": f"resnet50_images_per_sec_per_chip_{dtype_name}",
+           "value": round(B / med, 1), "unit": "images/s",
+           "step_ms": round(med * 1e3, 3),
+           "spread_pct": round(spread, 1),
+           "runs": [round(t * 1e3, 3) for t in per_step],
+           "config": f"CIFAR10 32x32, batch 256, Momentum, {dtype_name}; "
+                     f"K={K} steps fused in one fori_loop program, "
+                     f"{REPS} runs, single fetch per run"}
+    if flops_per_step:
+        achieved = flops_per_step / med
+        out["achieved_tflops"] = round(achieved / 1e12, 2)
+        out["mfu_pct_vs_bf16_peak"] = round(achieved / 197e12 * 100, 1)
+        out["flops_source"] = "XLA cost_analysis (single step)"
+    return out
 
 
 def _bert_job(jax, jnp, paddle):
@@ -327,6 +370,29 @@ def bench_moe(jax, jnp, paddle):
                       "[T,E,C] alternative kept for GSPMD ep meshes)"}
 
 
+def bench_resnet50_bf16(jax, jnp, paddle):
+    return bench_resnet50(jax, jnp, paddle, dtype_name="bf16")
+
+
+def bench_gpt_longctx(jax, jnp, paddle):
+    """GPT-1.3B at seq 2048 — GPT-3's real context length (VERDICT r4
+    ask-8: the MFU story extrapolated from seq 1024). NEW config hash; the
+    frozen flagship series (bench.py, seq 1024) is untouched."""
+    import bench as B  # repo root already on sys.path (module top)
+    from paddle_tpu.models import gpt as G
+
+    conf = dict(B.FLAGSHIP)
+    conf.update(max_seq_len=2048, seq=2048, batch=4)  # same 8192 tok/step
+    toks, mfu, n_params = B._run_config(jax, paddle, G, conf, 12)
+    return {"metric": "gpt1p3b_seq2048_tokens_per_sec_per_chip",
+            "value": round(toks, 1), "unit": "tokens/s",
+            "mfu_pct": round(mfu * 100, 1),
+            "config_hash": B._config_hash(conf),
+            "config": "GPT-1.3B seq 2048 batch 4 (8192 tok/step, same as "
+                      "flagship's 8x1024), bf16, flash + selective remat — "
+                      "the north-star context length"}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -336,8 +402,9 @@ def main():
     if not on_tpu:
         print(json.dumps({"error": "configs bench needs the TPU backend"}))
         return
-    for fn in (bench_resnet50, bench_bert_base, bench_bert_packed,
-               bench_llama, bench_moe):
+    for fn in (bench_resnet50, bench_resnet50_bf16,
+               bench_bert_base, bench_bert_packed,
+               bench_llama, bench_moe, bench_gpt_longctx):
         try:
             print(json.dumps(fn(jax, jnp, paddle)))
         except Exception as e:  # keep going; report the failure

@@ -22,6 +22,7 @@ import threading
 from typing import Iterable, Optional, Set
 
 import jax.numpy as jnp
+from ..enforce import enforce_in
 
 from .. import dtypes as _dtypes
 
@@ -98,7 +99,8 @@ def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = 
     if dtype is None:
         from ..flags import flag
         dtype = flag("amp_dtype")
-    assert level in ("O0", "O1", "O2"), level
+    enforce_in(level, ("O0", "O1", "O2"), op="amp.auto_cast",
+               name="level")
     prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
             set(_STATE.white), set(_STATE.black))
     _STATE.enabled = bool(enable) and level != "O0"
@@ -198,7 +200,7 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     Returns (models, optimizers) like the reference.
     """
     del save_dtype
-    assert level in ("O1", "O2"), level
+    enforce_in(level, ("O1", "O2"), op="amp.decorate", name="level")
     target = _resolve_dtype(dtype)
 
     single_model = not isinstance(models, (list, tuple))

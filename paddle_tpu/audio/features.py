@@ -12,6 +12,7 @@ from functools import partial
 from typing import Optional
 
 import jax.numpy as jnp
+from ..enforce import enforce, enforce_gt
 
 from ..nn.layer.layers import Layer
 from .. import signal as _signal
@@ -29,7 +30,8 @@ class Spectrogram(Layer):
                  power: float = 1.0, center: bool = True,
                  pad_mode: str = "reflect", dtype: str = "float32"):
         super().__init__()
-        assert power > 0, "Power of spectrogram must be > 0."
+        enforce_gt(power, 0, "Power of spectrogram must be > 0.",
+                   op="Spectrogram")
         self.power = power
         win_length = win_length or n_fft
         fft_window = get_window(window, win_length, fftbins=True, dtype=dtype)
@@ -101,7 +103,8 @@ class MFCC(Layer):
                  amin: float = 1e-10, top_db: Optional[float] = None,
                  dtype: str = "float32"):
         super().__init__()
-        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        enforce(n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels",
+                op="MFCC", n_mfcc=n_mfcc, n_mels=n_mels)
         self._log_melspectrogram = LogMelSpectrogram(
             sr, n_fft, hop_length, win_length, window, power, center,
             pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,

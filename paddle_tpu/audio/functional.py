@@ -14,6 +14,8 @@ import math
 from typing import Optional, Union
 
 import jax.numpy as jnp
+from ..enforce import (InvalidArgumentError, enforce,
+                       enforce_ge, enforce_gt, enforce_in)
 import numpy as np
 
 __all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
@@ -100,16 +102,16 @@ def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
                 top_db: Optional[float] = 80.0):
     """(functional.py:262) 10*log10(x/ref), numerically stable, optional
     dynamic-range clip at top_db below peak."""
-    if amin <= 0:
-        raise ValueError("amin must be strictly positive")
-    if ref_value <= 0:
-        raise ValueError("ref_value must be strictly positive")
+    enforce_gt(amin, 0, "amin must be strictly positive",
+               op="power_to_db")
+    enforce_gt(ref_value, 0, "ref_value must be strictly positive",
+               op="power_to_db")
     spect = jnp.asarray(spect)
     log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
     log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
     if top_db is not None:
-        if top_db < 0:
-            raise ValueError("top_db must be non-negative")
+        enforce_ge(top_db, 0, "top_db must be non-negative",
+                   op="power_to_db")
         log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
     return log_spec
 
@@ -123,7 +125,8 @@ def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
     if norm is None:
         dct *= 2.0
     else:
-        assert norm == "ortho"
+        enforce_in(norm, (None, "ortho"), op="create_dct",
+                   name="norm")
         dct[0] *= 1.0 / math.sqrt(2.0)
         dct *= math.sqrt(2.0 / n_mels)
     return jnp.asarray(dct.T, dtype=dtype)
@@ -224,8 +227,8 @@ def _gaussian(M, std, sym=True):
 
 @_register("exponential")
 def _exponential(M, center=None, tau=1.0, sym=True):
-    if sym and center is not None:
-        raise ValueError("If sym==True, center must be None.")
+    enforce(not (sym and center is not None),
+            "If sym==True, center must be None.", op="get_window")
     if M <= 1:
         return np.ones(max(M, 0))
     M, trunc = _extend(M, sym)
@@ -306,8 +309,10 @@ def get_window(window: Union[str, tuple], win_length: int,
     elif isinstance(window, tuple):
         name, args = window[0], window[1:]
     else:
-        raise ValueError(f"cannot parse window spec {window!r}")
+        raise InvalidArgumentError(f"cannot parse window spec {window!r}",
+                                   op="get_window")
     if name not in _WINDOWS:
-        raise ValueError(f"unknown window type {name!r}; "
-                         f"known: {sorted(_WINDOWS)}")
+        raise InvalidArgumentError(f"unknown window type {name!r}; "
+                                   f"known: {sorted(_WINDOWS)}",
+                                   op="get_window")
     return jnp.asarray(_WINDOWS[name](win_length, *args, sym=sym), dtype=dtype)

@@ -27,6 +27,7 @@ import threading
 from typing import Any, Callable, List, Optional, Tuple
 
 import jax
+from ..enforce import InvalidTypeError, enforce
 
 __all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks"]
 
@@ -152,9 +153,9 @@ class PyLayer(metaclass=_PyLayerMeta):
             grads = cls.backward(ctx, *(g if isinstance(g, tuple) else (g,)))
             if not isinstance(grads, tuple):
                 grads = (grads,)
-            assert len(grads) == n_in, (
-                f"{cls.__name__}.backward returned {len(grads)} grads for "
-                f"{n_in} inputs")
+            enforce(len(grads) == n_in,
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {n_in} inputs", op="PyLayer")
             return grads
 
         op.defvjp(op_fwd, op_bwd)
@@ -164,6 +165,6 @@ class PyLayer(metaclass=_PyLayerMeta):
     @classmethod
     def apply(cls, *args, **kwargs):
         if kwargs:
-            raise TypeError("PyLayer.apply takes positional tensor args "
+            raise InvalidTypeError("PyLayer.apply takes positional tensor args "
                             "only (reference behavior for tensors)")
         return cls._build()(*args)

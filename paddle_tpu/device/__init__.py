@@ -9,6 +9,7 @@ reference's Python API surface (`set_device`, `get_device`, `is_compiled_with_*`
 """
 
 from __future__ import annotations
+from ..enforce import InvalidArgumentError
 
 import functools
 from typing import List, Optional, Union
@@ -117,7 +118,8 @@ def set_device(device: Union[str, Place]) -> Place:
         elif dtype_ in get_all_custom_device_type():
             place = CustomPlace(dtype_, idx)
         else:
-            raise ValueError(f"Unknown device type: {dtype_}")
+            raise InvalidArgumentError(f"Unknown device type: {dtype_}",
+                                       op="set_device")
     _current_device[0] = f"{place.device_type}:{place.device_id}"
     return place
 

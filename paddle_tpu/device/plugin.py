@@ -22,6 +22,7 @@ point with no arguments; entries typically register ops/kernels/devices.
 """
 
 from __future__ import annotations
+from ..enforce import NotFoundError
 
 from typing import Callable, Dict, List, Optional
 
@@ -46,7 +47,7 @@ class CustomPlace(_place_base()):
 
     def __init__(self, device_type: str, device_id: int = 0):
         if device_type not in _CUSTOM_DEVICES:
-            raise ValueError(
+            raise NotFoundError(
                 f"custom device {device_type!r} is not registered; call "
                 f"register_custom_device(name, jax_platform) first "
                 f"(registered: {sorted(_CUSTOM_DEVICES) or 'none'})")

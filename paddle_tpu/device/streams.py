@@ -30,6 +30,7 @@ from collections import deque
 from typing import Any, List, Optional
 
 import jax
+from ..enforce import PreconditionNotMetError, enforce
 
 __all__ = ["Stream", "Event", "current_stream", "stream_guard",
            "synchronize"]
@@ -136,7 +137,9 @@ class Event:
     def elapsed_time(self, end: "Event") -> float:
         """Milliseconds between two recorded events (host clock — device
         timestamps belong to the profiler)."""
-        assert self._time is not None and end._time is not None
+        enforce(self._time is not None and end._time is not None,
+                "elapsed_time needs both events recorded",
+                op="Event.elapsed_time", error=PreconditionNotMetError)
         return (end._time - self._time) * 1e3
 
 

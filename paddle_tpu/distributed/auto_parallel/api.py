@@ -19,6 +19,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...enforce import (InvalidArgumentError,
+                        PreconditionNotMetError, enforce)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ...nn.layer.layers import Layer, Parameter
@@ -49,7 +51,7 @@ def shard_tensor(data, mesh, placements: Sequence[Placement],
     x = jnp.asarray(data, dtype=dtype)
     partial_axes = [(i, p) for i, p in enumerate(placements) if isinstance(p, Partial)]
     if partial_axes:
-        raise ValueError("shard_tensor cannot create Partial placements; "
+        raise InvalidArgumentError("shard_tensor cannot create Partial placements; "
                          "Partial arises from computation (use reshard to "
                          "reduce it)")
     return jax.device_put(x, _sharding_for(x.ndim, mesh, placements))
@@ -72,7 +74,8 @@ def reshard(x, mesh, placements: Sequence[Placement]) -> jax.Array:
     jmesh = to_jax_mesh(mesh)
     partials = [(i, p) for i, p in enumerate(placements) if isinstance(p, Partial)]
     if partials:
-        raise ValueError("reshard target cannot be Partial")
+        raise InvalidArgumentError("reshard target cannot be Partial",
+                                   op="reshard")
     if isinstance(x, Parameter):
         x.value = reshard(x.value, mesh, placements)
         x.placements = list(placements)
@@ -245,7 +248,8 @@ def shard_optimizer(optimizer, shard_fn=None, mesh=None, offload=False):
     if shard_fn is None:
         shard_fn = ShardingStage1(mesh)
     use_mesh = mesh if mesh is not None else getattr(shard_fn, "_mesh", None)
-    assert use_mesh is not None, "shard_optimizer needs a mesh"
+    enforce(use_mesh is not None, "shard_optimizer needs a mesh",
+            op="shard_optimizer", error=PreconditionNotMetError)
     wrapped = _ShardedOptimizer(optimizer, shard_fn, use_mesh,
                                 offload=offload)
     if getattr(shard_fn, "stage", 1) >= 3 and optimizer._parameter_list:

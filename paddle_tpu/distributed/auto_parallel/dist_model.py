@@ -11,6 +11,7 @@ places params accordingly, and compiles ONE sharded train/eval step.
 """
 
 from __future__ import annotations
+from ...enforce import PreconditionNotMetError, enforce
 
 from typing import Callable, Optional
 
@@ -41,8 +42,10 @@ class DistModel:
         if mesh is None:
             from ..topology import get_hybrid_communicate_group
             hcg = get_hybrid_communicate_group()
-            assert hcg is not None, ("no mesh: call fleet.init / pass mesh= "
-                                     "or shard parameters first")
+            enforce(hcg is not None,
+                    "no mesh: call fleet.init / pass mesh= or shard "
+                    "parameters first", op="to_static",
+                    error=PreconditionNotMetError)
             mesh = hcg.mesh
         self.mesh = to_jax_mesh(mesh) if not hasattr(mesh, "devices") else mesh
 
@@ -127,7 +130,8 @@ class DistModel:
     def __call__(self, inputs, labels=None):
         inputs = jnp.asarray(inputs)
         if self._mode == "train":
-            assert labels is not None, "train mode needs labels"
+            enforce(labels is not None, "train mode needs labels",
+                    op="DistModel", error=PreconditionNotMetError)
             step = self._build_train()
             # buffer updates (BatchNorm stats) thread through the step
             self._params, self._state, self._buffers, loss = step(

@@ -13,6 +13,8 @@ from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
+from ...enforce import (InvalidArgumentError, InvalidTypeError,
+                        enforce_eq)
 from jax.sharding import Mesh
 
 __all__ = ["ProcessMesh"]
@@ -24,12 +26,14 @@ class ProcessMesh:
         arr = np.asarray(mesh)
         if dim_names is None:
             dim_names = [f"d{i}" for i in range(arr.ndim)]
-        assert arr.ndim == len(dim_names)
+        enforce_eq(arr.ndim, len(dim_names),
+                   "mesh array rank must equal len(dim_names)",
+                   op="ProcessMesh")
         self._ids = arr
         self._dim_names = list(dim_names)
         devices = np.array(jax.devices())
         if arr.size > devices.size:
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"ProcessMesh needs {arr.size} devices, only {devices.size} "
                 f"visible")
         self._jax_mesh = Mesh(devices[arr.reshape(-1)].reshape(arr.shape),
@@ -98,4 +102,6 @@ def to_jax_mesh(mesh) -> Mesh:
         return mesh.jax_mesh
     if isinstance(mesh, Mesh):
         return mesh
-    raise TypeError(f"expected ProcessMesh or jax Mesh, got {type(mesh)}")
+    raise InvalidTypeError(
+        f"expected ProcessMesh or jax Mesh, got {type(mesh)}",
+        op="to_jax_mesh")

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 import jax
 import numpy as np
+from ...enforce import PreconditionNotMetError
 
 from .metadata import LocalTensorIndex, Metadata
 from .utils import (chunk_name, chunk_overlap, flatten_state_dict,
@@ -67,7 +68,7 @@ def _assemble_region(key: str, offset, shape, dtype, md: Metadata,
         covered += int(np.prod([s.stop - s.start for s in dst_sl]))
     need = int(np.prod(shape)) if shape else 1
     if covered < need:
-        raise ValueError(
+        raise PreconditionNotMetError(
             f"checkpoint chunk coverage incomplete for '{key}': region "
             f"offset={offset} shape={shape} covered {covered}/{need} elements")
     return out

@@ -25,6 +25,7 @@ from typing import Any, Dict
 
 import jax
 import numpy as np
+from ...enforce import InvalidArgumentError
 
 from ..fleet.meta_parallel.pp_utils.spmd_pipeline import vpp_block_permutation
 
@@ -54,7 +55,7 @@ def pp_relayout_state_dict(state_dict: Dict[str, Any], num_layers: int,
     def fix(leaf):
         if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == num_layers:
             return leaf[idx]
-        raise ValueError(
+        raise InvalidArgumentError(
             f"block leaf with leading dim {getattr(leaf, 'shape', None)} "
             f"!= num_layers {num_layers}; is blocks_key={blocks_key!r} "
             f"right?")

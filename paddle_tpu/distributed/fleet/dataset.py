@@ -13,6 +13,7 @@ batches come out as dicts of arrays ready for jnp.asarray — the natural
 feed for a jit'd PS/embedding step."""
 
 from __future__ import annotations
+from ...enforce import enforce
 
 import os
 import pickle
@@ -66,11 +67,12 @@ class MultiSlotDataGenerator(DataGenerator):
     """(reference MultiSlotDataGenerator) validates the slot structure."""
 
     def _gen_str(self, sample) -> str:
-        if not isinstance(sample, (list, tuple)):
-            raise ValueError("sample must be [(slot, values), ...]")
+        enforce(isinstance(sample, (list, tuple)),
+                "sample must be [(slot, values), ...]",
+                op="MultiSlotDataGenerator")
         for slot, values in sample:
-            if not values:
-                raise ValueError(f"slot {slot!r} has no values")
+            enforce(values, f"slot {slot!r} has no values",
+                    op="MultiSlotDataGenerator")
         return super()._gen_str(sample)
 
 

@@ -10,6 +10,7 @@ reference's `strategy.hybrid_configs = {...}` idiom working.
 """
 
 from __future__ import annotations
+from ...enforce import enforce
 
 import copy
 from typing import Any, Dict
@@ -116,7 +117,9 @@ class DistributedStrategy:
                "sharding": h["sharding_degree"], "sep": h["sep_degree"],
                "mp": h["mp_degree"]}
         order = list(h["order"])
-        assert sorted(order) == sorted(deg), f"bad hybrid order {order}"
+        enforce(sorted(order) == sorted(deg),
+                f"bad hybrid order {order}", op="DistributedStrategy",
+                order=order)
         return {a: int(deg[a]) for a in order}
 
     def __repr__(self):

@@ -15,6 +15,9 @@ from typing import Dict, Optional
 
 import jax
 import numpy as np
+from ...enforce import (InvalidArgumentError,
+                        PreconditionNotMetError, enforce,
+                        enforce_eq)
 
 from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         set_hybrid_communicate_group)
@@ -49,10 +52,10 @@ class Fleet:
         if degrees == 1 and n_dev > 1:
             dims = dict(dims)
             dims["dp"] = n_dev  # default: pure data parallel
-        elif degrees != n_dev:
-            raise ValueError(
-                f"hybrid degrees {dims} multiply to {degrees} but "
-                f"{n_dev} devices are visible")
+        else:
+            enforce_eq(degrees, n_dev,
+                       f"hybrid degrees {dims} multiply to {degrees} but "
+                       f"{n_dev} devices are visible", op="fleet.init")
         topo = CommunicateTopology(
             [_AXIS_TO_NAME[a] for a in dims], list(dims.values()))
         self._hcg = HybridCommunicateGroup(topo)
@@ -97,7 +100,8 @@ class Fleet:
 
     # -- accessors -----------------------------------------------------------
     def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
-        assert self._hcg is not None, "call fleet.init first"
+        enforce(self._hcg is not None, "call fleet.init first",
+                op="fleet", error=PreconditionNotMetError)
         return self._hcg
 
     def is_initialized(self):
@@ -111,7 +115,9 @@ class Fleet:
     def distributed_model(self, model):
         """Wrap by parallel mode (reference: fleet/model.py:143-172 selects
         ShardingParallel/SegmentParallel/TensorParallel/PipelineParallel)."""
-        assert self._is_initialized, "call fleet.init first"
+        enforce(self._is_initialized, "call fleet.init first",
+                op="fleet.distributed_model",
+                error=PreconditionNotMetError)
         hcg = self._hcg
         strat = self._strategy
         if hcg.get_sharding_parallel_world_size() > 1:
@@ -203,7 +209,8 @@ class Fleet:
                 out = P("x")
                 vol = lambda b: (n - 1) / n * b
             else:
-                raise ValueError(f"unknown comm_type {op}")
+                raise InvalidArgumentError(f"unknown comm_type {op}",
+                                           op="collective_perf")
             return fn, out, vol
 
         fn, out_spec, vol = make(comm_type)

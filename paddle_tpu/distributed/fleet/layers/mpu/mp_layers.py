@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from .....enforce import enforce
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -76,8 +77,10 @@ class VocabParallelEmbedding(Layer):
         self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
-        assert num_embeddings % self.world_size == 0, (
-            "vocab size must divide mp degree")
+        enforce(num_embeddings % self.world_size == 0,
+                "vocab size must be divisible by the mp degree",
+                op="VocabParallelEmbedding", num_embeddings=num_embeddings,
+                world=self.world_size)
         self.vocab_per_rank = num_embeddings // self.world_size
         from .....nn.initializer import Normal
         self.weight = self.create_parameter(
@@ -110,7 +113,10 @@ class ColumnParallelLinear(Layer):
                  mp_group=None, name=None):
         super().__init__()
         self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
-        assert out_features % self.world_size == 0
+        enforce(out_features % self.world_size == 0,
+                "out_features must be divisible by the mp world size",
+                op="ColumnParallelLinear", out_features=out_features,
+                world=self.world_size)
         self.in_features = in_features
         self.out_features = out_features
         self.out_per_rank = out_features // self.world_size
@@ -158,7 +164,10 @@ class RowParallelLinear(Layer):
                  mp_group=None, name=None):
         super().__init__()
         self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
-        assert in_features % self.world_size == 0
+        enforce(in_features % self.world_size == 0,
+                "in_features must be divisible by the mp world size",
+                op="RowParallelLinear", in_features=in_features,
+                world=self.world_size)
         self.in_features = in_features
         self.out_features = out_features
         self.input_is_parallel = input_is_parallel

@@ -29,6 +29,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ....enforce import InvalidArgumentError, enforce
 from jax import lax
 
 __all__ = ["DGCMomentum"]
@@ -40,7 +41,8 @@ class DGCMomentum:
     def __init__(self, learning_rate=0.001, momentum=0.9, rho=0.01,
                  rampup_begin_step: int = 0, dp_axis: str = "dp",
                  use_nesterov: bool = False, reduce_dtype=None):
-        assert 0.0 < rho <= 1.0
+        enforce(0.0 < rho <= 1.0, "rho must be in (0, 1]", op="DGC",
+                rho=rho)
         self._lr = learning_rate
         self._momentum = float(momentum)
         self.rho = float(rho)
@@ -49,7 +51,7 @@ class DGCMomentum:
         self._use_nesterov = bool(use_nesterov)
         self._reduce_dtype = reduce_dtype
         if use_nesterov and rampup_begin_step <= 0:
-            raise ValueError(
+            raise InvalidArgumentError(
                 "use_nesterov applies only to the pre-rampup dense phase "
                 "(the DGC exchange already carries momentum); set "
                 "rampup_begin_step > 0 or drop use_nesterov")

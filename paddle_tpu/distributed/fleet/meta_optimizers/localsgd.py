@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ....enforce import enforce_ge
 from jax import lax
 
 __all__ = ["LocalSGD"]
@@ -27,7 +28,7 @@ class LocalSGD:
     _skips_grad_sync = True
 
     def __init__(self, inner, k_steps: int = 4, dp_axis: str = "dp"):
-        assert k_steps >= 1
+        enforce_ge(k_steps, 1, op="LocalSGD", name="k_steps")
         self._inner = inner
         self.k_steps = int(k_steps)
         self.dp_axis = dp_axis

@@ -45,6 +45,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from ....enforce import InvalidArgumentError
 from jax import lax
 
 __all__ = ["ring_attention", "ulysses_attention"]
@@ -68,7 +69,7 @@ def ring_attention(q, k, v, axis: str = "sep", causal: bool = False,
     B, S, H, D = q.shape
     H_kv = k.shape[2]
     if H % max(H_kv, 1) != 0:
-        raise ValueError(
+        raise InvalidArgumentError(
             f"ring attention GQA needs q heads divisible by kv heads "
             f"(got q {H}, kv {H_kv})")
     if impl == "auto":
@@ -415,7 +416,7 @@ def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = False,
     B, S, H, D = q.shape
     H_kv = k.shape[2]
     if H % n != 0 or H_kv % n != 0:
-        raise ValueError(
+        raise InvalidArgumentError(
             f"ulysses needs q heads ({H}) AND kv heads ({H_kv}) divisible "
             f"by the axis size ({n}) — the all-to-all trades the sequence "
             "shard for a head shard on both; repeat kv heads upstream or "

@@ -24,6 +24,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from .....enforce import enforce
 from jax import lax
 
 __all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
@@ -38,7 +39,9 @@ def vpp_block_permutation(num_layers: int, pp: int, vpp: int):
     shard is [V, cl] chunk-major (reference: interleave chunk assignment,
     pp_layers.py PipelineLayerChunk). Model-agnostic — any family with a
     [L, ...]-stacked block pytree uses this."""
-    assert num_layers % (pp * vpp) == 0, (num_layers, pp, vpp)
+    enforce(num_layers % (pp * vpp) == 0,
+            "num_layers must be divisible by pp*virtual_pp",
+            op="spmd_pipeline", num_layers=num_layers, pp=pp, vpp=vpp)
     cl = num_layers // (pp * vpp)
     order = []
     for r in range(pp):
@@ -162,8 +165,8 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params_chunks,
     idx = lax.axis_index(axis)
     M = x_microbatches.shape[0]
     V = jax.tree.leaves(stage_params_chunks)[0].shape[0]
-    assert M >= P, (f"interleaved schedule needs microbatches >= pp degree "
-                    f"({M} < {P})")
+    enforce(M >= P, f"interleaved schedule needs microbatches >= pp degree "
+            f"({M} < {P})", op="spmd_pipeline_interleaved")
     T = V * M + P - 1
 
     fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn

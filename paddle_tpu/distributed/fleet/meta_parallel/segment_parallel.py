@@ -14,6 +14,8 @@ the helpers a sep-parallel train step needs.
 """
 
 from __future__ import annotations
+from ....enforce import (PreconditionNotMetError, enforce,
+                         enforce_in)
 
 from typing import Optional
 
@@ -64,7 +66,8 @@ class SegmentParallel:
     def __init__(self, layers, hcg=None, mesh: Optional[Mesh] = None,
                  axis: str = "sep", strategy=None, mode: str = "ring"):
         del strategy
-        assert mode in ("ring", "ulysses")
+        enforce_in(mode, ("ring", "ulysses"), op="SegmentParallel",
+                   name="mode")
         self._layers = layers
         self._hcg = hcg
         self._mesh = mesh if mesh is not None else (
@@ -90,7 +93,8 @@ class SegmentParallel:
         return ring_attention(q, k, v, axis=self._axis, causal=causal, **kw)
 
     def split_inputs(self, x, seq_dim: int = 1):
-        assert self._mesh is not None, "SegmentParallel needs a mesh"
+        enforce(self._mesh is not None, "SegmentParallel needs a mesh",
+                op="SegmentParallel", error=PreconditionNotMetError)
         return split_sequence(x, self._mesh, self._axis, seq_dim)
 
     def reduce_gradients(self, grads, include_dp: bool = True):

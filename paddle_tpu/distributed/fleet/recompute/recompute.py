@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+from ....enforce import enforce
 
 __all__ = ["recompute", "recompute_sequential"]
 
@@ -37,7 +38,8 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
     """
     del preserve_rng_state, use_reentrant
     if offload:
-        assert policy is None, "pass either policy= or offload=True"
+        enforce(policy is None, "pass either policy= or offload=True",
+                op="recompute")
         policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
     fn = jax.checkpoint(function, policy=policy, prevent_cse=prevent_cse)

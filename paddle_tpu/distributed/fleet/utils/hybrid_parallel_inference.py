@@ -11,6 +11,7 @@ partitions the single program over the mesh.
 """
 
 from __future__ import annotations
+from ....enforce import InvalidTypeError
 
 from typing import Callable
 
@@ -72,7 +73,7 @@ class HybridParallelInferenceHelper:
         dispatch = {G: gen.gpt_generate, L: gen.llama_generate}
         fn = dispatch.get(self.family)
         if fn is None:
-            raise TypeError(
+            raise InvalidTypeError(
                 f"model family {self.family!r} has no `generate` and is not "
                 f"one of the built-in families")
         return fn(params, self.cfg, prompt, max_new_tokens, **sample_kw)

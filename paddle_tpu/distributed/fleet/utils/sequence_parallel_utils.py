@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from ....enforce import enforce
 from jax import lax
 
 from ....nn.layer.layers import Layer
@@ -108,7 +109,10 @@ class ColumnSequenceParallelLinear(Layer):
         from ..layers.mpu.mp_layers import _mp_info, _annotate
         from jax.sharding import PartitionSpec as P
         self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
-        assert out_features % self.world_size == 0
+        enforce(out_features % self.world_size == 0,
+                "out_features must be divisible by the mp world size",
+                op="ColumnSequenceParallelLinear",
+                out_features=out_features, world=self.world_size)
         self.weight = self.create_parameter((in_features, out_features),
                                             attr=weight_attr,
                                             default_initializer=XavierNormal())
@@ -138,7 +142,10 @@ class RowSequenceParallelLinear(Layer):
         from ..layers.mpu.mp_layers import _mp_info, _annotate
         from jax.sharding import PartitionSpec as P
         self.mesh, self.axis, self.world_size, self.rank = _mp_info(mp_group)
-        assert in_features % self.world_size == 0
+        enforce(in_features % self.world_size == 0,
+                "in_features must be divisible by the mp world size",
+                op="RowSequenceParallelLinear", in_features=in_features,
+                world=self.world_size)
         self.weight = self.create_parameter((in_features, out_features),
                                             attr=weight_attr,
                                             default_initializer=XavierNormal())

@@ -13,6 +13,7 @@ auto_tuner.Candidate; `report_metric(value)` writes the metric file.
 """
 
 from __future__ import annotations
+from ...enforce import InvalidArgumentError
 
 import json
 import os
@@ -59,7 +60,7 @@ def run_auto_tune(ctx) -> Optional[str]:
         # inconsistent meshes; a store-synchronized multi-node sweep is
         # future work (the reference's auto-tuner is likewise driven from
         # one launcher)
-        raise ValueError(
+        raise InvalidArgumentError(
             "--auto_tune currently supports single-node jobs only "
             "(nnodes=1); run the sweep on one node and pass the winning "
             "candidate to the multi-node job via "

@@ -11,6 +11,7 @@ paddle_tpu.distributed.init_parallel_env() unchanged on a pod slice.
 """
 
 from __future__ import annotations
+from ...enforce import PreconditionNotMetError, enforce
 
 import json
 import os
@@ -44,7 +45,8 @@ class Master:
                                   is_master=(args.node_rank == 0),
                                   timeout=args.rdzv_timeout)
         else:
-            assert args.nnodes == 1, "--master required for multi-node"
+            enforce(args.nnodes == 1, "--master required for multi-node",
+                    op="launch", error=PreconditionNotMetError)
             self.store = TCPStore("127.0.0.1", 0, world_size=1,
                                   is_master=True,
                                   timeout=args.rdzv_timeout)

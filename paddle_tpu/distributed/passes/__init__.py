@@ -12,6 +12,9 @@ the reference does by hand.
 """
 
 from __future__ import annotations
+from ...enforce import (InvalidArgumentError,
+                        PreconditionNotMetError, enforce,
+                        enforce_in)
 
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional
@@ -47,11 +50,12 @@ class TrainSpec:
         # drain), so a static loss_fn stays valid for it
         if (self.schedule not in ("1F1B", "FThenB") or self.virtual_pp != 1
                 or self.num_microbatches != 1):
-            raise ValueError(
+            raise InvalidArgumentError(
                 "schedule/virtual_pp/num_microbatches are set but loss_fn "
                 "is static — pass loss_fn_factory so pipeline passes can "
                 "take effect (a bare loss_fn cannot be re-scheduled)")
-        assert self.loss_fn is not None, "TrainSpec needs a loss_fn"
+        enforce(self.loss_fn is not None, "TrainSpec needs a loss_fn",
+                op="TrainSpec", error=PreconditionNotMetError)
         return self.loss_fn
 
     def build(self, **kw):
@@ -84,7 +88,9 @@ class PassBase:
 
     def apply(self, spec: TrainSpec, context: Optional[PassContext] = None
               ) -> TrainSpec:
-        assert self.check(spec), f"pass {self.name}: precondition failed"
+        enforce(self.check(spec),
+                f"pass {self.name}: precondition failed", op=self.name,
+                error=PreconditionNotMetError)
         out = self._apply_impl(spec)
         # replace, never mutate: an impl may legitimately return its input
         out = dataclasses.replace(out, applied=spec.applied + (self.name,))
@@ -98,8 +104,9 @@ class PassBase:
 
 def _wrap_loss(spec: TrainSpec, wrapper: Callable) -> TrainSpec:
     """Apply a loss-transform through whichever form the spec carries."""
-    assert spec.loss_fn is not None or spec.loss_fn_factory is not None, (
-        "TrainSpec needs a loss_fn or loss_fn_factory before loss passes")
+    enforce(spec.loss_fn is not None or spec.loss_fn_factory is not None,
+            "TrainSpec needs a loss_fn or loss_fn_factory before loss "
+            "passes", error=PreconditionNotMetError, op="apply_passes")
     if spec.loss_fn_factory is not None:
         inner_factory = spec.loss_fn_factory
         return dataclasses.replace(
@@ -285,8 +292,9 @@ _PASSES = {p.name: p for p in
 
 def new_pass(name: str, attrs: Optional[Dict] = None) -> PassBase:
     """(reference: pass_base.py new_pass)."""
-    if name not in _PASSES:
-        raise ValueError(f"unknown pass {name!r}; have {sorted(_PASSES)}")
+    enforce_in(name, _PASSES,
+               f"unknown pass {name!r}; have {sorted(_PASSES)}",
+               op="new_pass")
     return _PASSES[name](attrs)
 
 
@@ -323,8 +331,9 @@ def build_train_step(spec: TrainSpec, vpp_layers: Optional[int] = None):
     from ..fleet.meta_parallel.pp_utils.spmd_pipeline import (
         vpp_wrap_shard_params)
 
-    assert spec.mesh is not None and spec.optimizer is not None, (
-        "TrainSpec needs mesh and optimizer to build a train step")
+    enforce(spec.mesh is not None and spec.optimizer is not None,
+            "TrainSpec needs mesh and optimizer to build a train step",
+            error=PreconditionNotMetError, op="build_from_spec")
     loss_fn = spec.resolved_loss_fn()
     step, shard_params, init_state = _build(
         loss_fn, spec.param_specs, spec.mesh, spec.optimizer)

@@ -9,6 +9,7 @@ table/accessor vs brpc service layers.
 """
 
 from __future__ import annotations
+from ...enforce import PreconditionNotMetError, enforce
 
 from typing import List, Optional
 
@@ -37,13 +38,15 @@ class TheOnePs:
         self.server: Optional[PsServer] = None
         self.client: Optional[PsClient] = None
         if role == PsRole.SERVER:
-            if configs is None:
-                raise ValueError("server role needs table configs")
+            enforce(configs is not None,
+                    "server role needs table configs", op="ps.init",
+                    error=PreconditionNotMetError)
             self.server = PsServer(configs)
             self.endpoint = self.server.endpoint
         else:
-            if endpoint is None:
-                raise ValueError("worker role needs the server endpoint")
+            enforce(endpoint is not None,
+                    "worker role needs the server endpoint", op="ps.init",
+                    error=PreconditionNotMetError)
             self.client = PsClient(endpoint, client_id=client_id)
             self.endpoint = endpoint
 

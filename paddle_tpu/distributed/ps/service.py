@@ -22,6 +22,7 @@ import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
+from ...enforce import InvalidArgumentError
 
 from ..store import TCPStore
 from .table import DenseTable, SparseTable, make_rule
@@ -171,7 +172,8 @@ class PsServer:
             for tid, sd in req["state"].items():
                 self.tables[int(tid)].load_state_dict(sd)
             return True
-        raise ValueError(f"unknown ps op {op!r}")
+        raise InvalidArgumentError(f"unknown ps op {op!r}",
+                                   op="ps.service")
 
     def stop(self):
         if self._stop.is_set():

@@ -12,6 +12,8 @@ collectives.
 """
 
 from __future__ import annotations
+from ..enforce import (AlreadyExistsError, NotFoundError,
+                       PreconditionNotMetError, enforce)
 
 import pickle
 import threading
@@ -129,7 +131,8 @@ def init_rpc(name: str, rank: Optional[int] = None,
     """Start this worker's RPC agent (reference: rpc.py init_rpc — brpc
     server + gloo-store name registry)."""
     global _AGENT
-    assert _AGENT is None, "init_rpc already called"
+    enforce(_AGENT is None, "init_rpc already called", op="init_rpc",
+            error=AlreadyExistsError)
     import os
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
         else rank
@@ -147,7 +150,8 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
 
 def _agent() -> _Agent:
-    assert _AGENT is not None, "call init_rpc first"
+    enforce(_AGENT is not None, "call init_rpc first", op="rpc",
+            error=PreconditionNotMetError)
     return _AGENT
 
 
@@ -172,7 +176,8 @@ def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
             continue
         if n == name:
             return WorkerInfo(n, i)
-    raise ValueError(f"unknown rpc worker {name!r}")
+    raise NotFoundError(f"unknown rpc worker {name!r}",
+                        op="rpc.get_worker_info")
 
 
 def get_all_worker_infos() -> List[WorkerInfo]:

@@ -31,6 +31,8 @@ from typing import Callable, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...enforce import (PreconditionNotMetError, enforce,
+                        enforce_in)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
@@ -125,10 +127,12 @@ def build_sharded_train_step(
     moment-buffers of HBM. On one 16GB v5e this is what lets a >2.7B bf16
     config train (params + grads + activations only in HBM).
     """
-    assert level in LEVELS, f"level must be one of {LEVELS}"
+    enforce_in(level, LEVELS, op="build_sharded_train_step",
+               name="level")
     stage = _STAGE_OF[level]
-    if shard_axis not in mesh.shape:
-        raise ValueError(f"mesh has no axis '{shard_axis}': {mesh.shape}")
+    enforce_in(shard_axis, mesh.shape,
+               f"mesh has no axis '{shard_axis}': {mesh.shape}",
+               op="build_sharded_train_step")
     if isinstance(data_axes, str):
         data_axes = (data_axes,)
     data_axes = tuple(a for a in data_axes if a in mesh.shape
@@ -352,7 +356,8 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
     offload=True parks the optimizer state in host memory (pinned_host)
     between steps — the reference's stage-3 offload
     (group_sharded_stage3.py:85); each apply() streams it through HBM."""
-    assert level in LEVELS, f"level must be one of {LEVELS}"
+    enforce_in(level, LEVELS, op="group_sharded_parallel",
+               name="level")
     del sync_buffers, unused
     from ..auto_parallel.api import (shard_optimizer, ShardingStage1,
                                      ShardingStage2, ShardingStage3)
@@ -363,7 +368,10 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
     if mesh is None:
         from ..topology import get_hybrid_communicate_group
         hcg = get_hybrid_communicate_group()
-        assert hcg is not None, "group_sharded_parallel needs a mesh/group"
+        enforce(hcg is not None,
+                "group_sharded_parallel needs a mesh/group",
+                op="group_sharded_parallel",
+                error=PreconditionNotMetError)
         mesh = hcg.mesh
         if shard_axis is None:
             shard_axis = ("sharding" if mesh.shape.get("sharding", 1) > 1

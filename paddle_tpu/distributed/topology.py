@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
+from ..enforce import InvalidArgumentError, enforce_eq
 from jax.sharding import Mesh
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup", "Group",
@@ -68,7 +69,9 @@ class CommunicateTopology:
 
     def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "sep", "model"),
                  dims: Sequence[int] = (1, 1, 1, 1, 1)):
-        assert len(hybrid_group_names) == len(dims)
+        enforce_eq(len(hybrid_group_names), len(dims),
+                   "group names and degrees must align",
+                   op="CommunicateTopology")
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
         self.coordinate = list(itertools.product(*[range(d) for d in dims]))
@@ -88,7 +91,9 @@ class CommunicateTopology:
         return self._world_size
 
     def get_rank(self, **kwargs) -> int:
-        assert len(kwargs) == len(self._parallel_names)
+        enforce_eq(len(kwargs), len(self._parallel_names),
+                   "get_rank needs one coordinate per axis",
+                   op="CommunicateTopology.get_rank")
         coord = tuple(kwargs[n] for n in self._parallel_names)
         return self._coord2rank[coord]
 
@@ -148,7 +153,7 @@ def _split_ici_dcn(shape: Sequence[int], n_local: int):
             ici.insert(0, 1)
         elif deg <= rem:
             if rem % deg:
-                raise ValueError(
+                raise InvalidArgumentError(
                     f"axis degree {deg} does not divide the remaining "
                     f"intra-host device block {rem} (shape={list(shape)}, "
                     f"devices/process={n_local})")
@@ -157,7 +162,7 @@ def _split_ici_dcn(shape: Sequence[int], n_local: int):
             rem //= deg
         else:
             if deg % rem:
-                raise ValueError(
+                raise InvalidArgumentError(
                     f"axis degree {deg} cannot absorb the remaining "
                     f"intra-host device block {rem} (shape={list(shape)}, "
                     f"devices/process={n_local})")
@@ -181,7 +186,7 @@ def _hybrid_device_array(shape: Sequence[int], devices: Sequence) -> np.ndarray:
     locals_ = [sorted(by_proc[p], key=_local_order_key) for p in procs]
     n_local = len(locals_[0])
     if any(len(l) != n_local for l in locals_):
-        raise ValueError(
+        raise InvalidArgumentError(
             "uneven device count per process: "
             + str({p: len(by_proc[p]) for p in procs}))
     dcn_shape, ici_shape = _split_ici_dcn(shape, n_local)

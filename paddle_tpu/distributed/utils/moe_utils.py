@@ -19,6 +19,7 @@ XLA inserts the all-to-alls from sharding annotations.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from ...enforce import enforce
 from jax import lax
 
 __all__ = ["global_scatter", "global_gather"]
@@ -33,7 +34,9 @@ def global_scatter(x, axis: str = "ep"):
     """
     world = lax.psum(1, axis)
     e_global, cap, d = x.shape
-    assert e_global % world == 0, (e_global, world)
+    enforce(e_global % world == 0,
+            "global expert count must be divisible by the ep world size",
+            op="global_scatter", num_experts=e_global, world=world)
     # tiled: dim 0 is split into `world` contiguous expert blocks (peer p owns
     # experts [p*e_local, (p+1)*e_local)); arrivals concatenate peer-major on
     # dim 0. Untiled would require e_global == world, breaking e_local > 1.

@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..enforce import InvalidArgumentError, enforce
 
 from ..random import next_key
 
@@ -135,8 +136,9 @@ class Uniform(Distribution):
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
-        assert (logits is None) != (probs is None), \
-            "exactly one of logits/probs"
+        enforce((logits is None) != (probs is None),
+                "exactly one of logits/probs",
+                error=InvalidArgumentError, op="Categorical")
         if probs is not None:
             probs = jnp.asarray(probs, jnp.float32)
             self.logits = jnp.log(probs / jnp.sum(probs, -1, keepdims=True))

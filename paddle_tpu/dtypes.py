@@ -9,6 +9,7 @@ and reference dtype.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from .enforce import InvalidTypeError
 import numpy as np
 
 import ml_dtypes
@@ -84,7 +85,9 @@ def get_default_dtype():
 def set_default_dtype(d):
     d = convert_np_dtype_to_dtype_(d)
     if not is_floating_point(d):
-        raise TypeError(f"default dtype must be floating point, got {d}")
+        raise InvalidTypeError(
+            f"default dtype must be floating point, got {d}",
+            op="set_default_dtype")
     _DEFAULT_DTYPE[0] = jnp.dtype(d).type
 
 

@@ -19,11 +19,12 @@ from typing import Any, Optional
 from .flags import flag
 
 __all__ = [
-    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
-    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
-    "UnimplementedError", "UnavailableError", "PreconditionNotMetError",
-    "ExecutionTimeoutError", "enforce", "enforce_eq", "enforce_gt",
-    "enforce_ge", "enforce_in", "enforce_shape",
+    "EnforceNotMet", "InvalidArgumentError", "InvalidTypeError",
+    "NotFoundError", "OutOfRangeError", "AlreadyExistsError",
+    "PermissionDeniedError", "UnimplementedError", "UnavailableError",
+    "PreconditionNotMetError", "ExecutionTimeoutError", "enforce",
+    "enforce_eq", "enforce_gt", "enforce_ge", "enforce_in",
+    "enforce_shape", "enforce_type",
 ]
 
 
@@ -69,7 +70,15 @@ class InvalidArgumentError(EnforceNotMet, ValueError):
     error_type = "InvalidArgument"
 
 
-class NotFoundError(EnforceNotMet, KeyError):
+class InvalidTypeError(EnforceNotMet, TypeError):
+    """Wrong argument TYPE (kept a TypeError so duck-typed callers and
+    `except TypeError` clauses behave as with the bare raise it replaces)."""
+    error_type = "InvalidType"
+
+
+class NotFoundError(EnforceNotMet, KeyError, ValueError):
+    # also a ValueError: unknown-name lookups were plain ValueErrors
+    # before the taxonomy; callers catch either
     error_type = "NotFound"
 
     def __str__(self):  # KeyError quotes args[0]; keep the rich render
@@ -82,7 +91,8 @@ class OutOfRangeError(EnforceNotMet, IndexError, ValueError):
     error_type = "OutOfRange"
 
 
-class AlreadyExistsError(EnforceNotMet):
+class AlreadyExistsError(EnforceNotMet, ValueError):
+    # ValueError base: duplicate-registration sites were plain ValueErrors
     error_type = "AlreadyExists"
 
 
@@ -94,11 +104,14 @@ class UnimplementedError(EnforceNotMet, NotImplementedError):
     error_type = "Unimplemented"
 
 
-class UnavailableError(EnforceNotMet):
+class UnavailableError(EnforceNotMet, RuntimeError):
     error_type = "Unavailable"
 
 
-class PreconditionNotMetError(EnforceNotMet):
+class PreconditionNotMetError(EnforceNotMet, ValueError):
+    # ValueError base: call-X-first / missing-setup sites were plain
+    # ValueErrors (or asserts) before the round-5 sweep; callers keeping
+    # `except ValueError` continue to work
     error_type = "PreconditionNotMet"
 
 
@@ -142,9 +155,10 @@ def enforce_ge(a, b, message: str = "", **kw) -> None:
 
 
 def enforce_in(value, options, message: str = "", **kw) -> None:
+    shown = sorted(options, key=repr)  # repr-keyed: mixed types sort too
     enforce(value in options,
-            message or f"{value!r} not in allowed set {sorted(options)!r}",
-            value=value, options=sorted(options), **kw)
+            message or f"{value!r} not in allowed set {shown!r}",
+            value=value, options=shown, **kw)
 
 
 def enforce_shape(x, expected, message: str = "", *, op=None, name="input"
@@ -155,3 +169,14 @@ def enforce_shape(x, expected, message: str = "", *, op=None, name="input"
         e is None or s == e for s, e in zip(shape, expected))
     enforce(ok, message or f"{name} expects shape {tuple(expected)}, got "
             f"{shape}", op=op, **{name: x})
+
+
+def enforce_type(value, types, message: str = "", *, op=None,
+                 name="argument") -> None:
+    """Type check raising InvalidTypeError (a TypeError) with op context."""
+    if not isinstance(value, types):
+        tn = (types.__name__ if isinstance(types, type)
+              else "/".join(t.__name__ for t in types))
+        raise InvalidTypeError(
+            message or f"{name} expects {tn}, got {type(value).__name__}",
+            op=op, **{name: value})

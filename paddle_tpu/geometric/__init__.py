@@ -16,6 +16,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..enforce import InvalidArgumentError
 import numpy as np
 
 __all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
@@ -34,7 +35,7 @@ def _num_segments(segment_ids, num_segments: Optional[int]) -> int:
     if num_segments is not None:
         return int(num_segments)
     if isinstance(segment_ids, jax.core.Tracer):
-        raise ValueError(
+        raise InvalidArgumentError(
             "segment ops under jit need a static segment count; pass "
             "num_segments= (reference infers it from data, which would be a "
             "dynamic shape on TPU)")
@@ -88,7 +89,8 @@ def _apply_edge_op(msg, e, compute_fn: str):
         return msg * e
     if compute_fn == "div":
         return msg / e
-    raise ValueError(f"unsupported message op {compute_fn!r}")
+    raise InvalidArgumentError(f"unsupported message op {compute_fn!r}",
+                               op="geometric.send_ue_recv")
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",

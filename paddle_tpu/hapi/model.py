@@ -17,6 +17,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..enforce import PreconditionNotMetError, enforce
 
 from .. import optimizer as opt_mod
 from ..io import DataLoader, Dataset
@@ -141,8 +142,9 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        assert self._optimizer is not None and self._loss is not None, \
-            "call prepare(optimizer, loss) first"
+        enforce(self._optimizer is not None and self._loss is not None,
+                "call prepare(optimizer, loss) first",
+                error=PreconditionNotMetError, op="Model.fit")
         loader = self._to_loader(train_data, batch_size, shuffle, num_workers)
         try:
             steps = len(loader)

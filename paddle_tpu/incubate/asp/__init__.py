@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from ...enforce import enforce
 import numpy as np
 
 from ...nn.layer.layers import Layer
@@ -36,7 +37,9 @@ def create_mask(w, n: int = 2, m: int = 4):
     """Keep the n largest-|w| of every m consecutive weights on the last
     axis (reference mask_1d pattern)."""
     shape = w.shape
-    assert shape[-1] % m == 0, f"last dim {shape[-1]} not divisible by {m}"
+    enforce(shape[-1] % m == 0,
+            f"last dim {shape[-1]} not divisible by {m}",
+            op="asp.create_mask")
     grouped = jnp.abs(jnp.asarray(w)).reshape(-1, m)
     # threshold = n-th largest per group; ties broken by index via argsort
     order = jnp.argsort(-grouped, axis=-1)

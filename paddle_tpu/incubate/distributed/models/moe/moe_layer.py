@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .....enforce import enforce, enforce_in
 from .....nn.functional.activation import gelu
 from .....nn.initializer import Constant, XavierNormal
 from .....nn.layer.layers import Layer
@@ -108,9 +109,8 @@ class MoELayer(Layer):
                  moe_group=None, ep_axis: Optional[str] = None,
                  dispatch_mode: str = "auto"):
         super().__init__()
-        if dispatch_mode not in ("auto", "index", "einsum"):
-            raise ValueError(f"dispatch_mode {dispatch_mode!r} not in "
-                             "('auto', 'index', 'einsum')")
+        enforce_in(dispatch_mode, ("auto", "index", "einsum"),
+                   op="MoELayer", name="dispatch_mode")
         self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         self.num_experts = num_experts
@@ -126,8 +126,9 @@ class MoELayer(Layer):
             self.gate = gate
         self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
         self.mesh, self.ep_axis, self.ep_world = _ep_info(moe_group, ep_axis)
-        if self.num_experts % self.ep_world != 0:
-            raise ValueError("num_experts must divide ep world size")
+        enforce(self.num_experts % self.ep_world == 0,
+                "num_experts must be divisible by the ep world size", op="MoELayer",
+                num_experts=self.num_experts, ep_world=self.ep_world)
         self.aux_loss = jnp.zeros((), jnp.float32)
         if self.mesh is not None and self.ep_world > 1:
             spec = P(self.ep_axis)
@@ -160,17 +161,16 @@ class MoELayer(Layer):
             type(self.gate)._route is not BaseGate._route
             or type(self.gate).forward_index is not BaseGate.forward_index)
         if self.dispatch_mode == "index":
-            if self.ep_world > 1:
-                raise ValueError(
+            enforce(self.ep_world == 1,
                     "dispatch_mode='index' builds a flat local scatter — it "
                     "cannot carry the ep-axis sharding the einsum form "
                     "gives GSPMD (the all-to-all). Use 'auto' or 'einsum' "
-                    "when experts are split over an ep mesh axis.")
-            if not gate_has_index:
-                raise ValueError(
+                    "when experts are split over an ep mesh axis.",
+                    op="MoELayer", ep_world=self.ep_world)
+            enforce(gate_has_index,
                     f"{type(self.gate).__name__} implements neither "
                     "_route() nor forward_index(); index dispatch needs "
-                    "one of them (see BaseGate._route).")
+                    "one of them (see BaseGate._route).", op="MoELayer")
         use_index = (self.dispatch_mode == "index"
                      or (self.dispatch_mode == "auto" and self.ep_world == 1
                          and gate_has_index))

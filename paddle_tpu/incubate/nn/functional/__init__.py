@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ....enforce import enforce, enforce_eq
 
 from ....ops import get_op, register_op, register_pallas_impl
 from ....nn.functional.norm import rms_norm as _rms_norm_op
@@ -232,11 +233,13 @@ def fused_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
         h = F.layer_norm(h, (H,), pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
     if qkv_weight.ndim == 4:
         three, heads, head_dim, _ = qkv_weight.shape
-        assert three == 3
+        enforce_eq(three, 3, "qkv_weight dim 1 must be 3 (q,k,v)",
+                   op="fused_multi_transformer")
         w = qkv_weight.reshape(3 * heads * head_dim, H).T  # [H, 3HD]
     else:
         w = qkv_weight
-        assert num_heads, "num_heads required for 2-D qkv_weight"
+        enforce(num_heads, "num_heads required for 2-D qkv_weight",
+                op="fused_multi_transformer")
         heads = num_heads
         head_dim = H // heads
     qkv = h @ w

@@ -16,6 +16,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ...enforce import PreconditionNotMetError, enforce
 from jax import lax
 
 from ...nn.layer.layers import Layer
@@ -37,8 +38,12 @@ class FusedMultiTransformer(Layer):
                  epsilon: float = 1e-5, name=None):
         super().__init__()
         del name
-        assert normalize_before, "reference kernel is pre-LN only"
-        assert embed_dim % num_heads == 0
+        enforce(normalize_before, "reference kernel is pre-LN only",
+                op="FusedMultiTransformer")
+        enforce(embed_dim % num_heads == 0,
+                "embed_dim must be divisible by num_heads",
+                op="FusedMultiTransformer", embed_dim=embed_dim,
+                num_heads=num_heads)
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -131,7 +136,9 @@ class FusedMultiTransformer(Layer):
                 raise NotImplementedError(
                     "decode mode masks via cache positions (seq_len), not "
                     "attn_mask — pass lengths through the cache instead")
-            assert time_step is not None, "decode needs time_step"
+            enforce(time_step is not None, "decode needs time_step",
+                    op="FusedMultiTransformer",
+                    error=PreconditionNotMetError)
             from ...models.generation import masked_multihead_attention
 
             def attn(q, k, v, ck, cv):

@@ -9,6 +9,8 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..enforce import (InvalidArgumentError,
+                       PreconditionNotMetError, enforce)
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
 
@@ -140,7 +142,7 @@ class PredictorTensor:
             shape, dtype = self._spec
             data = np.ascontiguousarray(data, dtype=dtype)
             if tuple(data.shape) != tuple(shape):
-                raise ValueError(
+                raise InvalidArgumentError(
                     f"input '{self.name}' expects shape {tuple(shape)}, "
                     f"got {tuple(data.shape)}")
         self._value = jax.device_put(data, self._device)
@@ -150,7 +152,9 @@ class PredictorTensor:
         self._value = array
 
     def copy_to_cpu(self) -> np.ndarray:
-        assert self._value is not None, f"tensor '{self.name}' is empty"
+        enforce(self._value is not None,
+                f"tensor '{self.name}' is empty", op="Tensor.copy_to_cpu",
+                error=PreconditionNotMetError)
         return np.asarray(jax.device_get(self._value))
 
     @property
@@ -186,7 +190,9 @@ class Predictor:
                     num_inputs = 1
             self._n_in = max(num_inputs, 1)
         else:
-            assert config.model_path(), "Config has no model path"
+            enforce(config.model_path(), "Config has no model path",
+                    op="create_predictor",
+                    error=PreconditionNotMetError)
             from ..jit import load as jit_load
             tl = jit_load(config.model_path())
             self._callable = tl
@@ -218,7 +224,7 @@ class Predictor:
         """Either positional `inputs` or previously-filled input handles."""
         if inputs is not None:
             if len(inputs) != len(self._inputs):
-                raise ValueError(
+                raise InvalidArgumentError(
                     f"got {len(inputs)} inputs but the program has "
                     f"{len(self._inputs)} input slots "
                     f"({list(self._inputs)}); fill handles individually for "
@@ -243,8 +249,8 @@ class Predictor:
                 "with bfloat16 inputs to deploy bf16")
             self._warned_bf16 = True
         for name, h in self._inputs.items():
-            if h._value is None:
-                raise ValueError(f"input '{name}' not set")
+            enforce(h._value is not None, f"input '{name}' not set",
+                    op="Predictor.run", error=PreconditionNotMetError)
             v = h._value
             if cast is not None and jnp.issubdtype(v.dtype, jnp.floating):
                 v = v.astype(cast)

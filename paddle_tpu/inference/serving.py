@@ -37,11 +37,17 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..enforce import InvalidArgumentError
 from jax import lax
 
 from ..models import gpt as G
 
 __all__ = ["Request", "ServingEngine", "generate_static_batch"]
+
+
+def _dispatch_rtt_ms() -> float:
+    from ..utils.timing import dispatch_rtt_s
+    return dispatch_rtt_s() * 1e3
 
 
 @dataclasses.dataclass
@@ -277,7 +283,7 @@ class ServingEngine:
                  block_size: int = None, num_blocks: int = 256,
                  max_blocks_per_seq: int = 32, chunk: int = None,
                  decode_burst: int = None, seed: int = 0, mesh=None,
-                 mp_axis: str = "mp", adaptive_burst: bool = False,
+                 mp_axis: str = "mp", adaptive_burst="auto",
                  int8: bool = False):
         from ..flags import flag
         block_size = (int(flag("paged_block_size")) if block_size is None
@@ -315,7 +321,11 @@ class ServingEngine:
         # re-admits sooner — a win ONLY when dispatch overhead is below a
         # few decode steps. Through a remote tunnel (~105 ms per fetch)
         # the extra round trips invert it (measured 0.75x vs 1.1x on the
-        # 64-request bench), so it is opt-in.
+        # 64-request bench). "auto" measures the dispatch+fetch RTT once
+        # and enables bursts only when it is small (a real pod / local
+        # chip); True/False force it either way.
+        if adaptive_burst == "auto":
+            adaptive_burst = _dispatch_rtt_ms() < 5.0
         self.adaptive_burst = adaptive_burst
         self.decode_microsteps = 0  # device decode steps issued (telemetry)
         self._pending_tok = np.zeros((max_batch,), np.int32)
@@ -460,7 +470,7 @@ class ServingEngine:
             if need > self.tables.shape[1]:
                 self.queue.pop(0)
                 r.done = True  # cannot ever fit; reject loudly
-                raise ValueError(
+                raise InvalidArgumentError(
                     f"request {r.rid} needs {need} blocks > "
                     f"max_blocks_per_seq {self.tables.shape[1]}")
             if need > len(self.free_blocks):

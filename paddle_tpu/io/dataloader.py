@@ -10,6 +10,7 @@ import threading
 from typing import Callable, Optional
 
 import numpy as np
+from ..enforce import InvalidTypeError
 
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
@@ -84,7 +85,8 @@ class DataLoader:
 
     def __len__(self):
         if self._iterable:
-            raise TypeError("IterableDataset has no len()")
+            raise InvalidTypeError("IterableDataset has no len()",
+                                   op="DataLoader.__len__")
         return len(self.batch_sampler)
 
     def _iter_batches(self):

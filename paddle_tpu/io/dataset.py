@@ -6,6 +6,7 @@ import bisect
 from typing import List, Sequence
 
 import numpy as np
+from ..enforce import enforce_eq
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "ConcatDataset", "Subset", "random_split"]
@@ -33,7 +34,8 @@ class IterableDataset(Dataset):
 class TensorDataset(Dataset):
     def __init__(self, tensors: Sequence):
         lens = {len(t) for t in tensors}
-        assert len(lens) == 1, "tensors must have the same first dim"
+        enforce_eq(len(lens), 1, "tensors must have the same first dim",
+                   op="TensorDataset")
         self.tensors = [np.asarray(t) for t in tensors]
 
     def __getitem__(self, idx):
@@ -49,7 +51,8 @@ class ComposeDataset(Dataset):
     def __init__(self, datasets: List[Dataset]):
         self.datasets = datasets
         lens = {len(d) for d in datasets}
-        assert len(lens) == 1
+        enforce_eq(len(lens), 1, "arrays must have the same first dim",
+                   op="ComposeDataset")
 
     def __len__(self):
         return len(self.datasets[0])
@@ -107,7 +110,8 @@ def random_split(dataset: Dataset, lengths: Sequence, generator=None):
         for i in range(total - sum(counts)):
             counts[i % len(counts)] += 1
         lengths = counts
-    assert sum(lengths) == total, "lengths must sum to dataset size"
+    enforce_eq(sum(lengths), total, "lengths must sum to dataset size",
+               op="random_split")
     perm = np.random.permutation(total)
     out, off = [], 0
     for l in lengths:

@@ -8,6 +8,7 @@ import math
 from typing import Iterator, List, Optional
 
 import numpy as np
+from ..enforce import enforce
 
 __all__ = ["Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "WeightedRandomSampler",
@@ -85,7 +86,9 @@ class BatchSampler(Sampler):
     def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
                  drop_last=False):
         super().__init__(dataset)
-        assert dataset is not None or sampler is not None
+        enforce(dataset is not None or sampler is not None,
+                "BatchSampler needs a dataset or a sampler",
+                op="BatchSampler")
         if sampler is None:
             sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
         self.sampler = sampler

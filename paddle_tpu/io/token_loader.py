@@ -15,6 +15,7 @@ import threading
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
+from ..enforce import InvalidTypeError
 
 from .. import _native
 
@@ -129,7 +130,7 @@ class TokenFileLoader:
 
     def __len__(self):
         if self.epochs < 0:
-            raise TypeError("TokenFileLoader with epochs<0 is an infinite "
+            raise InvalidTypeError("TokenFileLoader with epochs<0 is an infinite "
                             "stream and has no length")
         data_len = np.memmap(self.path, dtype=np.int32, mode="r").shape[0]
         window = self.seq_len + 1

@@ -9,6 +9,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..enforce import InvalidArgumentError, InvalidTypeError
 import numpy as np
 
 from ..nn.layer.layers import Layer, functional_call, functional_train_graph
@@ -181,7 +182,8 @@ def _example_inputs(input_spec, example_args):
     if example_args is not None:
         return tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
                      for a in example_args)
-    raise ValueError("save() needs input_spec or example inputs")
+    raise InvalidArgumentError(
+        "save() needs input_spec or example inputs", op="jit.save")
 
 
 def save(obj, path: str, input_spec=None, example_args=None, **configs):
@@ -198,7 +200,7 @@ def save(obj, path: str, input_spec=None, example_args=None, **configs):
     elif isinstance(obj, Layer) or callable(obj):
         sf = to_static(obj, input_spec=input_spec)
     else:
-        raise TypeError(f"cannot save {type(obj)}")
+        raise InvalidTypeError(f"cannot save {type(obj)}", op="jit.save")
     sf._build()
 
     inputs = _example_inputs(input_spec or sf._input_spec, example_args)

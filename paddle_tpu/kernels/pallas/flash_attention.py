@@ -53,6 +53,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from ...enforce import enforce
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -956,8 +957,8 @@ def flash_attention(query, key, value, causal=False, sm_scale=None,
                   None if right is None or right < 0 else int(right))
         if window == (None, None):
             window = None
-    if dropout_p > 0 and dropout_seed is None:
-        raise ValueError("dropout_p > 0 requires dropout_seed")
+    enforce(not (dropout_p > 0 and dropout_seed is None),
+            "dropout_p > 0 requires dropout_seed", op="flash_attention")
     if q_segment_ids is not None:
         q_segment_ids = q_segment_ids.astype(jnp.int32)
         kv_segment_ids = kv_segment_ids.astype(jnp.int32)

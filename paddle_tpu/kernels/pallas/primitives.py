@@ -29,6 +29,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from ...enforce import InvalidArgumentError
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -64,7 +65,7 @@ def elementwise(fn: Callable, *arrays, block_rows: int = 256,
     r, c = xs2[0].shape
     for a in xs2[1:]:
         if a.shape != (r, c):
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"elementwise primitive needs equal shapes, got "
                 f"{[tuple(a.shape) for a in xs2]}")
     br = _tile(r, block_rows)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax.numpy as jnp
+from ..enforce import OutOfRangeError, enforce
 
 from .. import nn
 from ..nn import functional as F
@@ -154,7 +155,9 @@ def pack_sequences(seqs, seq_len: int, pad_id: int = 0):
     row_of_seq, offset_of_seq = [], []
     for s in seqs:
         L = len(s)
-        assert L <= seq_len, f"sequence of {L} tokens exceeds row {seq_len}"
+        enforce(L <= seq_len,
+                f"sequence of {L} tokens exceeds row {seq_len}",
+                op="bert.pack_sequences", error=OutOfRangeError)
         for r in range(len(rows)):
             if row_lens[r] + L <= seq_len:
                 break

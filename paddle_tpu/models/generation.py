@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..enforce import InvalidTypeError, OutOfRangeError
 import numpy as np
 from jax import lax
 
@@ -173,7 +174,7 @@ def gpt_generate(params, cfg: G.GPTConfig, prompt, max_new_tokens: int,
     """
     total = prompt.shape[1] + max_new_tokens
     if total > cfg.max_seq_len:
-        raise ValueError(
+        raise OutOfRangeError(
             f"prompt ({prompt.shape[1]}) + max_new_tokens "
             f"({max_new_tokens}) = {total} exceeds the position table "
             f"(max_seq_len={cfg.max_seq_len})")
@@ -341,7 +342,7 @@ class PagedKVCache:
     def _check_capacity(self, b: int, need: int):
         import jax.core as _core
         if isinstance(self.seq_lens, _core.Tracer):
-            raise TypeError(
+            raise InvalidTypeError(
                 "PagedKVCache.write/prefill are host-side cache-management "
                 "methods and cannot run under jit (they read concrete "
                 "seq_lens for the capacity check); call them outside the "

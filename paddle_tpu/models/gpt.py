@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from ..enforce import enforce
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -56,7 +57,9 @@ class GPTConfig:
     def __post_init__(self):
         if self.ffn_hidden is None:
             self.ffn_hidden = 4 * self.hidden_size
-        assert self.hidden_size % self.num_heads == 0
+        enforce(self.hidden_size % self.num_heads == 0,
+                "hidden_size must be divisible by num_heads", op="GPTConfig",
+                hidden_size=self.hidden_size, num_heads=self.num_heads)
 
     @property
     def head_dim(self):
@@ -482,7 +485,9 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     """
     b_local, S = tokens.shape
     M = num_microbatches
-    assert b_local % M == 0, (b_local, M)
+    enforce(b_local % M == 0,
+            "per-dp-rank batch must be divisible by num_microbatches",
+            op="gpt.hybrid_loss_fn", batch_local=b_local, microbatches=M)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x + params["wpe"][None, :S]
     x = x.astype(cfg.dtype)

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from ..enforce import enforce
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -65,8 +66,13 @@ class LlamaConfig:
             # Llama sizing: 2/3 · 4H rounded up to a multiple of 256
             self.intermediate_size = 256 * math.ceil(8 * self.hidden_size
                                                      / 3 / 256)
-        assert self.hidden_size % self.num_heads == 0
-        assert self.num_heads % self.num_kv_heads == 0
+        enforce(self.hidden_size % self.num_heads == 0,
+                "hidden_size must be divisible by num_heads", op="LlamaConfig",
+                hidden_size=self.hidden_size, num_heads=self.num_heads)
+        enforce(self.num_heads % self.num_kv_heads == 0,
+                "num_heads must be divisible by num_kv_heads (GQA groups)",
+                op="LlamaConfig", num_heads=self.num_heads,
+                num_kv_heads=self.num_kv_heads)
 
     @property
     def head_dim(self):
@@ -427,7 +433,9 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     """Per-device loss of the full hybrid Llama (inside shard_map)."""
     b_local, S = tokens.shape
     M = num_microbatches
-    assert b_local % M == 0, (b_local, M)
+    enforce(b_local % M == 0,
+            "per-dp-rank batch must be divisible by num_microbatches",
+            op="llama.hybrid_loss_fn", batch_local=b_local, microbatches=M)
     cos, sin = rope_tables(cfg, S)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x.astype(cfg.dtype)

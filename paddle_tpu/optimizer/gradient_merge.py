@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ..enforce import (PreconditionNotMetError, enforce,
+                       enforce_ge)
 from jax import lax
 
 __all__ = ["GradientMergeOptimizer"]
@@ -24,7 +26,8 @@ class GradientMergeOptimizer:
     gradient accumulation."""
 
     def __init__(self, inner, k_steps: int, avg: bool = True):
-        assert k_steps >= 1
+        enforce_ge(k_steps, 1, op="GradientMergeOptimizer",
+                   name="k_steps")
         self._inner = inner
         self.k_steps = int(k_steps)
         self.avg = avg
@@ -76,8 +79,10 @@ class GradientMergeOptimizer:
         """Eager accumulation over Parameter.grad slots: the inner step
         fires only every k-th call (matching apply())."""
         params = getattr(self._inner, "_parameter_list", None)
-        assert params, ("GradientMergeOptimizer.step() needs the inner "
-                        "optimizer constructed with `parameters`")
+        enforce(params, "GradientMergeOptimizer.step() needs the inner "
+                "optimizer constructed with `parameters`",
+                op="GradientMergeOptimizer.step",
+                error=PreconditionNotMetError)
         if self._eager_acc is None:
             self._eager_acc = [None] * len(params)
         for i, p in enumerate(params):

@@ -15,6 +15,7 @@ from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..enforce import PreconditionNotMetError, enforce
 
 __all__ = ["LBFGS", "minimize_lbfgs"]
 
@@ -180,7 +181,8 @@ class LBFGS:
     def step(self, closure: Callable):
         """closure must compute the loss FROM the parameter values it is
         given: closure(values_list) -> scalar loss."""
-        assert self._params, "LBFGS constructed without `parameters`"
+        enforce(self._params, "LBFGS constructed without `parameters`",
+                op="LBFGS.step", error=PreconditionNotMetError)
         values = [p.value for p in self._params]
 
         def loss_fn(vals):

@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+from ..enforce import (InvalidArgumentError, InvalidTypeError,
+                       PreconditionNotMetError, enforce)
 import numpy as np
 
 from ..nn.layer.layers import Layer, Parameter
@@ -199,8 +201,9 @@ class Optimizer:
 
     # -- eager surface -------------------------------------------------------
     def _ensure_params(self):
-        if self._parameter_list is None:
-            raise ValueError("optimizer constructed without `parameters`")
+        enforce(self._parameter_list is not None,
+                "optimizer constructed without `parameters`",
+                op="Optimizer.step", error=PreconditionNotMetError)
 
     def _param_key(self, idx: int, p: Parameter) -> str:
         return p.name if p.name else f"param_{idx}"
@@ -479,7 +482,7 @@ class Adam(Optimizer):
     def apply(self, params, grads, state, lr=None):
         use_mt = self._use_multi_tensor
         if use_mt and not self._fusable(grads):
-            raise ValueError(
+            raise InvalidArgumentError(
                 "use_multi_tensor=True needs a plain Adam/AdamW update "
                 "(no lazy_mode/apply_decay_param_fun/lr_ratio/SelectedRows "
                 "grads)")
@@ -600,7 +603,7 @@ class AdamW(Adam):
         self._lr_ratio = lr_ratio
         if use_multi_tensor and (apply_decay_param_fun is not None
                                  or lr_ratio is not None):
-            raise ValueError(
+            raise InvalidArgumentError(
                 "use_multi_tensor=True needs a plain AdamW update — "
                 "apply_decay_param_fun/lr_ratio thread per-parameter "
                 "context the fused pass cannot")
@@ -679,7 +682,7 @@ class Lars(Optimizer):
                  exclude_from_weight_decay=None, epsilon=1e-9, name=None,
                  **kw):
         if "weight_decay" in kw:
-            raise TypeError(
+            raise InvalidTypeError(
                 "Lars takes lars_weight_decay=, not weight_decay= — "
                 "refusing to silently ignore it")
         super().__init__(learning_rate, parameters, lars_weight_decay,

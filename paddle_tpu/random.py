@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from .enforce import AlreadyExistsError, NotFoundError
 import numpy as np
 
 __all__ = [
@@ -154,9 +155,11 @@ class RNGStatesTracker:
 
     def add(self, name: str, seed_: int):
         if seed_ in self.seeds_:
-            raise ValueError(f"seed {seed_} already exists")
+            raise AlreadyExistsError(f"seed {seed_} already exists",
+                                     op="RNGStatesTracker.add")
         if name in self.states_:
-            raise ValueError(f"state {name} already exists")
+            raise AlreadyExistsError(f"state {name} already exists",
+                                     op="RNGStatesTracker.add")
         self.seeds_.add(seed_)
         self.states_[name] = Generator(seed_)
 
@@ -170,7 +173,8 @@ class RNGStatesTracker:
     @contextlib.contextmanager
     def rng_state(self, name: str = MODEL_PARALLEL_RNG):
         if name not in self.states_:
-            raise ValueError(f"state {name} does not exist")
+            raise NotFoundError(f"state {name} does not exist",
+                                op="RNGStatesTracker.rng_state")
         with rng_guard(self.states_[name].next_key()):
             yield
 

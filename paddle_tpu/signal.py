@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
+from .enforce import InvalidArgumentError
 import numpy as np
 
 __all__ = ["frame", "overlap_add", "stft", "istft"]
@@ -50,7 +51,8 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
         xt = jnp.moveaxis(x, 0, -1)                           # [..., T]
         out = _frames_last(xt, frame_length, hop_length)      # [..., F, L]
         return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 0)  # [F, L, ...]
-    raise ValueError(f"axis must be 0 or -1, got {axis}")
+    raise InvalidArgumentError(f"axis must be 0 or -1, got {axis}",
+                               op="signal.frame", axis=axis)
 
 
 def overlap_add(frames, hop_length: int, axis: int = -1, name=None):
@@ -62,7 +64,8 @@ def overlap_add(frames, hop_length: int, axis: int = -1, name=None):
     if axis == 0:
         f = jnp.moveaxis(jnp.moveaxis(frames, 0, -1), 0, -1)  # [..., F, L]
         return jnp.moveaxis(_overlap_add_last(f, hop_length), -1, 0)
-    raise ValueError(f"axis must be 0 or -1, got {axis}")
+    raise InvalidArgumentError(f"axis must be 0 or -1, got {axis}",
+                               op="signal.overlap_add", axis=axis)
 
 
 def stft(x, n_fft: int, hop_length: Optional[int] = None,
@@ -85,7 +88,7 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
         widths = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
         x = jnp.pad(x, widths, mode=pad_mode)
     if jnp.iscomplexobj(x) and onesided:
-        raise ValueError("stft: onesided must be False for complex input "
+        raise InvalidArgumentError("stft: onesided must be False for complex input "
                          "(reference: python/paddle/signal.py stft check)")
     frames = _frames_last(x, n_fft, hop_length)   # [..., F, n_fft]
     frames = frames * window

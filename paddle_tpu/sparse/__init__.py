@@ -13,6 +13,8 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..enforce import InvalidTypeError
+from ..enforce import enforce, enforce_eq
 import numpy as np
 from jax.experimental import sparse as jsparse
 
@@ -227,7 +229,7 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
         out = jnp.sum(jnp.asarray(x), axis=axis, keepdims=keepdim)
         return out.astype(dtype) if dtype is not None else out
     if isinstance(x.data, jax.core.Tracer):
-        raise TypeError(
+        raise InvalidTypeError(
             "sparse.sum is eager-only (the output nnz is data-dependent, "
             "like the reference kernel's out_nnz) — call it outside jit, "
             "or densify the input explicitly first")
@@ -280,8 +282,10 @@ def softmax(x, axis=-1, name=None):
     """Row softmax over the SPARSITY PATTERN (reference:
     sparse/nn/functional/activation.py softmax — only stored values
     participate; zeros stay zero). 2-D, last axis."""
-    assert axis in (-1, x.ndim - 1), "sparse softmax: last axis only"
-    assert x.ndim == 2, "sparse softmax supports 2-D tensors"
+    enforce(axis in (-1, x.ndim - 1), "sparse softmax: last axis only",
+            op="sparse.softmax", axis=axis)
+    enforce_eq(x.ndim, 2, "sparse softmax supports 2-D tensors",
+               op="sparse.softmax")
     xc = coalesce(x) if is_sparse(x) else to_sparse_coo(x)
     rows = xc.indices[:, 0]
     vals = xc.data.astype(jnp.float32)

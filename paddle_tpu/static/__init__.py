@@ -21,6 +21,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..enforce import (InvalidArgumentError, NotFoundError,
+                       OutOfRangeError, PreconditionNotMetError, enforce,
+                       enforce_in, enforce_type)
 import numpy as np
 
 from ..jit.api import InputSpec
@@ -76,10 +79,11 @@ class Program:
 
     def _compiled(self):
         if self._jitted is None:
-            assert self._fn is not None, (
-                "Program has no computation: use set_output()/from_callable "
-                "(classic op-by-op building is tracing here — see module "
-                "docstring)")
+            enforce(self._fn is not None,
+                    "Program has no computation: use set_output()/"
+                    "from_callable (classic op-by-op building is tracing "
+                    "here — see module docstring)", op="Program",
+                    error=PreconditionNotMetError)
             self._jitted = jax.jit(self._fn)
         return self._jitted
 
@@ -142,9 +146,8 @@ def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=N
     """Host callback op (reference: static.py_func). Under jit this rides
     jax.pure_callback; gradients need a PyLayer instead."""
     del backward_func, skip_vars_in_backward_input
-    if out is None:
-        raise ValueError("py_func needs `out` (a ShapeDtypeStruct or "
-                         "example array describing the result)")
+    enforce(out is not None, "py_func needs `out` (a ShapeDtypeStruct "
+            "or example array describing the result)", op="py_func")
     shape_dtype = jax.ShapeDtypeStruct(jnp.shape(out), jnp.result_type(out))
     return jax.pure_callback(func, shape_dtype, x)
 
@@ -163,9 +166,9 @@ class Executor:
         feed = feed or {}
         names = program.input_names()
         missing = [n for n in names if n not in feed]
-        if missing:
-            raise ValueError(f"feed missing inputs {missing}; program "
-                             f"declares {names}")
+        enforce(not missing, f"feed missing inputs {missing}; "
+                f"program declares {names}", op="Executor.run",
+                error=NotFoundError)
         args = [jnp.asarray(feed[n]) for n in names]
         out = program._compiled()(*args)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
@@ -174,30 +177,29 @@ class Executor:
             out_names = program._output_names
             for item in fetch_list:
                 if isinstance(item, int):
-                    if item >= len(outs):
-                        raise ValueError(
+                    enforce(item < len(outs),
                             f"fetch index {item} out of range "
-                            f"({len(outs)} outputs)")
+                            f"({len(outs)} outputs)", op="Executor.run",
+                            error=OutOfRangeError)
                     picked.append(outs[item])
                 elif isinstance(item, str):
                     if out_names is not None:
-                        if item not in out_names:
-                            raise ValueError(
-                                f"unknown fetch name {item!r}; program "
-                                f"outputs are named {out_names}")
+                        enforce_in(item, out_names,
+                                   f"unknown fetch name {item!r}; program "
+                                   f"outputs are named {out_names}",
+                                   op="Executor.run")
                         picked.append(outs[out_names.index(item)])
                     elif len(outs) == 1 and len(fetch_list) == 1:
                         picked.append(outs[0])  # unambiguous
                     else:
-                        raise ValueError(
+                        raise InvalidArgumentError(
                             f"cannot fetch {item!r} by name: the program "
                             f"has {len(outs)} unnamed outputs — declare "
                             f"output_names via set_output/from_callable or "
-                            f"fetch by integer index")
+                            f"fetch by integer index", op="Executor.run")
                 else:
-                    raise TypeError(
-                        f"fetch_list entries must be int or str, got "
-                        f"{type(item)}")
+                    enforce_type(item, (int, str), op="Executor.run",
+                                 name="fetch_list entry")
             outs = picked
         if return_numpy:
             return [np.asarray(o) for o in outs]

@@ -15,6 +15,7 @@ import tarfile
 from typing import Callable, Optional
 
 import numpy as np
+from ..enforce import enforce_eq
 
 from ..io import Dataset
 
@@ -120,13 +121,15 @@ class MNIST(Dataset):
     def _read_images(self, p):
         with self._open(p) as f:
             magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-            assert magic == 2051
+            enforce_eq(magic, 2051, "bad MNIST image-file magic",
+                       op="vision.datasets.MNIST")
             return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, 1, rows, cols)
 
     def _read_labels(self, p):
         with self._open(p) as f:
             magic, n = struct.unpack(">II", f.read(8))
-            assert magic == 2049
+            enforce_eq(magic, 2049, "bad MNIST label-file magic",
+                       op="vision.datasets.MNIST")
             return np.frombuffer(f.read(), dtype=np.uint8)
 
     def __len__(self):

@@ -1,0 +1,12 @@
+"""Shared helpers for the vision model zoo."""
+
+from ...enforce import UnavailableError, enforce
+
+
+def no_pretrained(pretrained: bool) -> None:
+    """Shared guard: pretrained weights are not bundled (zero-egress
+    build); load a checkpoint with paddle.load + set_state_dict instead."""
+    enforce(not pretrained,
+            "pretrained weights are not bundled in this build (no egress); "
+            "load a checkpoint with paddle.load + set_state_dict instead",
+            op="vision.models", error=UnavailableError)

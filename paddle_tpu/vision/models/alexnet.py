@@ -1,6 +1,7 @@
 """AlexNet (reference: python/paddle/vision/models/alexnet.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 from ... import nn
 
@@ -37,5 +38,5 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained: bool = False, **kwargs) -> AlexNet:
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return AlexNet(**kwargs)

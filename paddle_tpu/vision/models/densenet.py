@@ -1,6 +1,8 @@
 """DenseNet (reference: python/paddle/vision/models/densenet.py)."""
 
 from __future__ import annotations
+from ...enforce import enforce_in
+from ._utils import no_pretrained
 
 import jax.numpy as jnp
 
@@ -49,7 +51,7 @@ class DenseNet(nn.Layer):
                  dropout: float = 0.0, num_classes: int = 1000,
                  with_pool: bool = True):
         super().__init__()
-        assert layers in _CFG, f"layers must be one of {sorted(_CFG)}"
+        enforce_in(layers, _CFG, op="DenseNet", name="layers")
         init_c, growth, blocks = _CFG[layers]
         self.num_classes = num_classes
         self.with_pool = with_pool
@@ -83,7 +85,7 @@ class DenseNet(nn.Layer):
 
 
 def _make(layers, pretrained, **kw):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return DenseNet(layers=layers, **kw)
 
 

@@ -2,6 +2,7 @@
 python/paddle/vision/models/googlenet.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 import jax.numpy as jnp
 
@@ -88,5 +89,5 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained: bool = False, **kwargs) -> GoogLeNet:
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return GoogLeNet(**kwargs)

@@ -1,6 +1,7 @@
 """Inception v3 (reference: python/paddle/vision/models/inceptionv3.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 import jax.numpy as jnp
 
@@ -145,5 +146,5 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained: bool = False, **kwargs) -> InceptionV3:
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return InceptionV3(**kwargs)

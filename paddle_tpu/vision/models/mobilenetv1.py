@@ -1,6 +1,7 @@
 """MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 from ... import nn
 
@@ -56,5 +57,5 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained: bool = False, scale: float = 1.0, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return MobileNetV1(scale=scale, **kwargs)

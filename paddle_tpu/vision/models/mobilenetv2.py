@@ -1,6 +1,7 @@
 """MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 from ... import nn
 
@@ -88,5 +89,5 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return MobileNetV2(scale=scale, **kwargs)

@@ -1,6 +1,7 @@
 """MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 from ... import nn
 from .mobilenetv2 import _make_divisible
@@ -120,10 +121,10 @@ class MobileNetV3Small(_MobileNetV3):
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return MobileNetV3Large(scale=scale, **kw)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return MobileNetV3Small(scale=scale, **kw)

@@ -1,6 +1,8 @@
 """ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
 
 from __future__ import annotations
+from ...enforce import enforce_in
+from ._utils import no_pretrained
 
 import jax.numpy as jnp
 
@@ -78,7 +80,7 @@ class ShuffleNetV2(nn.Layer):
     def __init__(self, scale: float = 1.0, act: str = "relu",
                  num_classes: int = 1000, with_pool: bool = True):
         super().__init__()
-        assert scale in _STAGE_OUT, f"scale must be one of {sorted(_STAGE_OUT)}"
+        enforce_in(scale, _STAGE_OUT, op="ShuffleNetV2", name="scale")
         c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
         self.num_classes = num_classes
         self.with_pool = with_pool
@@ -110,7 +112,7 @@ class ShuffleNetV2(nn.Layer):
 
 
 def _make(scale, act, pretrained, **kw):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return ShuffleNetV2(scale=scale, act=act, **kw)
 
 

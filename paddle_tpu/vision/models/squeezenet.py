@@ -1,6 +1,7 @@
 """SqueezeNet (reference: python/paddle/vision/models/squeezenet.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 import jax.numpy as jnp
 
@@ -64,10 +65,10 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained: bool = False, **kwargs) -> SqueezeNet:
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return SqueezeNet("1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained: bool = False, **kwargs) -> SqueezeNet:
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return SqueezeNet("1.1", **kwargs)

@@ -1,6 +1,7 @@
 """VGG family (reference: python/paddle/vision/models/vgg.py)."""
 
 from __future__ import annotations
+from ._utils import no_pretrained
 
 from ... import nn
 
@@ -62,20 +63,20 @@ def _vgg(arch, batch_norm=False, **kwargs):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return _vgg("A", batch_norm, **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return _vgg("B", batch_norm, **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return _vgg("D", batch_norm, **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    assert not pretrained, "pretrained weights are not bundled"
+    no_pretrained(pretrained)
     return _vgg("E", batch_norm, **kwargs)

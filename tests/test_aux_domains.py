@@ -519,7 +519,8 @@ def test_sparse_softmax_dense_input_and_rank_guard():
     out = sp.softmax(jnp.eye(3))  # dense input must work
     np.testing.assert_allclose(np.asarray(sp.to_dense(out)), np.eye(3))
     import pytest as _pytest
-    with _pytest.raises(AssertionError):
+    from paddle_tpu.enforce import InvalidArgumentError
+    with _pytest.raises(InvalidArgumentError):  # typed since the r5 sweep
         sp.softmax(sp.to_sparse_coo(jnp.ones((2, 2, 2))))
 
 
