@@ -64,7 +64,8 @@ def _mem_stats(compiled) -> Dict[str, int]:
 
 def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
                          microbatches: int = 2,
-                         moment_dtype=jnp.bfloat16) -> Dict[str, Any]:
+                         moment_dtype=jnp.bfloat16,
+                         zero1_dp: bool = False) -> Dict[str, Any]:
     """Compile the full dp x pp x mp hybrid train step (1F1B pipeline,
     vocab-parallel CE, dp grad pmean, fused AdamW update) at the REAL
     GPT-3 6.7B shape (H=4096, L=32, heads=32, vocab 50304) and return
@@ -84,12 +85,16 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  moment_dtype=moment_dtype)
     step, _, _ = G.build_hybrid_train_step(
-        cfg, mesh, opt, num_microbatches=microbatches)
+        cfg, mesh, opt, num_microbatches=microbatches, zero1_dp=zero1_dp)
 
     specs = G.hybrid_param_specs(cfg)
     pshape = jax.eval_shape(
         lambda: G.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
-    sspec = state_specs_for(opt, specs, pshape)
+    if zero1_dp:
+        from ..models.hybrid_engine import zero1_state_specs
+        _, sspec = zero1_state_specs(opt, specs, pshape, mesh, "dp")
+    else:
+        sspec = state_specs_for(opt, specs, pshape)
     sshape = jax.eval_shape(opt.init_state, pshape)
 
     def shaped(shapes, spec_tree):
@@ -127,7 +132,8 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
         + cfg.max_seq_len * H + 2 * H)
     assert abs(param_b - expect) / expect < 0.001, (param_b, expect)
 
-    out = {"config": "gpt3_6p7b H=4096 L=32 heads=32 vocab=50304",
+    out = {"config": "gpt3_6p7b H=4096 L=32 heads=32 vocab=50304"
+                     + (" + zero1 dp-sharded state" if zero1_dp else ""),
            "mesh": dict(mesh.shape), "seq": seq, "batch": batch,
            "microbatches": microbatches,
            "n_params": n_params,

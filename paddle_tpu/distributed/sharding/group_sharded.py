@@ -303,29 +303,24 @@ def build_sharded_train_step(
                 lambda g: jax.device_put(g, _state_sharding(g))
                 if _offloadable(g) else g, grads)
             step_no = opt_state["step"] + 1
-            rng_base = (jax.random.key(step_no.astype(jnp.uint32),
-                                       impl="rbg") if needs_rng else None)
-            from ...optimizer.optimizer import _path_name
-            paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
-            leaves_p = [leaf for _, leaf in paths_p]
-            names = [_path_name(path) for path, _ in paths_p]
-            leaves_g = treedef.flatten_up_to(grads)
-            leaves_s = treedef.flatten_up_to(opt_state["slots"])
+            # names → ctx → rng per leaf via the ONE shared protocol
+            # (Optimizer._leaf_items — also drives _apply_leaves and the
+            # hybrid engine's ZeRO-1 loop)
+            treedef, items = optimizer._leaf_items(
+                params, grads, opt_state["slots"], step_no)
             new_p, new_s = [], []
-            for i, (p, g, s) in enumerate(zip(leaves_p, leaves_g, leaves_s)):
+            for p, g, s, ctx, rng in items:
                 if g is None:
                     new_p.append(p)
                     new_s.append(s)
                     continue
-                ctx = optimizer._leaf_ctx(names[i])
                 s_dev = jax.tree.map(
                     lambda x: jax.device_put(
                         x, _state_sharding(x, kind="device")), s)
                 if _offloadable(g):
                     g = jax.device_put(g, _state_sharding(g, kind="device"))
                 if needs_rng:
-                    np_, ns_ = upd(p, g, s_dev, lr, step_no,
-                                   jax.random.fold_in(rng_base, i), ctx)
+                    np_, ns_ = upd(p, g, s_dev, lr, step_no, rng, ctx)
                 else:
                     np_, ns_ = upd(p, g, s_dev, lr, step_no, ctx)
                 new_p.append(np_)

@@ -523,7 +523,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, schedule: str = "1F1B",
-                            grad_reduce_dtype="auto"):
+                            grad_reduce_dtype="auto",
+                            zero1_dp: bool = False):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -546,7 +547,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
-        grad_reduce_dtype=grad_reduce_dtype)
+        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

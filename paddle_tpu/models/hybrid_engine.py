@@ -18,7 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils import shard_map as _shard_map
 
-__all__ = ["build_train_step", "state_specs_for"]
+__all__ = ["build_train_step", "state_specs_for",
+           "zero1_state_specs"]
 
 
 def state_specs_for(optimizer, specs, example_params=None):
@@ -64,10 +65,115 @@ def state_specs_for(optimizer, specs, example_params=None):
     return jax.tree_util.tree_map_with_path(spec_for, state_shape)
 
 
+def _zero1_dims(specs, example_params, mesh: Mesh, dp_axis: str):
+    """Per-param-leaf dim index to shard optimizer state (and the update)
+    over the dp axis — ZeRO stage 1 composed with the hybrid mesh
+    (reference: DygraphShardingOptimizer stage-1 partitioning,
+    fleet/meta_parallel/dygraph_optimizer/dygraph_sharding_optimizer.py:44
+    `_partition_parameters`, running under HybridParallelOptimizer).
+    Picks the first dim with no existing mesh axis whose LOCAL extent
+    (global / pp·mp shards) divides the dp degree; -1 = leaf stays
+    replicated (tiny vectors; -1 not None — a None pytree leaf would
+    vanish from tree_map/flatten_up_to)."""
+    dp = mesh.shape[dp_axis]
+
+    def dim_for(spec, leaf):
+        shape = getattr(leaf, "shape", ())
+        for d in range(len(shape)):
+            ax = spec[d] if d < len(spec) else None
+            if ax is not None:
+                continue
+            local = shape[d]
+            if local % dp == 0 and local >= dp:
+                return d
+        return -1
+
+    return jax.tree.map(dim_for, specs, example_params,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _zero1_extend_spec(spec: P, zd, dp_axis: str, ndim: int) -> P:
+    if zd < 0:
+        return spec
+    entries = list(spec) + [None] * (ndim - len(spec))
+    entries[zd] = dp_axis
+    return P(*entries)
+
+
+def zero1_state_specs(optimizer, specs, example_params, mesh: Mesh,
+                      dp_axis: str = "dp"):
+    """(zdims, state_specs) for ZeRO-1-over-dp: the ONE derivation of the
+    dp-sharded optimizer-state layout, shared by build_train_step, the
+    hbm_audit 6.7B compile and the byte-shrink test (three call sites
+    must agree or audited bytes stop matching the real program)."""
+    zdims = _zero1_dims(specs, example_params, mesh, dp_axis)
+    ext = jax.tree.map(
+        lambda s, zd, p: _zero1_extend_spec(s, zd, dp_axis, p.ndim),
+        specs, zdims, example_params,
+        is_leaf=lambda x: isinstance(x, P))
+    return zdims, state_specs_for(optimizer, ext, example_params)
+
+
+def _effective_clip(opt):
+    """(clip, owner): walk wrapper optimizers' `_inner` chain so a clip
+    configured on the wrapped optimizer (LocalSGD(AdamW(grad_clip=...)))
+    is seen — wrappers forward apply() to the inner, whose clip would
+    otherwise silently compute rank-local norms under shard_map."""
+    seen = set()
+    o = opt
+    while o is not None and id(o) not in seen:
+        seen.add(id(o))
+        c = getattr(o, "_grad_clip", None)
+        if c is not None:
+            return c, o
+        o = getattr(o, "_inner", None)
+    return None, None
+
+
+def _spec_axes(spec: P) -> set:
+    s = set()
+    for e in spec:
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            s.add(a)
+    return s
+
+
+def _global_clip_scale(red, leaves_spec, leaves_z, mesh: Mesh, dp_axis,
+                       clip):
+    """TRUE global-norm clip coefficient inside shard_map: each leaf's
+    local sum-of-squares is divided by its replication factor (product of
+    mesh axes it is NOT sharded over), then one psum over ALL mesh axes
+    re-multiplies exactly once per distinct element. This is the
+    reference's HybridParallelClipGrad discipline
+    (hybrid_parallel_optimizer.py:41 — partial norms combined across
+    mp/pp/sharding before one shared coefficient); a naive
+    ClipGradByGlobalNorm under shard_map would clip each model-parallel
+    rank with a DIFFERENT partial norm."""
+    from ..nn.clip import sum_squares
+
+    all_axes = tuple(mesh.axis_names)
+    n2 = jnp.zeros((), jnp.float32)
+    for g, sp, zd in zip(red, leaves_spec, leaves_z):
+        if g is None:
+            continue
+        sharded = _spec_axes(sp)
+        if zd is not None and zd >= 0:
+            sharded = sharded | {dp_axis}
+        repl = 1
+        for a in all_axes:
+            if a not in sharded:
+                repl *= mesh.shape[a]
+        n2 = n2 + sum_squares([g]) / repl
+    n2 = lax.psum(n2, all_axes)
+    return clip.scale_from_norm(jnp.sqrt(n2))
+
+
 def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
-                     grad_reduce_dtype="auto"):
+                     grad_reduce_dtype="auto", zero1_dp: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -79,12 +185,40 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     flow `strategy.fp16_allreduce = True; fleet.init(strategy=s)` engages
     with no extra plumbing; pass None to force fp32 reduction. Optimizers
     that manage their own synchronization (LocalSGD/DGC — attribute
-    `_skips_grad_sync`) receive dp-UNreduced local gradients."""
+    `_skips_grad_sync`) receive dp-UNreduced local gradients.
+
+    zero1_dp=True: ZeRO stage-1 composed with the hybrid mesh — optimizer
+    state shards over the dp axis (on top of its pp/mp shardings), grads
+    reduce-scatter instead of all-reduce, each dp rank updates only its
+    param shard and the new params all-gather back. Same bytes on the wire
+    as allreduce (RS + AG), 1/dp the optimizer-state HBM and update flops.
+    Reference: DygraphShardingOptimizer (stage 1) under
+    HybridParallelOptimizer. Requires the per-leaf optimizer protocol
+    (AdamW-family; name filters ride the ctx protocol) and supports
+    ClipGradByGlobalNorm/ByValue."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
     data_spec = P(dp_axis) if data_spec is None else data_spec
-    sspec = state_specs_for(optimizer, specs, example_params)
+    zdims = None
+    if zero1_dp:
+        from ..distributed.sharding.group_sharded import _leaf_streamable
+        from ..enforce import enforce
+        enforce(example_params is not None,
+                "zero1_dp needs example_params (leaf shapes pick the dp "
+                "shard dims)", op="build_train_step")
+        enforce(_leaf_streamable(optimizer),
+                "zero1_dp re-runs the update per leaf shard; the optimizer "
+                "must follow the per-leaf _init_slot/_update protocol "
+                f"(AdamW-family). Got {type(optimizer).__name__}",
+                op="build_train_step")
+        enforce(not getattr(optimizer, "_skips_grad_sync", False),
+                "LocalSGD/DGC own the dp axis — incompatible with zero1_dp",
+                op="build_train_step")
+        zdims, sspec = zero1_state_specs(optimizer, specs, example_params,
+                                         mesh, dp_axis)
+    else:
+        sspec = state_specs_for(optimizer, specs, example_params)
 
     def shard_params(params):
         return jax.tree.map(
@@ -92,12 +226,90 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             params, specs)
 
     def init_state(params):
-        # zeros_like under jit preserves input shardings
-        return jax.jit(optimizer.init_state)(params)
+        # zeros_like under jit preserves input shardings; zero1 pins the
+        # state to its dp-sharded specs instead (1/dp per-chip moments)
+        return jax.jit(
+            optimizer.init_state,
+            out_shardings=jax.tree.map(
+                lambda s: NamedSharding(mesh, s), sspec))(params)
+
+    def _zero1_apply(params, grads, opt_state, lr):
+        """Per-leaf ZeRO-1 update inside shard_map: reduce-scatter the
+        leaf's grad over dp, update only this rank's param/state shard,
+        all-gather the new params. Leaves with no dp-shardable dim stay
+        replicated (pmean + full update). The per-leaf name/ctx/rng
+        protocol comes from Optimizer._leaf_items (one implementation
+        across every per-leaf loop)."""
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+
+        dp = mesh.shape[dp_axis]
+        idx = lax.axis_index(dp_axis)
+        step_no = opt_state["step"] + 1
+        treedef, items = optimizer._leaf_items(
+            params, grads, opt_state["slots"], step_no)
+        leaves_z = treedef.flatten_up_to(zdims)
+        leaves_spec = treedef.flatten_up_to(specs)
+
+        # pass 1: reduce grads (scatter where dp-sharded)
+        red = []
+        clip = optimizer._grad_clip
+        for (p, g, s, ctx, rng), zd in zip(items, leaves_z):
+            if g is None:
+                red.append(None)
+                continue
+            if extra_grad_axes:
+                g = lax.pmean(g, tuple(extra_grad_axes))
+            gr = g.astype(grad_reduce_dtype) \
+                if grad_reduce_dtype is not None else g
+            if zd < 0:
+                gm = lax.pmean(gr, dp_axis).astype(g.dtype)
+            else:
+                gm = (lax.psum_scatter(gr, dp_axis, scatter_dimension=zd,
+                                       tiled=True) / dp).astype(g.dtype)
+            red.append(gm)
+
+        scale = None
+        if isinstance(clip, ClipGradByGlobalNorm):
+            scale = _global_clip_scale(red, leaves_spec, leaves_z, mesh,
+                                       dp_axis, clip)
+        elif clip is not None and not isinstance(clip, ClipGradByValue):
+            raise NotImplementedError(
+                f"zero1_dp supports global-norm/by-value clip, got "
+                f"{type(clip).__name__}")
+
+        # pass 2: per-leaf update on this rank's shard, gather params back
+        new_p, new_s = [], []
+        for (p, g_unused, s, ctx, rng), g, zd in zip(items, red, leaves_z):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            if isinstance(clip, ClipGradByValue):
+                g = jnp.clip(g, clip.min, clip.max).astype(g.dtype)
+            if scale is not None:
+                g = (g * scale).astype(g.dtype)
+            if zd < 0:
+                np_, ns_ = optimizer._update_ctx(ctx, p, g, s, lr,
+                                                 step_no, rng=rng)
+            else:
+                shard = p.shape[zd] // dp
+                p_sh = lax.dynamic_slice_in_dim(p, idx * shard, shard, zd)
+                np_sh, ns_ = optimizer._update_ctx(ctx, p_sh, g, s, lr,
+                                                   step_no, rng=rng)
+                np_ = lax.all_gather(np_sh, dp_axis, axis=zd, tiled=True)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree.unflatten(treedef, new_p),
+                {"step": step_no,
+                 "slots": jax.tree.unflatten(treedef, new_s)})
 
     def local_step(params, opt_state, tokens, labels, lr):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, tokens, labels))(params)
+        if zero1_dp:
+            new_params, new_state = _zero1_apply(params, grads, opt_state,
+                                                 lr)
+            return new_params, new_state, loss
         # dp gradient reduction (the EagerReducer equivalent — one pmean,
         # fused and overlapped by XLA). Self-synchronizing optimizers
         # (LocalSGD/DGC: _skips_grad_sync) own the dp axis but NOT the
@@ -123,6 +335,57 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 return g
 
             grads = jax.tree.map(reduce_one, grads)
+        # Norm-based clips under shard_map must see norms of WHOLE
+        # tensors: the optimizer's own _grad_clip would compute each
+        # mp/pp rank's norm from its local shard and scale shards of the
+        # same tensor by DIFFERENT factors. Global-norm clip gets the
+        # axes-aware coefficient here; per-tensor ClipGradByNorm has no
+        # cheap sharded form and is refused when model axes exist.
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm
+        clip, clip_owner = _effective_clip(optimizer)
+        model_axes = any(mesh.shape[a] > 1 for a in mesh.axis_names
+                         if a != dp_axis and a not in extra_axes)
+        if isinstance(clip, ClipGradByNorm) and model_axes:
+            raise NotImplementedError(
+                "ClipGradByNorm computes PER-TENSOR norms; under mp/pp "
+                "sharding each rank would clip its shard with a different "
+                "coefficient. Use ClipGradByGlobalNorm (axes-aware here) "
+                "or clip-by-value.")
+        if isinstance(clip, ClipGradByGlobalNorm):
+            if skips_dp:
+                raise NotImplementedError(
+                    "LocalSGD/DGC run on local (unreduced) gradients; a "
+                    "global-norm clip across their dp-desynced grads is "
+                    "ill-defined. Clip inside the inner optimizer on a "
+                    "1-model-axis mesh, or drop the clip.")
+            treedef = jax.tree.structure(params)
+            leaves_g = treedef.flatten_up_to(grads)
+            leaves_spec = treedef.flatten_up_to(specs)
+            scale = _global_clip_scale(leaves_g, leaves_spec,
+                                       [-1] * len(leaves_g), mesh,
+                                       dp_axis, clip)
+            grads = jax.tree.map(
+                lambda g: (g * scale).astype(g.dtype), grads)
+            from ..distributed.sharding.group_sharded import \
+                _leaf_streamable
+            if _leaf_streamable(optimizer):
+                # clean bypass: the per-leaf protocol never applies
+                # _grad_clip (clip lives in apply()), so run it directly
+                step_no = opt_state["step"] + 1
+                new_p, new_slots = optimizer._apply_leaves(
+                    params, grads, opt_state["slots"], lr, step_no)
+                return new_p, {"step": step_no, "slots": new_slots}, loss
+            # wrapper optimizers (GradientMerge etc): bypass by clearing
+            # the owner's clip across this trace. Trace-time-only window;
+            # single-threaded tracing makes this safe, restored in finally.
+            prev_clip = clip_owner._grad_clip
+            clip_owner._grad_clip = None
+            try:
+                new_params, new_state = optimizer.apply(
+                    params, grads, opt_state, lr)
+            finally:
+                clip_owner._grad_clip = prev_clip
+            return new_params, new_state, loss
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
         return new_params, new_state, loss
 

@@ -466,7 +466,8 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
 def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
-                            virtual_pp: int = 1, grad_reduce_dtype="auto"):
+                            virtual_pp: int = 1, grad_reduce_dtype="auto",
+                            zero1_dp: bool = False):
     from .hybrid_engine import build_train_step
 
     def loss_fn(p, tokens, labels):
@@ -479,7 +480,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
-        grad_reduce_dtype=grad_reduce_dtype)
+        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

@@ -136,11 +136,14 @@ class Optimizer:
         slots = _tree_map(lambda p: self._init_slot(p), params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
 
-    def _apply_leaves(self, params, grads, slots, lr, step, offset=None):
-        """Per-leaf update loop shared by apply() and the param-streaming
-        tier (distributed/sharding/param_stream.py). `offset`: traced base
-        leaf index decorrelating the stochastic-rounding rng streams when
-        the loop is split across multiple jitted programs."""
+    def _leaf_items(self, params, grads, slots, step, offset=None):
+        """ONE implementation of the per-leaf iteration protocol shared by
+        every per-leaf update loop (_apply_leaves, the group_sharded
+        offload loop, the hybrid engine's ZeRO-1 loop): flatten with
+        paths, derive names → ctx, build the per-leaf stochastic-rounding
+        keys. Returns (treedef, items) with items =
+        [(p, g_or_None, slot, ctx, rng_or_None), ...]; `offset` rebases
+        the rng stream when the loop is split across programs."""
         paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
         leaves_p = [leaf for _, leaf in paths_p]
         names = [_path_name(path) for path, _ in paths_p]
@@ -153,20 +156,28 @@ class Optimizer:
             # rbg = XLA's hardware RngBitGenerator — ~free on TPU, where
             # threefry on billions of moment elements costs ~5% step time
             rng_base = jax.random.key(step.astype(jnp.uint32), impl="rbg")
-        new_p, new_s = [], []
+        items = []
         for i, (p, g, s) in enumerate(zip(leaves_p, leaves_g, leaves_s)):
+            rng = None
+            if rng_base is not None and g is not None:
+                idx = i if offset is None else offset + i
+                rng = jax.random.fold_in(rng_base, idx)
+            ctx = self._leaf_ctx(names[i]) if g is not None else None
+            items.append((p, g, s, ctx, rng))
+        return treedef, items
+
+    def _apply_leaves(self, params, grads, slots, lr, step, offset=None):
+        """Per-leaf update loop shared by apply() and the param-streaming
+        tier (distributed/sharding/param_stream.py)."""
+        treedef, items = self._leaf_items(params, grads, slots, step,
+                                          offset=offset)
+        new_p, new_s = [], []
+        for p, g, s, ctx, rng in items:
             if g is None:
                 new_p.append(p)
                 new_s.append(s)
                 continue
-            ctx = self._leaf_ctx(names[i])
-            if rng_base is not None:
-                idx = i if offset is None else offset + i
-                np_, ns_ = self._update_ctx(
-                    ctx, p, g, s, lr, step,
-                    rng=jax.random.fold_in(rng_base, idx))
-            else:
-                np_, ns_ = self._update_ctx(ctx, p, g, s, lr, step)
+            np_, ns_ = self._update_ctx(ctx, p, g, s, lr, step, rng=rng)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
