@@ -342,7 +342,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         # axes-aware coefficient here; per-tensor ClipGradByNorm has no
         # cheap sharded form and is refused when model axes exist.
         from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm
-        clip, clip_owner = _effective_clip(optimizer)
+        clip, _ = _effective_clip(optimizer)
         model_axes = any(mesh.shape[a] > 1 for a in mesh.axis_names
                          if a != dp_axis and a not in extra_axes)
         if isinstance(clip, ClipGradByNorm) and model_axes:
@@ -351,13 +351,31 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 "sharding each rank would clip its shard with a different "
                 "coefficient. Use ClipGradByGlobalNorm (axes-aware here) "
                 "or clip-by-value.")
-        if isinstance(clip, ClipGradByGlobalNorm):
+        if isinstance(clip, ClipGradByGlobalNorm) and model_axes:
+            # (on a dp-only mesh the local grads ARE the full tensors, so
+            # the optimizer's own clip is already globally correct — no
+            # interception, exact legacy semantics incl. GradientMerge's
+            # clip-on-the-MERGED-grad timing)
             if skips_dp:
                 raise NotImplementedError(
                     "LocalSGD/DGC run on local (unreduced) gradients; a "
                     "global-norm clip across their dp-desynced grads is "
                     "ill-defined. Clip inside the inner optimizer on a "
                     "1-model-axis mesh, or drop the clip.")
+            from ..distributed.sharding.group_sharded import \
+                _leaf_streamable
+            if not _leaf_streamable(optimizer):
+                # GradientMerge-style wrappers clip the MERGED gradient
+                # inside their own apply — pre-scaling per micro-step here
+                # would change that semantic, and their internal clip
+                # would compute rank-local norms. Refuse rather than
+                # silently do either wrong thing.
+                raise NotImplementedError(
+                    f"{type(optimizer).__name__} applies global-norm clip "
+                    "inside its own accumulation schedule; on a "
+                    "model-parallel mesh that clip would be rank-local. "
+                    "Use zero1_dp/plain AdamW-family clip, or merge on a "
+                    "dp-only mesh.")
             treedef = jax.tree.structure(params)
             leaves_g = treedef.flatten_up_to(grads)
             leaves_spec = treedef.flatten_up_to(specs)
@@ -366,26 +384,15 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                        dp_axis, clip)
             grads = jax.tree.map(
                 lambda g: (g * scale).astype(g.dtype), grads)
-            from ..distributed.sharding.group_sharded import \
-                _leaf_streamable
-            if _leaf_streamable(optimizer):
-                # clean bypass: the per-leaf protocol never applies
-                # _grad_clip (clip lives in apply()), so run it directly
-                step_no = opt_state["step"] + 1
-                new_p, new_slots = optimizer._apply_leaves(
-                    params, grads, opt_state["slots"], lr, step_no)
-                return new_p, {"step": step_no, "slots": new_slots}, loss
-            # wrapper optimizers (GradientMerge etc): bypass by clearing
-            # the owner's clip across this trace. Trace-time-only window;
-            # single-threaded tracing makes this safe, restored in finally.
-            prev_clip = clip_owner._grad_clip
-            clip_owner._grad_clip = None
-            try:
-                new_params, new_state = optimizer.apply(
-                    params, grads, opt_state, lr)
-            finally:
-                clip_owner._grad_clip = prev_clip
-            return new_params, new_state, loss
+            # per-leaf protocol never applies _grad_clip (clip lives in
+            # apply()), so run it directly. NOTE: this also routes
+            # use_multi_tensor=True through the per-leaf loop — fused
+            # multi-tensor Adam ships default-off (measured slower on
+            # TPU), so clip+mp/pp configs simply get the default path.
+            step_no = opt_state["step"] + 1
+            new_p, new_slots = optimizer._apply_leaves(
+                params, grads, opt_state["slots"], lr, step_no)
+            return new_p, {"step": step_no, "slots": new_slots}, loss
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
         return new_params, new_state, loss
 
