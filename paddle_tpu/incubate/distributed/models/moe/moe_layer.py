@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .....enforce import enforce, enforce_in
+from .....enforce import InvalidArgumentError, enforce, enforce_in
 from .....nn.functional.activation import gelu
 from .....nn.initializer import Constant, XavierNormal
 from .....nn.layer.layers import Layer
@@ -70,6 +70,38 @@ class ExpertFFN(Layer):
         h = jnp.einsum("ecd,edf->ecf", dispatched, w1) + b1[:, None, :]
         h = self.activation(h)
         return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+
+def _index_scatter(xt, slots, num_experts: int, capacity: int):
+    """Slot-id dispatch: scatter tokens into the [E, C, D] expert batch
+    (dropped tokens land on a dummy row that is trimmed). Returns
+    (dispatched [E, C, D], slot_safe [T, K]) — slot_safe is reused by
+    _index_combine. The zero-flop analogue of the reference's CUDA
+    global_scatter, vs the 2·T·E·C·D-flop dense einsum."""
+    dtype = xt.dtype
+    d_model = xt.shape[-1]
+    flat = num_experts * capacity
+    slot_safe = jnp.where(slots >= 0, slots, flat)
+    # dropped tokens scatter into the dummy row that [:flat] trims — no
+    # mask multiply needed (the trimmed row's cotangent is zero too)
+    contrib = jnp.broadcast_to(xt[:, None, :],
+                               (*slots.shape, d_model))  # [T, K, D]
+    dispatched = jnp.zeros((flat + 1, d_model), dtype) \
+        .at[slot_safe.reshape(-1)].add(contrib.reshape(-1, d_model))
+    return dispatched[:flat].reshape(num_experts, capacity, d_model), \
+        slot_safe
+
+
+def _index_combine(out_e, gates, slot_safe):
+    """Gather each token's expert outputs back by slot id and mix with
+    the gate weights (zeroed for dropped tokens)."""
+    flat = out_e.shape[0] * out_e.shape[1]
+    d_model = out_e.shape[-1]
+    out_flat = jnp.concatenate(
+        [out_e.reshape(flat, d_model),
+         jnp.zeros((1, d_model), out_e.dtype)])
+    return (gates.astype(out_e.dtype)[..., None]
+            * out_flat[slot_safe]).sum(axis=1)
 
 
 def _ep_info(moe_group=None, ep_axis: Optional[str] = None):
@@ -137,6 +169,17 @@ class MoELayer(Layer):
                 p.value = jax.device_put(
                     p.value, NamedSharding(self.mesh, spec))
 
+    @property
+    def _gate_has_index(self) -> bool:
+        """Gates written against the pre-round-5 contract override
+        forward() only — they can't produce slot ids, so "auto" falls
+        back to the dense path for them instead of crashing in
+        forward_index. ONE copy of the capability check for both entry
+        points."""
+        return (type(self.gate)._route is not BaseGate._route
+                or type(self.gate).forward_index
+                is not BaseGate.forward_index)
+
     # -- auto / GSPMD path --------------------------------------------------
     def forward(self, x, return_aux: bool = False):
         """With return_aux=True returns (y, aux_loss) — REQUIRED under jit:
@@ -154,12 +197,7 @@ class MoELayer(Layer):
         orig_shape = x.shape
         xt = x.reshape(-1, self.d_model)
         dtype = xt.dtype
-        # gates written against the pre-round-5 contract override forward()
-        # only — they can't produce slot ids, so "auto" falls back to the
-        # dense path for them instead of crashing in forward_index
-        gate_has_index = (
-            type(self.gate)._route is not BaseGate._route
-            or type(self.gate).forward_index is not BaseGate.forward_index)
+        gate_has_index = self._gate_has_index
         if self.dispatch_mode == "index":
             enforce(self.ep_world == 1,
                     "dispatch_mode='index' builds a flat local scatter — it "
@@ -178,23 +216,11 @@ class MoELayer(Layer):
             slots, gates, aux = self.gate.forward_index(xt)  # [T,K] each
             if not isinstance(aux, jax.core.Tracer):
                 self.aux_loss = aux
-            E = self.num_experts
-            C = self.gate.capacity(xt.shape[0])
-            flat = E * C
-            kept = (slots >= 0)
-            slot_safe = jnp.where(kept, slots, flat)  # dropped -> dummy row
-            contrib = (xt[:, None, :]
-                       * kept[..., None].astype(dtype))  # [T, K, D]
-            dispatched = jnp.zeros((flat + 1, self.d_model), dtype) \
-                .at[slot_safe.reshape(-1)] \
-                .add(contrib.reshape(-1, self.d_model))
-            out_e = self.experts(dispatched[:flat].reshape(
-                E, C, self.d_model))
-            out_flat = jnp.concatenate(
-                [out_e.reshape(flat, self.d_model),
-                 jnp.zeros((1, self.d_model), out_e.dtype)])
-            y = (gates.astype(dtype)[..., None]
-                 * out_flat[slot_safe]).sum(axis=1)
+            dispatched, slot_safe = _index_scatter(
+                xt, slots, self.num_experts,
+                self.gate.capacity(xt.shape[0]))
+            out_e = self.experts(dispatched)
+            y = _index_combine(out_e, gates, slot_safe)
             return ((y.reshape(orig_shape), aux) if return_aux
                     else y.reshape(orig_shape))
         combine, dispatch, aux = self.gate(xt)
@@ -223,9 +249,26 @@ class MoELayer(Layer):
         """Per-rank body for shard_map over the ep axis. x is the LOCAL
         token shard [T_local, D]; w* are the LOCAL expert shards
         [E_local, ...]. Communication is two explicit all-to-alls
-        (global_scatter/global_gather), the reference's dispatch exactly."""
-        combine, dispatch, aux = self.gate(x)
+        (global_scatter/global_gather), the reference's dispatch exactly.
+        The LOCAL routing uses the index (gather/scatter) form when the
+        gate supports it — the exchange sees the same [E, C, D] layout
+        either way, so only the local flops change."""
         dtype = x.dtype
+        if self.dispatch_mode == "index" and not self._gate_has_index:
+            raise InvalidArgumentError(
+                f"{type(self.gate).__name__} implements neither _route() "
+                "nor forward_index(); index dispatch needs one of them "
+                "(see BaseGate._route).", op="MoELayer")
+        if self._gate_has_index and self.dispatch_mode != "einsum":
+            slots, gates, aux = self.gate.forward_index(x)
+            dispatched, slot_safe = _index_scatter(
+                x, slots, self.num_experts, self.gate.capacity(x.shape[0]))
+            arrived = global_scatter(dispatched, self.ep_axis)
+            out_local = self.experts.apply(arrived, w1, b1, w2, b2)
+            returned = global_gather(out_local, self.ep_axis)
+            y = _index_combine(returned, gates, slot_safe)
+            return (y, aux) if return_aux else y
+        combine, dispatch, aux = self.gate(x)
         dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), x)
         arrived = global_scatter(dispatched, self.ep_axis)
         out_local = self.experts.apply(arrived, w1, b1, w2, b2)
