@@ -89,7 +89,8 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
 
 
 def run_training(mesh, steps: int = 4, return_params: bool = False,
-                 num_microbatches: int = 1, schedule: str = "1F1B"):
+                 num_microbatches: int = 1, schedule: str = "1F1B",
+                 zero1: bool = False):
     """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
     pp / mp); every process computes identical host inputs. The ONE copy of
     the parity workload — the launcher golden, the spawned workers and the
@@ -103,10 +104,14 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
     cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
                       num_heads=4, max_seq_len=16, dtype=jnp.float32)
     params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
-    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    # zero1 mode also carries the axes-aware global-norm clip so the
+    # cross-process parity covers the whole round-5 stage-1 path
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2,
+        grad_clip=(paddle.nn.ClipGradByGlobalNorm(0.5) if zero1 else None))
     step, shard_params, init_state = G.build_hybrid_train_step(
         cfg, mesh, opt, num_microbatches=num_microbatches,
-        schedule=schedule)
+        schedule=schedule, zero1_dp=zero1)
     params = shard_params(params)
     state = init_state(params)
     rng = np.random.RandomState(0)
@@ -120,7 +125,7 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
     return (losses, params) if return_params else losses
 
 
-# mode -> (mesh dims builder, microbatches, schedule). "dpmp" is the hybrid
+# "dpmp" is the hybrid
 # dp-across-processes layout; the pp modes put the PIPELINE axis on the
 # process boundary — each stage lives on its own process and the 1F1B/ZBH1
 # ppermute hops cross it, the reference's dominant multi-node integration
@@ -129,11 +134,18 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
 # the ring's neighbor hops at the process edges are cross-process ppermutes
 # (2 of n hops with the contiguous hybrid layout; the long-context DCN
 # path at this box's fidelity).
+# mode -> (mesh dims builder, microbatches, schedule, zero1_dp)
 _MODES = {
-    "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B"),
-    "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B"),
-    "ppzbh1": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "ZBH1"),
-    "sepring": (lambda n: {"sep": n}, 1, "1F1B"),
+    "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B", False),
+    # zero1 stage-1 over the dp axis that SPANS the two processes: the
+    # grad reduce-scatter and param all-gather hops cross the boundary
+    "z1dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B",
+               True),
+    "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B",
+               False),
+    "ppzbh1": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "ZBH1",
+               False),
+    "sepring": (lambda n: {"sep": n}, 1, "1F1B", False),
 }
 
 
@@ -177,7 +189,7 @@ def main():
     import jax
 
     mode = os.environ.get("MPSMOKE_MODE", "dpmp")
-    dims_of, M, schedule = _MODES[mode]
+    dims_of, M, schedule, zero1 = _MODES[mode]
     n = len(jax.devices())
     mesh = build_mesh(dims_of(n))
     if mode == "sepring":
@@ -195,7 +207,7 @@ def main():
     ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
     dev = np.moveaxis(mesh.devices,
                       (ax["dp"], ax["pp"], ax["mp"]), (0, 1, 2))
-    if mode == "dpmp":
+    if mode in ("dpmp", "z1dpmp"):
         # hybrid-layout invariant: mp intra-process, dp across processes
         assert len({d.process_index for d in dev[0, 0, :]}) == 1
         assert dev[0, 0, 0].process_index != dev[1, 0, 0].process_index
@@ -205,7 +217,8 @@ def main():
         for s in range(2):
             assert len({d.process_index for d in dev[0, s, :]}) == 1, mode
         assert dev[0, 0, 0].process_index != dev[0, 1, 0].process_index
-    losses = run_training(mesh, num_microbatches=M, schedule=schedule)
+    losses = run_training(mesh, num_microbatches=M, schedule=schedule,
+                          zero1=zero1)
     print("MPSMOKE " + json.dumps(
         {"rank": jax.process_index(), "mode": mode, "losses": losses}),
         flush=True)
@@ -232,11 +245,12 @@ def golden_for(n_devices: int, mode: str = "dpmp", devices=None):
     """Single-process golden loss curve for a spawn mode (same mesh dims,
     same schedule, one process)."""
     from .topology import build_mesh
-    dims_of, M, schedule = _MODES[mode]
+    dims_of, M, schedule, zero1 = _MODES[mode]
     mesh = build_mesh(dims_of(n_devices), devices=devices)
     if mode == "sepring":
         return run_ring(mesh)
-    return run_training(mesh, num_microbatches=M, schedule=schedule)
+    return run_training(mesh, num_microbatches=M, schedule=schedule,
+                        zero1=zero1)
 
 
 if __name__ == "__main__":

@@ -99,10 +99,12 @@ _SLOW_TESTS = {
     "test_streamed_matches_dense_training",
     "test_streamed_llama_matches_dense_training",
     "test_ptq_calibrated_gpt_matches_fp",
-    # round 5: the heaviest new parity run moves to the slow tier — the
-    # two-pass streamed-clip parity (~45 s/param, 2 params); gating stays
-    # fast via test_streamed_rejects_grad_clip_and_custom_apply
+    # round 5: the heaviest new parity runs move to the slow tier — the
+    # two-pass streamed-clip parity (~45 s/param, 2 params; gating stays
+    # fast via test_streamed_rejects_grad_clip_and_custom_apply) and the
+    # 2-process zero1 spawn (same class as the other spawn parities here)
     "test_streamed_clip_matches_dense_clip",
+    "test_two_process_zero1_parity",
 }
 
 
