@@ -5,11 +5,12 @@ from .auto_cast import (
     is_auto_cast_enabled, get_amp_dtype, white_cast, black_cast, promote_cast,
     WHITE_LIST, BLACK_LIST,
 )
-from .grad_scaler import GradScaler
+from .grad_scaler import GradScaler, nonfinite_report
 from . import debugging
 
 __all__ = [
     "auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+    "nonfinite_report",
     "is_auto_cast_enabled", "get_amp_dtype", "debugging",
     "white_cast", "black_cast", "promote_cast",
 ]

@@ -22,9 +22,38 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GradScaler", "OptimizerState"]
+__all__ = ["GradScaler", "OptimizerState", "nonfinite_report"]
 
 OptimizerState = Dict[str, Any]
+
+
+def nonfinite_report(tree, max_leaves: int = 16) -> str:
+    """Per-leaf non-finite diagnostic: one line per floating leaf that
+    contains nan/inf, with its key path, shape and counts. The loop-level
+    extension of the scaler's found_inf bit — when a run aborts after too
+    many skipped steps, this names WHICH tensors went bad instead of a bare
+    'loss is nan' (consumed by distributed.resilience run_resilient)."""
+    import numpy as np
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    lines = []
+    for path, leaf in flat:
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        if n_nan or n_inf:
+            lines.append(f"  {jax.tree_util.keystr(path)}: shape="
+                         f"{tuple(arr.shape)} nan={n_nan} inf={n_inf}")
+    if not lines:
+        return "  (all leaves finite)"
+    shown = lines[:max_leaves]
+    if len(lines) > max_leaves:
+        shown.append(f"  ... and {len(lines) - max_leaves} more leaves")
+    return "\n".join(shown)
 
 
 def _tree_finite(tree) -> jax.Array:

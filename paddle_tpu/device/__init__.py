@@ -189,7 +189,9 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
     try:  # no-op if the backend is already initialized
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", n)
-    except RuntimeError:
+    except (RuntimeError, AttributeError):
+        # AttributeError: older jax without jax_num_cpu_devices — the
+        # XLA_FLAGS path above already forces the virtual device count
         pass
 
 from . import streams  # noqa: F401

@@ -28,6 +28,9 @@ from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .watchdog import CommWatchdog, get_watchdog
 from .checkpoint import load_state_dict, save_state_dict
+from . import resilience  # noqa: F401
+from .resilience import (FaultInjected, commit_checkpoint, latest_checkpoint,
+                         run_resilient)
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model
@@ -60,6 +63,9 @@ __all__ = [
     "sharding", "group_sharded_parallel", "save_group_sharded_model",
     # checkpoint
     "checkpoint", "save_state_dict", "load_state_dict",
+    # resilience
+    "resilience", "FaultInjected", "commit_checkpoint", "latest_checkpoint",
+    "run_resilient",
     "TCPStore", "MasterStore", "rpc", "passes", "CommWatchdog", "get_watchdog",
     "check", "CommCheckError", "nan_guard",
     "fleet_executor", "FleetExecutor", "TaskNode", "ps",

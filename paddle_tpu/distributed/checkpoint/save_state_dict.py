@@ -23,7 +23,8 @@ import jax
 import numpy as np
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
-from .utils import chunk_name, flatten_state_dict, shard_chunks, to_host
+from .utils import (atomic_write, chunk_name, flatten_state_dict,
+                    shard_chunks, to_host)
 
 __all__ = ["save_state_dict", "wait_async_save"]
 
@@ -53,16 +54,21 @@ _ASYNC_ERRORS: List[BaseException] = []
 
 
 def wait_async_save() -> None:
-    """Block until all in-flight async checkpoint writes complete. Re-raises
-    the first writer failure — a silently missing checkpoint must not look
-    like success."""
+    """Block until all in-flight async checkpoint writes complete.
+    Re-raises writer failures — a silently missing checkpoint must not look
+    like success — aggregating EVERY writer's error into the message, so a
+    multi-writer crash isn't narrowed to whichever thread died first."""
     while _PENDING:
         t = _PENDING.pop()
         t.join()
     if _ASYNC_ERRORS:
-        err = _ASYNC_ERRORS[0]
+        errs = list(_ASYNC_ERRORS)
         _ASYNC_ERRORS.clear()
-        raise RuntimeError("async checkpoint save failed") from err
+        detail = "; ".join(f"[writer {i}] {type(e).__name__}: {e}"
+                           for i, e in enumerate(errs))
+        raise RuntimeError(
+            f"async checkpoint save failed: {len(errs)} writer(s) raised: "
+            f"{detail}") from errs[0]
 
 
 atexit.register(wait_async_save)
@@ -182,6 +188,7 @@ def save_state_dict(state_dict: Dict, path: str,
         local_meta[key] = entries
 
     def _write_metadata(all_meta):
+        from ..resilience import faults
         md = Metadata(flat_mapping=mapping, misc=misc)
         for rank_meta in all_meta:
             for key, entries in rank_meta.items():
@@ -191,13 +198,19 @@ def save_state_dict(state_dict: Dict, path: str,
                                                    tuple(shape), dtype))
                     md.storage_metadata[
                         LocalTensorIndex(key, tuple(offset))] = fname
-        with open(os.path.join(path, "0.metadata"), "wb") as f:
+        faults.maybe_fail("ckpt/before_metadata_write")
+        # temp-file + os.replace: a crash mid-dump can never leave a
+        # truncated pickle at 0.metadata (load_metadata would otherwise
+        # surface it as an opaque UnpicklingError)
+        with atomic_write(os.path.join(path, "0.metadata")) as f:
             pickle.dump(md, f)
 
     def write_files(chunks=chunks, local_meta=local_meta, misc=misc,
                     meta_store=None, tag=None):
-        with open(os.path.join(path, data_file), "wb") as f:
+        from ..resilience import faults
+        with atomic_write(os.path.join(path, data_file)) as f:
             np.savez(f, **chunks)  # file handle keeps our .distcp name
+        faults.maybe_fail("ckpt/after_chunk_write")
         if meta_store is not None:
             _store_gather_commit(meta_store, tag, proc, jax.process_count(),
                                  coordinator_rank, local_meta,

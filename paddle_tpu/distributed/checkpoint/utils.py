@@ -5,6 +5,9 @@ flatten_state_dict / compute_local_shape_and_global_offset).
 
 from __future__ import annotations
 
+import contextlib
+import itertools
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -13,7 +16,42 @@ import numpy as np
 __all__ = [
     "flatten_state_dict", "unflatten_state_dict", "chunk_overlap",
     "shard_chunks", "to_host", "chunk_name", "index_to_offset_shape",
+    "atomic_write",
 ]
+
+_WIP_SEQ = itertools.count()  # pid alone is not unique: two async writer
+#                               threads targeting the same path must not
+#                               share (and truncate) one temp file
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb"):
+    """Durable-or-absent file write: the ONLY way checkpoint code may open
+    a final-destination path for writing (tests/test_resilience.py greps
+    this package for violations). Bytes land in a same-directory temp file,
+    are fsynced, and os.replace()d into place with a directory fsync — a
+    crash at any instant leaves either the complete old bytes or the
+    complete new bytes at `path`, never a truncated file."""
+    tmp = f"{path}.wip-{os.getpid()}-{next(_WIP_SEQ)}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+    except BaseException:
+        f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    f.close()
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def chunk_name(key: str, offset) -> str:

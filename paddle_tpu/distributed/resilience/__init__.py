@@ -1,0 +1,52 @@
+"""Fault-tolerant training runtime (reference analogs:
+paddle/phi/core/distributed/comm_task_manager.cc watchdog escalation,
+python/paddle/distributed/checkpoint/save_state_dict.py async side-process
+saves, the elastic launcher's checkpoint-restart contract).
+
+Pieces:
+  faults   — deterministic, flag-gated fault injection (the tests' only
+             way to prove recovery paths run)
+  commit   — crash-safe two-phase checkpoint commit + latest_checkpoint
+  driver   — run_resilient: watchdogged, preemption-aware train loop
+  fit      — Model.fit(resilient=...) plumbing
+
+`faults` is imported eagerly (stdlib-only, safe at any import depth — the
+flags module binds FLAGS_fault_inject to it at startup); everything else
+loads via __getattr__ so that MID-BOOTSTRAP importers (store.py pulls
+`.resilience.faults` while distributed/__init__ is still half-executed)
+never drag commit/driver into a partially-initialized package.
+distributed/__init__ re-exports the commit/driver names eagerly at the END
+of its own init, when that is safe.
+"""
+
+from . import faults
+from .faults import FaultInjected, maybe_fail
+
+__all__ = [
+    "faults", "FaultInjected", "maybe_fail",
+    "commit_checkpoint", "latest_checkpoint", "checkpoint_step",
+    "is_committed", "COMMIT_MARKER",
+    "run_resilient", "SigtermGuard", "NonFiniteLossError", "WatchdogTimeout",
+    "FitResilience",
+]
+
+_LAZY = {
+    "commit_checkpoint": "commit", "latest_checkpoint": "commit",
+    "checkpoint_step": "commit", "is_committed": "commit",
+    "COMMIT_MARKER": "commit", "commit": None,
+    "run_resilient": "driver", "SigtermGuard": "driver",
+    "NonFiniteLossError": "driver", "WatchdogTimeout": "driver",
+    "driver": None,
+    "FitResilience": "fit", "fit": None,
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod_name = _LAZY[name] or name
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        if _LAZY[name] is None:
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

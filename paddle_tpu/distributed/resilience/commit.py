@@ -1,0 +1,213 @@
+"""Crash-safe two-phase checkpoint commit on top of save/load_state_dict.
+
+The sharded checkpoint writer (`checkpoint/save_state_dict.py`) makes each
+individual file durable-or-absent (atomic_write), but a multi-file
+checkpoint needs a directory-level commit point: a crash between the chunk
+writes and the metadata — or between metadata and "done" — must never leave
+a directory that `latest_checkpoint` could hand back to a resuming job.
+
+Protocol (reference analog: the side-process save + barrier in
+python/paddle/distributed/checkpoint/save_state_dict.py:291, hardened to
+the Orbax/TensorStore commit discipline):
+
+1. every process writes its files into a staging dir ``step_N.tmp/``;
+2. a barrier (TCP store, or jax's coordination service) confirms ALL
+   writers finished — no rank may observe a commit for data that another
+   rank has not finished writing;
+3. the coordinator fsyncs the staging tree, ``os.replace``-renames it to
+   ``step_N/``, and writes a ``COMMITTED`` marker file LAST (itself via
+   temp-file + rename + dir fsync);
+4. ``latest_checkpoint`` only ever returns marker-bearing directories,
+   garbage-collects uncommitted stragglers, and retention keeps the newest
+   ``FLAGS_ckpt_keep_n`` committed steps.
+
+A crash at ANY instant therefore yields one of: old committed set intact
+(steps 1-3 before marker), or new step committed (after marker) — never a
+half-checkpoint with a plausible-looking layout.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["commit_checkpoint", "latest_checkpoint", "checkpoint_step",
+           "is_committed", "COMMIT_MARKER"]
+
+COMMIT_MARKER = "COMMITTED"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+_COMMIT_SEQ = [0]  # per-process commit-attempt counter; equal across ranks
+#                    because every rank calls commit_checkpoint in lockstep
+#                    (same idiom as save_state_dict._SAVE_SEQ) — it keeps a
+#                    RETRIED commit of the same step from sailing through
+#                    the previous attempt's stale barrier keys
+
+
+def step_path(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def checkpoint_step(path: str) -> int:
+    """Parse the step number out of a committed checkpoint path."""
+    m = _STEP_RE.match(os.path.basename(os.path.normpath(path)))
+    if not m:
+        raise ValueError(f"not a checkpoint step dir: {path!r}")
+    return int(m.group(1))
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, COMMIT_MARKER))
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _barrier(store, nproc: int, coordinator_rank: int, name: str,
+             timeout: Optional[float] = None) -> None:
+    """All `nproc` processes arrive before any leaves. Store-based when a
+    TCP store is available (pure host-side — safe while devices compute);
+    otherwise jax's coordination service."""
+    if nproc <= 1:
+        return
+    if store is not None:
+        proc = jax.process_index()
+        store.set(f"{name}/r{proc}", b"1")
+        if proc == coordinator_rank:
+            for r in range(nproc):
+                store.wait([f"{name}/r{r}"], timeout)
+            store.set(f"{name}/go", b"1")
+        else:
+            store.wait([f"{name}/go"], timeout)
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def _prune(root: str, keep_n: int) -> None:
+    """Retention: delete all but the newest keep_n COMMITTED steps. The
+    marker is unlinked first so a crash mid-delete downgrades the victim to
+    an uncommitted straggler (GC'd later) instead of a corrupt 'committed'
+    directory."""
+    if keep_n <= 0:
+        return
+    committed = sorted(
+        (int(m.group(1)), os.path.join(root, name))
+        for name in os.listdir(root)
+        for m in [_STEP_RE.match(name)]
+        if m and is_committed(os.path.join(root, name)))
+    for _step, path in committed[:-keep_n]:
+        try:
+            os.unlink(os.path.join(path, COMMIT_MARKER))
+            shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass  # best-effort: retention must never fail a commit
+
+
+def commit_checkpoint(state_dict: Dict, root: str, step: int, *,
+                      store=None, coordinator_rank: int = 0,
+                      async_save: bool = False,
+                      keep_n: Optional[int] = None,
+                      barrier_timeout: Optional[float] = None) -> str:
+    """Atomically commit `state_dict` as checkpoint `step` under `root`.
+
+    Returns the final committed directory. Idempotent: recommitting an
+    already-committed step is a no-op (the resilient driver's preemption
+    path may race a cadence checkpoint at the same boundary). Synchronous
+    at the commit point even with async_save=True — the rename only happens
+    once every byte is on disk.
+    """
+    from ..checkpoint import save_state_dict, wait_async_save
+    from . import faults
+
+    if keep_n is None:
+        from ...flags import flag
+        keep_n = int(flag("ckpt_keep_n"))
+
+    final = step_path(root, step)
+    if is_committed(final):
+        return final
+    proc, nproc = jax.process_index(), jax.process_count()
+    os.makedirs(root, exist_ok=True)
+    staging = final + ".tmp"
+    _COMMIT_SEQ[0] += 1
+    tag = (f"resil/{os.environ.get('PADDLE_RESTART_COUNT', '0')}"
+           f"/{_COMMIT_SEQ[0]}/{root}/{step}")
+
+    if proc == coordinator_rank:
+        # stale leftovers from a previous crashed incarnation of this step
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+    _barrier(store, nproc, coordinator_rank, f"{tag}/clean", barrier_timeout)
+
+    save_state_dict(state_dict, staging, coordinator_rank=coordinator_rank,
+                    async_save=async_save, store=store)
+    if async_save:
+        wait_async_save()
+    faults.maybe_fail("ckpt/before_commit")
+    # phase 1 done: every writer's files are in staging
+    _barrier(store, nproc, coordinator_rank, f"{tag}/staged", barrier_timeout)
+
+    if proc == coordinator_rank:
+        # every file in staging is already durable (atomic_write fsyncs the
+        # file AND the staging dir entry on each write — re-fsyncing multi-GB
+        # chunk files here would double the commit window); only the rename
+        # itself still needs the parent dir fsynced
+        _fsync_dir(staging)
+        os.replace(staging, final)
+        _fsync_dir(root)
+        faults.maybe_fail("ckpt/after_rename")
+        # marker LAST: its presence is the single bit that makes the
+        # checkpoint discoverable
+        from ..checkpoint.utils import atomic_write
+        with atomic_write(os.path.join(final, COMMIT_MARKER)) as f:
+            f.write(b"1")
+        _prune(root, keep_n)
+    _barrier(store, nproc, coordinator_rank, f"{tag}/committed",
+             barrier_timeout)
+    return final
+
+
+def latest_checkpoint(root: str, *, gc: bool = True) -> Optional[str]:
+    """Newest COMMITTED checkpoint directory under `root`, or None.
+
+    With gc=True (the restart path — any in-flight writer is dead by
+    definition), uncommitted stragglers are deleted: ``*.tmp`` staging dirs
+    and ``step_*`` dirs missing the COMMITTED marker. Pass gc=False to
+    inspect a directory a live job may still be writing to.
+    """
+    if not os.path.isdir(root):
+        return None
+    committed = []
+    stragglers = []
+    for name in os.listdir(root):
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue
+        if name.endswith(".tmp"):
+            stragglers.append(path)
+            continue
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        if is_committed(path):
+            committed.append((int(m.group(1)), path))
+        else:
+            stragglers.append(path)
+    if gc and jax.process_index() == 0:
+        for path in stragglers:
+            shutil.rmtree(path, ignore_errors=True)
+    if not committed:
+        return None
+    return max(committed)[1]

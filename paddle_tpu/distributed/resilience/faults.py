@@ -1,0 +1,156 @@
+"""Deterministic, flag-gated fault injection.
+
+Recovery code that is never executed is broken code: every resilience path
+in this package (crash-safe commit, store retry, preemption drain) carries
+named injection points — ``faults.maybe_fail("ckpt/after_chunk_write")`` —
+that are inert unless ``FLAGS_fault_inject`` arms them. Tests use them to
+prove the recovery paths actually run (reference analog: the chaos hooks
+the reference exercises via test/legacy_test/test_dist_base.py subprocess
+kills; here the kill point is addressable and deterministic).
+
+Spec grammar (``FLAGS_fault_inject``, comma-separated clauses)::
+
+    site                fire on the 1st hit of `site`, raising FaultInjected
+    site:3              fire on the 3rd hit (deterministic, fires once)
+    site:3:kill         hard-exit (os._exit(FAULT_EXIT_CODE)) on the 3rd hit
+    site:p0.25          fire each hit with prob 0.25 — per-site RNG seeded
+                        from FLAGS_fault_inject_seed, so the same seed+spec
+                        replays the identical failure schedule
+    site:p0.25:kill     probabilistic hard-exit
+
+Sites currently planted (grep for ``maybe_fail`` to enumerate):
+
+* ``ckpt/after_chunk_write``  — data file durable, metadata not yet written
+* ``ckpt/before_metadata_write`` — before the atomic 0.metadata replace
+* ``ckpt/before_commit``      — staging dir complete, not yet renamed
+* ``ckpt/after_rename``       — final dir exists, COMMITTED marker missing
+* ``store/connect`` ``store/get`` ``store/set`` ``store/wait`` — transient
+  store faults (raised as TransientStoreError so the retry path engages)
+* ``loop/before_step``        — the resilient train driver's step boundary
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import zlib
+from typing import Dict, Optional
+
+__all__ = ["FaultInjected", "maybe_fail", "configure", "reset", "hits",
+           "FAULT_EXIT_CODE"]
+
+FAULT_EXIT_CODE = 41  # distinguishable from python crashes (1) / signals
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed injection point (default failure mode)."""
+
+
+class _Clause:
+    __slots__ = ("site", "nth", "prob", "kill", "fired", "rng")
+
+    def __init__(self, site: str, nth: Optional[int], prob: Optional[float],
+                 kill: bool):
+        self.site = site
+        self.nth = nth
+        self.prob = prob
+        self.kill = kill
+        self.fired = False
+        self.rng: Optional[random.Random] = None
+
+
+_LOCK = threading.Lock()
+_ARMED: Dict[str, _Clause] = {}
+_COUNTS: Dict[str, int] = {}
+# Fast-path gate: maybe_fail is a single comparison when disarmed. None
+# means "not yet configured" — the first maybe_fail pulls the spec from
+# FLAGS_fault_inject (env overrides land there before this package can be
+# imported; see flags._bind_fault_inject).
+_ENABLED: Optional[bool] = None
+
+
+def configure(spec: str) -> None:
+    """(Re)arm injection points from a spec string; '' disarms everything.
+    Bound to FLAGS_fault_inject via its on_set hook, so both the env var and
+    paddle.set_flags take effect. Counters reset on every configure."""
+    global _ENABLED
+    armed: Dict[str, _Clause] = {}
+    for clause in (spec or "").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        site = parts[0]
+        nth: Optional[int] = 1
+        prob: Optional[float] = None
+        kill = False
+        for p in parts[1:]:
+            if p == "kill":
+                kill = True
+            elif p == "raise":
+                kill = False
+            elif p.startswith("p"):
+                prob, nth = float(p[1:]), None
+            else:
+                nth = int(p)
+        armed[site] = _Clause(site, nth, prob, kill)
+    with _LOCK:
+        _ARMED.clear()
+        _ARMED.update(armed)
+        _COUNTS.clear()
+        _ENABLED = bool(armed)
+
+
+def reset() -> None:
+    """Clear hit counters and one-shot state, keeping the armed spec."""
+    with _LOCK:
+        _COUNTS.clear()
+        for cl in _ARMED.values():
+            cl.fired = False
+            cl.rng = None
+
+
+def hits() -> Dict[str, int]:
+    """Per-site hit counts since the last configure/reset (only tracked
+    while any clause is armed — the disarmed fast path counts nothing)."""
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def _site_rng(site: str) -> random.Random:
+    # per-site stream: same FLAGS_fault_inject_seed => same schedule,
+    # independent of how other sites interleave
+    from ...flags import flag
+    seed = int(flag("fault_inject_seed"))
+    return random.Random((zlib.crc32(site.encode()) << 32) ^ seed)
+
+
+def maybe_fail(site: str, exc=FaultInjected) -> None:
+    """Injection point. No-op (one comparison) unless FLAGS_fault_inject
+    arms `site`; then raises `exc` or hard-exits on the scheduled hit."""
+    if _ENABLED is None:
+        from ...flags import flag
+        configure(flag("fault_inject"))
+    if not _ENABLED:
+        return
+    with _LOCK:
+        n = _COUNTS.get(site, 0) + 1
+        _COUNTS[site] = n
+        cl = _ARMED.get(site)
+        if cl is None:
+            return
+        if cl.prob is not None:
+            if cl.rng is None:
+                cl.rng = _site_rng(site)
+            fire = cl.rng.random() < cl.prob
+        else:
+            fire = (not cl.fired) and n == cl.nth
+            cl.fired = cl.fired or fire
+        kill = cl.kill
+    if not fire:
+        return
+    if kill:
+        os._exit(FAULT_EXIT_CODE)  # crash without cleanup: no atexit drain,
+        #                            no buffered IO flush — a real SIGKILL
+    raise exc(f"[fault-injection] {site} (hit {n})")

@@ -1,0 +1,147 @@
+"""Resilience plumbing for the high-level ``Model.fit`` loop.
+
+``Model.fit(..., resilient={...})`` threads the fault-tolerant runtime
+through the hapi trainer without rewriting it: crash-safe cadence
+checkpoints of (params, buffers, optimizer state, global step, rng seed),
+resume-with-fast-forward on restart, watchdog spans around every train
+step, and a SIGTERM handler that commits one final checkpoint and stops
+training inside the grace budget.
+
+Config keys (all except ckpt_dir optional)::
+
+    ckpt_dir      checkpoint root directory (required)
+    ckpt_every    commit cadence in train steps (default 100)
+    keep_n        committed checkpoints retained (default FLAGS_ckpt_keep_n)
+    grace_s       preemption budget (default FLAGS_preempt_grace_s)
+    step_timeout  watchdog budget per train step (default FLAGS_comm_timeout_s)
+    seed          deterministic per-run rng seed for the step keys — saved
+                  in the checkpoint so a resumed run replays the same
+                  dropout/shuffle keys (default: drawn from np.random)
+    store         TCP store for multi-process barriers (default: launcher's)
+    watchdog      CommWatchdog to use (default: a private one)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..watchdog import CommWatchdog
+from .commit import commit_checkpoint, latest_checkpoint
+from .driver import SigtermGuard
+
+__all__ = ["FitResilience"]
+
+
+class FitResilience:
+    def __init__(self, model, cfg: Dict[str, Any]):
+        from ...flags import flag
+        if "ckpt_dir" not in cfg:
+            raise ValueError("resilient fit config requires 'ckpt_dir'")
+        self.model = model
+        self.ckpt_dir: str = cfg["ckpt_dir"]
+        self.ckpt_every = int(cfg.get("ckpt_every", 100))
+        self.keep_n = cfg.get("keep_n")
+        self.grace_s = float(cfg.get("grace_s", flag("preempt_grace_s")))
+        self.step_timeout = cfg.get("step_timeout")
+        self.store = cfg.get("store")
+        self.seed = int(cfg.get("seed", np.random.randint(0, 2 ** 31 - 1)))
+        self.global_step = 0
+        self._wd: CommWatchdog = cfg.get("watchdog") or CommWatchdog(
+            poll_interval=0.2)
+        self._own_wd = cfg.get("watchdog") is None
+        self._sig = SigtermGuard()
+        self._finalized = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def __enter__(self):
+        self._wd.start()
+        self._sig.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._sig.__exit__(*exc)
+        if self._own_wd:
+            self._wd.stop()
+        return False
+
+    # -- checkpoint payload -------------------------------------------------
+    def _payload(self) -> Dict[str, Any]:
+        m = self.model
+        payload = {"params": m._params, "step": self.global_step,
+                   "seed": self.seed}
+        if m._opt_state:
+            payload["opt"] = m._opt_state
+        if m._buffers:
+            payload["buffers"] = m._buffers
+        if m._optimizer is not None:
+            # host-side optimizer state (step_count, LR-scheduler counters):
+            # without it a resumed warmup/decay schedule restarts at step 0
+            payload["opt_host"] = m._optimizer.state_dict()
+        return payload
+
+    def resume(self) -> int:
+        """Restore model/optimizer/step from the newest committed
+        checkpoint (if any). Call after the model synced its device pytrees
+        (they serve as the load templates). Returns the resumed step."""
+        ckpt = latest_checkpoint(self.ckpt_dir)
+        if ckpt is None:
+            return 0
+        from ..checkpoint import load_state_dict
+        # load_state_dict mutates the template trees in place, so
+        # model._params/_opt_state/_buffers are updated directly AND
+        # structure-only subtrees survive (e.g. SGD's empty per-param slot
+        # dicts, which the flatten/unflatten round trip cannot represent)
+        loaded = load_state_dict(self._payload(), ckpt)
+        self.global_step = int(loaded["step"])
+        self.seed = int(loaded["seed"])
+        if "opt_host" in loaded and self.model._optimizer is not None:
+            self.model._optimizer.set_state_dict(loaded["opt_host"])
+        return self.global_step
+
+    # -- per-step hooks -----------------------------------------------------
+    def watch(self):
+        if self.step_timeout is None:
+            return self._wd.watch("fit_step")
+        return self._wd.watch("fit_step", timeout=self.step_timeout)
+
+    def after_step(self) -> bool:
+        """Advance the step counter, run the cadence commit, honor a
+        pending preemption. Returns True when training must stop."""
+        self.global_step += 1
+        if self._sig.triggered:
+            self.finalize()
+            return True
+        if self.ckpt_every and self.global_step % self.ckpt_every == 0:
+            self._commit()
+        return False
+
+    def _commit(self, barrier_timeout: Optional[float] = None) -> str:
+        return commit_checkpoint(self._payload(), self.ckpt_dir,
+                                 self.global_step, store=self.store,
+                                 keep_n=self.keep_n,
+                                 barrier_timeout=barrier_timeout)
+
+    def finalize(self) -> None:
+        """Final synchronous commit (idempotent per step): the normal
+        end-of-fit path and the SIGTERM drain share it."""
+        if self._finalized:
+            return
+        from .driver import drain_then_commit
+        err = drain_then_commit(
+            self._wd, self.grace_s,
+            lambda: self._commit(barrier_timeout=self.grace_s))
+        self._finalized = True
+        if err is not None and not self._sig.triggered:
+            # only the dying (preempted) process may swallow a failed final
+            # commit; a clean end of fit must not fake success
+            raise err
+
+    @property
+    def preempted(self) -> bool:
+        return self._sig.triggered
+
+    def stats(self) -> Dict[str, Any]:
+        return self._wd.stats()

@@ -13,14 +13,80 @@ KV, launch/controllers/master.py:73).
 from __future__ import annotations
 
 import ctypes
+import random
 import struct
 import threading
 import time
-from typing import Optional
+from typing import Callable, Dict, Optional, TypeVar
 
 from .. import _native
 
-__all__ = ["TCPStore", "MasterStore"]
+__all__ = ["TCPStore", "MasterStore", "StoreError", "TransientStoreError",
+           "StoreTimeout", "retry_stats", "reset_retry_stats"]
+
+
+class StoreError(RuntimeError):
+    """Base class for KV-store failures."""
+
+
+class TransientStoreError(StoreError):
+    """Transient fault — connection refused, io error, dropped socket.
+    IDEMPOTENT ops (connect/set/get/wait) retry these internally with
+    exponential backoff + jitter (flags FLAGS_store_retry_{max,base_s,
+    max_s}) before letting one propagate. add/compare_set raise it with NO
+    retry: the mutation may have been applied server-side before the reply
+    was lost, so callers must treat a caught TransientStoreError from those
+    as INDETERMINATE, not safely re-callable."""
+
+
+class StoreTimeout(StoreError, TimeoutError):
+    """Deadline expired (key never appeared / peer unreachable within the
+    timeout). NOT retried: the deadline already budgeted the waiting."""
+
+
+_RETRY_STATS: Dict[str, int] = {}
+_RETRY_LOCK = threading.Lock()
+_T = TypeVar("_T")
+
+
+def retry_stats() -> Dict[str, int]:
+    """Per-op transient-retry counts (op -> retries), for tests and ops
+    dashboards."""
+    with _RETRY_LOCK:
+        return dict(_RETRY_STATS)
+
+
+def reset_retry_stats() -> None:
+    with _RETRY_LOCK:
+        _RETRY_STATS.clear()
+
+
+def _with_retry(op: str, attempt: Callable[[], _T],
+                deadline: Optional[float] = None) -> _T:
+    """Run `attempt`, retrying TransientStoreError with exponential backoff
+    and +/-50% jitter (decorrelates a fleet of workers hammering a
+    just-restarted master). StoreTimeout and other errors pass through.
+    `deadline` (time.monotonic) caps the WHOLE retry sequence so a
+    timeout-bearing op never exceeds its caller's budget — without it, a
+    transient fault near the deadline would restart the full wait."""
+    from ..flags import flag
+    attempts = max(1, int(flag("store_retry_max")))
+    delay = float(flag("store_retry_base_s"))
+    cap = float(flag("store_retry_max_s"))
+    for k in range(attempts):
+        try:
+            return attempt()
+        except TransientStoreError as e:
+            with _RETRY_LOCK:
+                _RETRY_STATS[op] = _RETRY_STATS.get(op, 0) + 1
+            if k == attempts - 1:
+                raise
+            if deadline is not None and time.monotonic() >= deadline:
+                raise StoreTimeout(
+                    f"TCPStore.{op} deadline expired during transient-fault "
+                    f"retry: {e}") from e
+            time.sleep(min(cap, delay) * (0.5 + random.random()))
+            delay *= 2
 
 
 class _PyStoreServer:
@@ -80,47 +146,83 @@ class TCPStore:
             self.port = self._lib.pts_server_port(self._server)
         else:
             self.port = port
-        self._client = self._lib.pts_client_connect(
-            host.encode(), self.port, self._timeout_ms)
-        if not self._client:
-            raise TimeoutError(
-                f"TCPStore: cannot reach {host}:{self.port}")
+
+        connect_deadline = time.monotonic() + self._timeout_ms / 1000
+
+        def connect():
+            from .resilience import faults
+            faults.maybe_fail("store/connect", exc=TransientStoreError)
+            rem_ms = max(1, int((connect_deadline - time.monotonic())
+                                * 1000))
+            client = self._lib.pts_client_connect(
+                host.encode(), self.port, rem_ms)
+            if not client:
+                raise TransientStoreError(
+                    f"TCPStore: cannot reach {host}:{self.port}")
+            return client
+        try:
+            self._client = _with_retry("connect", connect,
+                                       deadline=connect_deadline)
+        except TransientStoreError as e:
+            # exhausted retries: surface as a timeout (the historical
+            # contract — callers catch TimeoutError on rendezvous failure)
+            raise StoreTimeout(str(e)) from e
 
     # -- API (reference surface) --------------------------------------------
+    # set/get/wait/connect are idempotent, so transient io faults retry
+    # with backoff (_with_retry); add/compare_set are NOT safely retryable
+    # (the server may have applied an attempt whose reply was lost) and
+    # only get typed errors.
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        if self._fallback is not None:
-            with self._fallback.cond:
-                self._fallback.data[key] = bytes(value)
-                self._fallback.cond.notify_all()
-            return
-        rc = self._lib.pts_client_set(self._client, key.encode(), value,
-                                      len(value))
-        if rc != 0:
-            raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
+
+        def attempt():
+            from .resilience import faults
+            faults.maybe_fail("store/set", exc=TransientStoreError)
+            if self._fallback is not None:
+                with self._fallback.cond:
+                    self._fallback.data[key] = bytes(value)
+                    self._fallback.cond.notify_all()
+                return
+            rc = self._lib.pts_client_set(self._client, key.encode(), value,
+                                          len(value))
+            if rc != 0:
+                raise TransientStoreError(
+                    f"TCPStore.set({key!r}) failed rc={rc}")
+        _with_retry("set", attempt)
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         to_ms = self._timeout_ms if timeout is None else int(timeout * 1000)
-        if self._fallback is not None:
-            deadline = time.time() + to_ms / 1000
-            with self._fallback.cond:
-                while key not in self._fallback.data:
-                    rem = deadline - time.time()
-                    if rem <= 0:
-                        raise TimeoutError(f"TCPStore.get({key!r}) timed out")
-                    self._fallback.cond.wait(rem)
-                return self._fallback.data[key]
-        out = ctypes.c_void_p()
-        out_len = ctypes.c_uint64()
-        rc = self._lib.pts_client_get(self._client, key.encode(), to_ms,
-                                      ctypes.byref(out),
-                                      ctypes.byref(out_len))
-        if rc == -1:
-            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
-        if rc != 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed rc={rc}")
-        return _native.take_bytes(self._lib, out.value, out_len.value)
+        # one deadline for the whole call, retries included: each attempt
+        # only gets the REMAINING budget
+        deadline = time.monotonic() + to_ms / 1000
+
+        def attempt():
+            from .resilience import faults
+            faults.maybe_fail("store/get", exc=TransientStoreError)
+            rem_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            if self._fallback is not None:
+                with self._fallback.cond:
+                    while key not in self._fallback.data:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            raise StoreTimeout(
+                                f"TCPStore.get({key!r}) timed out")
+                        self._fallback.cond.wait(rem)
+                    return self._fallback.data[key]
+            out = ctypes.c_void_p()
+            out_len = ctypes.c_uint64()
+            rc = self._lib.pts_client_get(self._client, key.encode(),
+                                          rem_ms, ctypes.byref(out),
+                                          ctypes.byref(out_len))
+            if rc == -1:
+                raise StoreTimeout(f"TCPStore.get({key!r}) timed out")
+            if rc != 0:
+                raise TransientStoreError(
+                    f"TCPStore.get({key!r}) failed rc={rc}")
+            return _native.take_bytes(self._lib, out.value, out_len.value)
+        return _with_retry("get", attempt, deadline=deadline)
 
     def add(self, key: str, amount: int = 1) -> int:
         if self._fallback is not None:
@@ -135,7 +237,9 @@ class TCPStore:
                 return now
         rc = self._lib.pts_client_add(self._client, key.encode(), amount)
         if rc == -(2 ** 63):
-            raise RuntimeError(f"TCPStore.add({key!r}) io error")
+            # not retried: the increment may have been applied server-side
+            # before the reply was lost
+            raise TransientStoreError(f"TCPStore.add({key!r}) io error")
         return int(rc)
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
@@ -147,11 +251,20 @@ class TCPStore:
                 continue
             to_ms = (self._timeout_ms if timeout is None
                      else int(timeout * 1000))
-            rc = self._lib.pts_client_wait(self._client, k.encode(), to_ms)
-            if rc == -1:
-                raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
-            if rc != 0:
-                raise RuntimeError(f"TCPStore.wait({k!r}) failed rc={rc}")
+            deadline = time.monotonic() + to_ms / 1000
+
+            def attempt(k=k, deadline=deadline):
+                from .resilience import faults
+                faults.maybe_fail("store/wait", exc=TransientStoreError)
+                rem_ms = max(1, int((deadline - time.monotonic()) * 1000))
+                rc = self._lib.pts_client_wait(self._client, k.encode(),
+                                               rem_ms)
+                if rc == -1:
+                    raise StoreTimeout(f"TCPStore.wait({k!r}) timed out")
+                if rc != 0:
+                    raise TransientStoreError(
+                        f"TCPStore.wait({k!r}) failed rc={rc}")
+            _with_retry("wait", attempt, deadline=deadline)
 
     def delete_key(self, key: str) -> bool:
         if self._fallback is not None:
@@ -184,7 +297,8 @@ class TCPStore:
             self._client, key.encode(), expected, len(expected), desired,
             len(desired), ctypes.byref(out), ctypes.byref(out_len))
         if rc != 0:
-            raise RuntimeError(f"TCPStore.compare_set({key!r}) rc={rc}")
+            # not retried: the swap may have landed before the reply died
+            raise TransientStoreError(f"TCPStore.compare_set({key!r}) rc={rc}")
         return _native.take_bytes(self._lib, out.value, out_len.value)
 
     def barrier(self, name: str = "barrier", timeout: Optional[float] = None):

@@ -52,6 +52,8 @@ class CommWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.timeout_count = 0
+        self._spans_started = 0
+        self._spans_completed = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -84,16 +86,36 @@ class CommWatchdog:
             self._seq += 1
             sid = self._seq
             self._spans[sid] = span
+            self._spans_started += 1
         try:
             yield span
         finally:
             with self._lock:
                 self._spans.pop(sid, None)
+                self._spans_completed += 1
 
     def pending(self):
         with self._lock:
             return [(s.tag, time.monotonic() - s.start)
                     for s in self._spans.values()]
+
+    # -- observability (resilient-loop tests assert escalation counts) -------
+    def stats(self) -> Dict[str, int]:
+        """Counters snapshot: spans started/completed, currently active,
+        and timeouts fired since construction or the last reset()."""
+        with self._lock:
+            return {"timeout_count": self.timeout_count,
+                    "spans_started": self._spans_started,
+                    "spans_completed": self._spans_completed,
+                    "active": len(self._spans)}
+
+    def reset(self) -> None:
+        """Clear the counters (active spans keep running) so tests can
+        assert a scenario fired the watchdog exactly N times."""
+        with self._lock:
+            self.timeout_count = 0
+            self._spans_started = 0
+            self._spans_completed = 0
 
     # -- monitor -------------------------------------------------------------
     def _loop(self):
@@ -106,7 +128,8 @@ class CommWatchdog:
                         s.fired = True
                         overdue.append(s)
             for s in overdue:
-                self.timeout_count += 1
+                with self._lock:
+                    self.timeout_count += 1
                 self.on_timeout(s, self._report(s, now))
 
     def _report(self, span: "_Span", now: float) -> str:

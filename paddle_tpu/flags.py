@@ -295,6 +295,58 @@ define_flag("async_ckpt_workers", 1,
             "Writer threads for async distributed checkpoints (consumed "
             "by checkpoint.save_state_dict).")
 
+# --- resilience / fault tolerance ------------------------------------------
+define_flag("ckpt_keep_n", 3,
+            "Committed checkpoints retained by the crash-safe commit "
+            "protocol; after each successful commit, older committed "
+            "step_* dirs are pruned. <= 0 keeps all (consumed by "
+            "distributed.resilience.commit).")
+define_flag("preempt_grace_s", 30.0,
+            "Grace budget in seconds for the SIGTERM/preemption handler's "
+            "final synchronous checkpoint: async writers are drained and "
+            "one commit is taken inside this window (consumed by "
+            "distributed.resilience run_resilient / Model.fit resilient=).")
+define_flag("max_consecutive_nonfinite", 10,
+            "Consecutive non-finite (skipped) train steps tolerated by the "
+            "resilient loop before aborting with a per-leaf nan/inf "
+            "diagnostic — the loop-level extension of the grad-scaler "
+            "found_inf skip (consumed by resilience.run_resilient).")
+define_flag("store_retry_max", 4,
+            "Max attempts for idempotent TCP-store ops (connect/set/get/"
+            "wait) on TransientStoreError before it propagates (consumed "
+            "by distributed.store._with_retry).")
+define_flag("store_retry_base_s", 0.05,
+            "Initial backoff delay for store retries; doubles per attempt "
+            "with +/-50% jitter (consumed by distributed.store).")
+define_flag("store_retry_max_s", 2.0,
+            "Ceiling on the store retry backoff delay (consumed by "
+            "distributed.store).")
+define_flag("fault_inject_seed", 0,
+            "Seed for probabilistic fault-injection clauses ('site:p0.25'):"
+            " identical seed + spec replays the identical failure schedule "
+            "(consumed by distributed.resilience.faults).")
+
+
+def _bind_fault_inject(v):
+    import sys
+    mod = sys.modules.get("paddle_tpu.distributed.resilience.faults")
+    if mod is None:
+        # import-time env override: faults reads this flag lazily on its
+        # first maybe_fail, so we must NOT import paddle_tpu.distributed
+        # here mid-bootstrap
+        return
+    mod.configure(v)
+
+
+define_flag("fault_inject", "",
+            "Deterministic fault-injection spec, comma-separated clauses "
+            "'site[:N][:kill]' (fire on the Nth hit of the named site; "
+            "'kill' hard-exits with code 41 instead of raising "
+            "FaultInjected) or 'site:pP[:kill]' (seeded Bernoulli). Empty "
+            "disarms every site. Sites are documented in "
+            "distributed/resilience/faults.py (bound to faults.configure).",
+            on_set=_bind_fault_inject)
+
 # --- data / io -------------------------------------------------------------
 define_flag("dataloader_num_workers", 0,
             "Default DataLoader worker count when none is passed "

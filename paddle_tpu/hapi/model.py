@@ -11,6 +11,7 @@ Layer tree is only touched when syncing state for save()/state_dict().
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import List, Optional
 
@@ -141,7 +142,16 @@ class Model:
     # -- training ------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            resilient=None):
+        """Train the model. With ``resilient={"ckpt_dir": ..., ...}`` the
+        loop runs under the fault-tolerant runtime
+        (distributed.resilience.fit.FitResilience): crash-safe cadence
+        checkpoints, resume + batch fast-forward from the last committed
+        step on restart, a watchdog span around every train step, and a
+        SIGTERM handler that commits one final checkpoint within
+        FLAGS_preempt_grace_s and stops training cleanly. Resume needs a
+        sized train loader (len()) to fast-forward mid-epoch."""
         enforce(self._optimizer is not None and self._loss is not None,
                 "call prepare(optimizer, loss) first",
                 error=PreconditionNotMetError, op="Model.fit")
@@ -160,44 +170,99 @@ class Model:
             self._train_step_fn = self._build_train_step()
         self.stop_training = False
 
-        cbks.on_train_begin()
-        step_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
-        global_step = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            epoch_logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                lr = self._optimizer.get_lr()
-                key = jax.random.fold_in(step_key, global_step)
-                (self._params, self._buffers, self._opt_state, loss,
-                 outputs) = self._train_step_fn(
-                    self._params, self._frozen, self._buffers, self._opt_state,
-                    jnp.asarray(lr, jnp.float32), key,
-                    tuple(jnp.asarray(x) for x in inputs),
-                    tuple(jnp.asarray(y) for y in labels))
-                logs = {"loss": float(loss), "lr": lr}
+        res = None
+        if resilient:
+            from ..distributed.resilience.fit import FitResilience
+            res = FitResilience(self, dict(resilient))
+            res.__enter__()
+        try:
+            cbks.on_train_begin()
+            if res is not None:
+                start_step = res.resume()
+                enforce(start_step == 0 or steps is not None,
+                        "resilient resume needs a sized train loader to "
+                        "fast-forward to the checkpointed step",
+                        error=PreconditionNotMetError, op="Model.fit")
+                step_key = jax.random.PRNGKey(res.seed)
+            else:
+                start_step = 0
+                step_key = jax.random.PRNGKey(
+                    np.random.randint(0, 2**31 - 1))
+            skip_epochs = start_step // steps if (res and steps) else 0
+            skip_batches = start_step % steps if (res and steps) else 0
+            # only a Dataset input gets wrapped in a loader that honors the
+            # `shuffle` arg (lists/iterables keep their own fixed order; a
+            # user-built DataLoader's order is their responsibility — see
+            # the docstring)
+            if skip_batches and shuffle and isinstance(train_data, Dataset):
+                import warnings
+                warnings.warn(
+                    "resilient mid-epoch resume fast-forwards "
+                    f"{skip_batches} batches, but shuffle=True reshuffles "
+                    "the loader on restart — the skipped subset differs "
+                    "from the one trained before the crash. Pass "
+                    "shuffle=False (or a deterministically-ordered "
+                    "DataLoader) for exact resume.")
+            global_step = 0
+            for epoch in range(epochs):
+                if res is not None and epoch < skip_epochs:
+                    global_step += steps  # already trained before restart
+                    continue
+                cbks.on_epoch_begin(epoch)
                 for m in self._metrics:
-                    res = _metric_update(m, outputs[0], labels)
-                    logs[m.name() if isinstance(m.name(), str) else m.name()[0]] = res
-                epoch_logs = logs
-                global_step += 1
-                cbks.on_train_batch_end(step, logs)
+                    m.reset()
+                epoch_logs = {}
+                for step, batch in enumerate(loader):
+                    if (res is not None and epoch == skip_epochs
+                            and step < skip_batches):
+                        global_step += 1
+                        continue
+                    cbks.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    lr = self._optimizer.get_lr()
+                    key = jax.random.fold_in(step_key, global_step)
+                    with (res.watch() if res is not None
+                          else contextlib.nullcontext()):
+                        (self._params, self._buffers, self._opt_state, loss,
+                         outputs) = self._train_step_fn(
+                            self._params, self._frozen, self._buffers,
+                            self._opt_state,
+                            jnp.asarray(lr, jnp.float32), key,
+                            tuple(jnp.asarray(x) for x in inputs),
+                            tuple(jnp.asarray(y) for y in labels))
+                    logs = {"loss": float(loss), "lr": lr}
+                    for m in self._metrics:
+                        r = _metric_update(m, outputs[0], labels)
+                        logs[m.name() if isinstance(m.name(), str)
+                             else m.name()[0]] = r
+                    epoch_logs = logs
+                    global_step += 1
+                    cbks.on_train_batch_end(step, logs)
+                    if res is not None and res.after_step():
+                        self.stop_training = True  # preempted: final
+                        #                            checkpoint is committed
+                    if self.stop_training:
+                        break
+                if res is not None and res.preempted:
+                    break  # don't burn the grace budget on metrics/eval —
+                    #        the final checkpoint is already committed
+                for m in self._metrics:
+                    nm = m.name() if isinstance(m.name(), str) else m.name()[0]
+                    epoch_logs[nm] = m.accumulate()
+                cbks.on_epoch_end(epoch, epoch_logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                              verbose=0,
+                                              num_workers=num_workers)
+                    cbks.on_eval_end({f"eval_{k}": v
+                                      for k, v in eval_logs.items()})
                 if self.stop_training:
                     break
-            for m in self._metrics:
-                nm = m.name() if isinstance(m.name(), str) else m.name()[0]
-                epoch_logs[nm] = m.accumulate()
-            cbks.on_epoch_end(epoch, epoch_logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0, num_workers=num_workers)
-                cbks.on_eval_end({f"eval_{k}": v for k, v in eval_logs.items()})
-            if self.stop_training:
-                break
+            if res is not None:
+                res.finalize()
+        finally:
+            if res is not None:
+                res.__exit__(None, None, None)
         cbks.on_train_end()
         self._sync_to_network()
         hist = [c for c in cbks.callbacks if type(c).__name__ == "History"]

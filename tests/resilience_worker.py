@@ -1,0 +1,78 @@
+"""Spawned worker half of the resilience tests (launcher half in
+tests/test_resilience.py). Runs a tiny deterministic training loop under
+run_resilient and reports losses/state as a RESULT json line, so the parent
+can kill it (SIGTERM or an armed ``:kill`` fault site), respawn it, and
+assert the resumed run matches the uninterrupted golden bitwise.
+
+Usage: python resilience_worker.py <mode> <ckpt_dir> [steps]
+  mode 'train': pure-jnp SGD steps; crash points come from
+                FLAGS_fault_inject in the environment.
+  mode 'slow':  python-side steps with a sleep — a SIGTERM target whose
+                step cadence is fast enough to preempt mid-run.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def train_step(state, i):
+    """Deterministic SGD on sum((w - x_i)^2); x_i derived from the step
+    index, so losses are a pure function of (initial state, step)."""
+    import jax
+    import jax.numpy as jnp
+    x = jax.random.normal(jax.random.PRNGKey(i), (4,), dtype=jnp.float32)
+    w = state["w"]
+    loss = jnp.sum((w - x) ** 2)
+    return {"w": w - 0.1 * 2.0 * (w - x)}, loss
+
+
+def initial_state():
+    import jax.numpy as jnp
+    return {"w": jnp.zeros((4,), jnp.float32)}
+
+
+def main():
+    mode, ckpt_dir = sys.argv[1], sys.argv[2]
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 9
+    from paddle_tpu.distributed.resilience import run_resilient
+
+    losses = {}
+
+    def on_step(i, loss):
+        losses[i] = loss
+
+    if mode == "train":
+        state, info = run_resilient(
+            train_step, initial_state(), steps=steps, ckpt_dir=ckpt_dir,
+            ckpt_every=3, on_step=on_step)
+        result = {"losses": losses, "w": np.asarray(state["w"]).tolist(),
+                  "completed": info["completed_steps"],
+                  "resumed_from": info["resumed_from"]}
+    elif mode == "slow":
+        import time
+
+        def slow_step(state, i):
+            time.sleep(0.05)
+            w = state["w"] * np.float32(0.999)
+            return {"w": w}, float(w.sum())
+
+        print("READY", flush=True)
+        state, info = run_resilient(
+            slow_step, {"w": np.ones((4,), np.float32)}, steps=steps,
+            ckpt_dir=ckpt_dir, ckpt_every=0, grace_s=15.0, on_step=on_step)
+        result = {"preempted": info["preempted"],
+                  "completed": info["completed_steps"],
+                  "final": info["final_checkpoint"],
+                  "grace_used_s": info.get("grace_used_s")}
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    print("RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,547 @@
+"""Fault-tolerant runtime tests: crash-safe checkpoint commit, deterministic
+fault injection, preemption (SIGTERM) handling, store retry/backoff,
+watchdog observability, and Model.fit resilient= plumbing.
+
+All tests here are tier-1 (fast, JAX_PLATFORMS=cpu, no `slow` marker): the
+fault-injection sites are the only way the recovery paths ever execute, so
+they must run on every CI pass (reference analog: the subprocess-kill
+chaos pattern of test/legacy_test/test_dist_base.py, made deterministic).
+"""
+
+import ast
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.resilience import (
+    FaultInjected, commit_checkpoint, faults, latest_checkpoint)
+from paddle_tpu.distributed.resilience.commit import (
+    COMMIT_MARKER, checkpoint_step, is_committed)
+from paddle_tpu.distributed.resilience.driver import (
+    NonFiniteLossError, WatchdogTimeout, run_resilient)
+from paddle_tpu.distributed.watchdog import CommWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "resilience_worker.py")
+
+# every site the checkpoint path plants, in commit order
+CKPT_SITES = ["ckpt/after_chunk_write", "ckpt/before_metadata_write",
+              "ckpt/before_commit", "ckpt/after_rename"]
+
+
+def _arm(spec):
+    paddle.set_flags({"FLAGS_fault_inject": spec})
+
+
+def _sgd_step(state, i):
+    """Deterministic: loss and update are pure functions of (state, i)."""
+    x = jax.random.normal(jax.random.PRNGKey(i), (4,), dtype=jnp.float32)
+    w = state["w"]
+    loss = jnp.sum((w - x) ** 2)
+    return {"w": w - 0.2 * (w - x)}, loss
+
+
+# -- commit protocol ---------------------------------------------------------
+def test_commit_latest_and_retention(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        p = commit_checkpoint({"w": jnp.full((4,), float(step))}, d, step,
+                              keep_n=2)
+        assert is_committed(p)
+        assert latest_checkpoint(d) == p
+        assert checkpoint_step(p) == step
+    kept = sorted(os.listdir(d))
+    assert kept == ["step_00000003", "step_00000004"]  # keep_n=2 pruned 1,2
+    out = ckpt.load_state_dict({"w": jnp.zeros((4,))}, latest_checkpoint(d))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4,), 4.0))
+
+
+def test_commit_idempotent_and_async(tmp_path):
+    d = str(tmp_path)
+    p1 = commit_checkpoint({"w": jnp.ones((4,))}, d, 5, async_save=True)
+    p2 = commit_checkpoint({"w": jnp.zeros((4,))}, d, 5)  # recommit: no-op
+    assert p1 == p2
+    out = ckpt.load_state_dict({"w": jnp.zeros((4,))}, p1)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(4))
+
+
+@pytest.mark.parametrize("site", CKPT_SITES)
+def test_interrupted_commit_never_discoverable(tmp_path, site):
+    """Acceptance: a checkpoint directory interrupted at ANY injected point
+    is never returned by latest_checkpoint, and the straggler is GC'd."""
+    d = str(tmp_path)
+    good = commit_checkpoint({"w": jnp.ones((4,))}, d, 1)
+    _arm(f"{site}:1")
+    with pytest.raises(FaultInjected):
+        commit_checkpoint({"w": jnp.full((4,), 2.0)}, d, 2)
+    _arm("")
+    assert latest_checkpoint(d) == good
+    assert sorted(os.listdir(d)) == ["step_00000001"]  # straggler GC'd
+    # the survivor still loads
+    out = ckpt.load_state_dict({"w": jnp.zeros((4,))}, good)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(4))
+
+
+def test_empty_or_missing_root(tmp_path):
+    assert latest_checkpoint(str(tmp_path / "nope")) is None
+    assert latest_checkpoint(str(tmp_path)) is None
+
+
+# -- satellite: atomic metadata / file writes --------------------------------
+def test_atomic_write_crash_leaves_old_bytes(tmp_path):
+    from paddle_tpu.distributed.checkpoint.utils import atomic_write
+    p = tmp_path / "0.metadata"
+    p.write_bytes(b"OLD")
+    with pytest.raises(RuntimeError, match="mid-write"):
+        with atomic_write(str(p)) as f:
+            f.write(b"NEW-PARTIAL")
+            raise RuntimeError("crash mid-write")
+    assert p.read_bytes() == b"OLD"
+    assert os.listdir(tmp_path) == ["0.metadata"]  # temp file cleaned up
+
+
+def test_metadata_crash_never_truncates(tmp_path):
+    """Crash before the metadata replace leaves the OLD metadata loadable —
+    not an opaque UnpicklingError from a truncated pickle."""
+    d = str(tmp_path)
+    ckpt.save_state_dict({"w": jnp.ones((4,))}, d)
+    md_old = ckpt.load_metadata(d)
+    _arm("ckpt/before_metadata_write:1")
+    with pytest.raises(FaultInjected):
+        ckpt.save_state_dict({"w": jnp.zeros((4,))}, d)
+    _arm("")
+    md = ckpt.load_metadata(d)  # must not raise
+    assert set(md.state_dict_metadata) == set(md_old.state_dict_metadata)
+
+
+def test_no_raw_final_path_writes_in_checkpoint_pkg():
+    """Guard: nothing under distributed/checkpoint/ may open a final
+    destination path for writing except the atomic-commit helper
+    (utils.atomic_write). Parses the AST, so variable modes count too."""
+    import paddle_tpu.distributed.checkpoint as pkg
+    pkg_dir = os.path.dirname(pkg.__file__)
+    violations = []
+    for fname in sorted(os.listdir(pkg_dir)):
+        if not fname.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(pkg_dir, fname)).read())
+        stack = []
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                mode = None
+                if len(node.args) > 1:
+                    mode = (node.args[1].value
+                            if isinstance(node.args[1], ast.Constant)
+                            else "<dynamic>")
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = (kw.value.value
+                                if isinstance(kw.value, ast.Constant)
+                                else "<dynamic>")
+                writes = mode is not None and (
+                    mode == "<dynamic>" or any(c in mode for c in "wax+"))
+                allowed = fname == "utils.py" and "atomic_write" in stack
+                if writes and not allowed:
+                    violations.append(f"{fname}:{node.lineno} open(mode="
+                                      f"{mode!r}) in {stack or ['<module>']}")
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+        visit(tree)
+    assert not violations, (
+        "final-destination checkpoint writes must go through "
+        f"checkpoint.utils.atomic_write: {violations}")
+
+
+def test_wait_async_save_aggregates_all_writer_errors():
+    import importlib
+    ssd = importlib.import_module(
+        "paddle_tpu.distributed.checkpoint.save_state_dict")
+    ssd._ASYNC_ERRORS.extend([ValueError("writer0 boom"),
+                              OSError("writer1 disk full")])
+    with pytest.raises(RuntimeError) as ei:
+        ssd.wait_async_save()
+    msg = str(ei.value)
+    assert "2 writer(s)" in msg
+    assert "writer0 boom" in msg and "writer1 disk full" in msg
+    assert not ssd._ASYNC_ERRORS  # drained
+
+
+# -- fault injection engine --------------------------------------------------
+def test_fault_spec_nth_and_counts():
+    _arm("a/b:3")
+    fired = []
+    for _ in range(5):
+        try:
+            faults.maybe_fail("a/b")
+            fired.append(False)
+        except FaultInjected:
+            fired.append(True)
+    assert fired == [False, False, True, False, False]  # one-shot on 3rd hit
+    assert faults.hits() == {"a/b": 5}
+    faults.reset()
+    assert faults.hits() == {}
+
+
+def test_fault_probabilistic_is_seed_deterministic():
+    def pattern():
+        out = []
+        for _ in range(32):
+            try:
+                faults.maybe_fail("x/y")
+                out.append(False)
+            except FaultInjected:
+                out.append(True)
+        return out
+    paddle.set_flags({"FLAGS_fault_inject_seed": 7})
+    _arm("x/y:p0.5")
+    p1 = pattern()
+    _arm("x/y:p0.5")  # reconfigure resets the per-site stream
+    p2 = pattern()
+    assert p1 == p2
+    assert any(p1) and not all(p1)  # actually Bernoulli, not constant
+
+
+def test_fault_disarmed_is_silent():
+    _arm("")
+    for _ in range(3):
+        faults.maybe_fail("anything/at/all")  # must not raise or count
+
+
+# -- resilient driver --------------------------------------------------------
+def test_end_to_end_crash_recovery_bitwise(tmp_path):
+    """Acceptance: train N steps, crash mid-checkpoint, restart, resume
+    from the last committed step, reach bitwise-identical losses and final
+    state vs the uninterrupted run."""
+    w0 = {"w": jnp.zeros((4,), jnp.float32)}
+    golden_losses = {}
+    golden, _ = run_resilient(
+        _sgd_step, w0, steps=9, ckpt_dir=str(tmp_path / "a"), ckpt_every=3,
+        on_step=lambda i, l: golden_losses.__setitem__(i, l))
+
+    d = str(tmp_path / "b")
+    losses = {}
+    _arm("ckpt/after_chunk_write:2")  # survives commit@3, dies in commit@6
+    with pytest.raises(FaultInjected):
+        run_resilient(_sgd_step, w0, steps=9, ckpt_dir=d, ckpt_every=3,
+                      on_step=lambda i, l: losses.__setitem__(i, l))
+    _arm("")
+    assert checkpoint_step(latest_checkpoint(d)) == 3
+    state, info = run_resilient(
+        _sgd_step, w0, steps=9, ckpt_dir=d, ckpt_every=3,
+        on_step=lambda i, l: losses.__setitem__(i, l))
+    assert info["resumed_from"].endswith("step_00000003")
+    assert losses == golden_losses  # bitwise: dict of floats, == not approx
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(golden["w"]))
+
+
+def test_nonfinite_skip_then_recover(tmp_path):
+    def step(state, i):
+        if i == 1:
+            return {"w": state["w"] * np.float32("nan")}, float("nan")
+        return {"w": state["w"] + 1.0}, 1.0
+    state, info = run_resilient(step, {"w": jnp.zeros((2,))}, steps=4,
+                                ckpt_dir=str(tmp_path))
+    assert info["nonfinite_skips"] == 1
+    # step 1 was rejected found_inf-style: 3 good updates landed
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full((2,), 3.0))
+
+
+def test_nonfinite_abort_with_per_leaf_diagnostic(tmp_path):
+    def step(state, i):
+        return ({"good": state["good"],
+                 "bad": state["bad"] * np.float32("nan")}, float("nan"))
+    with pytest.raises(NonFiniteLossError) as ei:
+        run_resilient(step, {"good": jnp.ones((2,)), "bad": jnp.ones((3,))},
+                      steps=10, ckpt_dir=str(tmp_path),
+                      max_consecutive_nonfinite=3)
+    msg = str(ei.value)
+    assert "3 consecutive non-finite" in msg
+    assert "'bad'" in msg and "nan=3" in msg   # the guilty leaf, by name
+    assert "'good'" not in msg                 # finite leaves stay silent
+
+
+def test_watchdog_escalation_aborts_and_commits(tmp_path):
+    wd = CommWatchdog(poll_interval=0.02,
+                      on_timeout=lambda s, r: None)  # keep stderr clean
+    d = str(tmp_path)
+
+    def step(state, i):
+        if i == 2:
+            time.sleep(1.5)  # hangs well past the budget
+        return {"w": state["w"] + 1.0}, 1.0
+
+    try:
+        with pytest.raises(WatchdogTimeout):
+            run_resilient(step, {"w": jnp.zeros((2,))}, steps=10,
+                          ckpt_dir=d, step_timeout=0.1,
+                          abort_on_timeout=True, watchdog=wd)
+    finally:
+        wd.stop()
+    assert wd.stats()["timeout_count"] == 1  # escalated exactly once
+    # the hung step's final commit holds the last GOOD state (2 steps)
+    p = latest_checkpoint(d)
+    out = ckpt.load_state_dict({"step": 0, "state": {"w": jnp.zeros((2,))}},
+                               p)
+    assert out["step"] == 2
+    np.testing.assert_array_equal(np.asarray(out["state"]["w"]),
+                                  np.full((2,), 2.0))
+
+
+def test_watchdog_reset_and_stats():
+    fired = []
+    wd = CommWatchdog(poll_interval=0.02,
+                      on_timeout=lambda s, r: fired.append(s.tag))
+    wd.start()
+    try:
+        with wd.watch("fast", timeout=10):
+            pass
+        with wd.watch("slow", timeout=0.05):
+            time.sleep(0.2)
+        s = wd.stats()
+        assert s["timeout_count"] == 1 and fired == ["slow"]
+        assert s["spans_started"] == 2 and s["spans_completed"] == 2
+        assert s["active"] == 0
+        wd.reset()
+        assert wd.stats() == {"timeout_count": 0, "spans_started": 0,
+                              "spans_completed": 0, "active": 0}
+    finally:
+        wd.stop()
+
+
+# -- store retry/backoff -----------------------------------------------------
+def test_store_transient_fault_is_retried():
+    from paddle_tpu.distributed import store as store_mod
+    paddle.set_flags({"FLAGS_store_retry_base_s": 0.001})
+    st = store_mod.TCPStore(is_master=True)
+    try:
+        st.set("k", b"v")
+        store_mod.reset_retry_stats()
+        _arm("store/get:1")
+        assert st.get("k") == b"v"  # 1st attempt injected, retry succeeds
+        assert store_mod.retry_stats()["get"] == 1
+    finally:
+        _arm("")
+        st.close()
+
+
+def test_store_retry_exhaustion_raises_typed():
+    from paddle_tpu.distributed import store as store_mod
+    paddle.set_flags({"FLAGS_store_retry_base_s": 0.001,
+                      "FLAGS_store_retry_max": 2})
+    st = store_mod.TCPStore(is_master=True)
+    try:
+        st.set("k", b"v")
+        store_mod.reset_retry_stats()
+        _arm("store/get:p1.0")  # every attempt fails
+        with pytest.raises(store_mod.TransientStoreError):
+            st.get("k")
+        assert store_mod.retry_stats()["get"] == 2  # = FLAGS_store_retry_max
+    finally:
+        _arm("")
+        st.close()
+
+
+def test_store_timeout_is_typed_and_not_retried():
+    from paddle_tpu.distributed import store as store_mod
+    assert issubclass(store_mod.StoreTimeout, TimeoutError)
+    st = store_mod.TCPStore(is_master=True)
+    try:
+        store_mod.reset_retry_stats()
+        t0 = time.monotonic()
+        with pytest.raises(store_mod.StoreTimeout):
+            st.get("never-set", timeout=0.05)
+        assert time.monotonic() - t0 < 2.0  # no backoff loop on deadline
+        assert store_mod.retry_stats().get("get", 0) == 0
+    finally:
+        st.close()
+
+
+def test_store_connect_retries():
+    from paddle_tpu.distributed import store as store_mod
+    paddle.set_flags({"FLAGS_store_retry_base_s": 0.001})
+    master = store_mod.TCPStore(is_master=True)
+    try:
+        store_mod.reset_retry_stats()
+        _arm("store/connect:1")
+        client = store_mod.TCPStore(host=master.host, port=master.port,
+                                    is_master=False)
+        client.close()
+        assert store_mod.retry_stats()["connect"] == 1
+    finally:
+        _arm("")
+        master.close()
+
+
+# -- spawn-based: real process death -----------------------------------------
+def _spawn(mode, ckpt_dir, steps=None, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               FLAGS_fault_inject="")
+    env.update(extra_env or {})
+    args = [sys.executable, WORKER, mode, ckpt_dir]
+    if steps is not None:
+        args.append(str(steps))
+    return subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _result(out):
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in: {out!r}")
+
+
+def test_spawned_kill_crash_recovery(tmp_path):
+    """Hard-kill (os._exit, no atexit/flush) mid-checkpoint, respawn,
+    resume: losses match an uninterrupted spawned run exactly."""
+    golden_dir, d = str(tmp_path / "a"), str(tmp_path / "b")
+    p = _spawn("train", golden_dir)
+    out, err = p.communicate(timeout=180)
+    assert p.returncode == 0, err
+    golden = _result(out)
+
+    p = _spawn("train", d,
+               extra_env={"FLAGS_fault_inject": "ckpt/after_chunk_write:2:kill"})
+    out, err = p.communicate(timeout=180)
+    assert p.returncode == faults.FAULT_EXIT_CODE, (out, err)
+    assert checkpoint_step(latest_checkpoint(d)) == 3
+
+    p = _spawn("train", d)  # respawn, fault disarmed
+    out, err = p.communicate(timeout=180)
+    assert p.returncode == 0, err
+    resumed = _result(out)
+    assert resumed["resumed_from"].endswith("step_00000003")
+    assert resumed["w"] == golden["w"]  # bitwise (json round-trips exactly)
+    for k, v in resumed["losses"].items():
+        assert golden["losses"][k] == v
+
+
+def test_spawned_sigterm_one_final_commit_in_grace(tmp_path):
+    """Acceptance: SIGTERM during training produces exactly one final
+    committed checkpoint within the grace budget."""
+    d = str(tmp_path)
+    p = _spawn("slow", d, steps=2000)
+    try:
+        for line in iter(p.stdout.readline, ""):
+            if line.strip() == "READY":
+                break
+        time.sleep(0.4)  # let a few steps land
+        t0 = time.monotonic()
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=60)
+        elapsed = time.monotonic() - t0
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, err
+    res = _result(out)
+    assert res["preempted"] is True
+    assert 0 < res["completed"] < 2000
+    assert elapsed < 15.0  # inside the grace budget passed to the worker
+    committed = [n for n in os.listdir(d)
+                 if os.path.isfile(os.path.join(d, n, COMMIT_MARKER))]
+    assert len(committed) == 1  # exactly one final checkpoint
+    assert checkpoint_step(os.path.join(d, committed[0])) == res["completed"]
+
+
+# -- Model.fit plumbing ------------------------------------------------------
+def _fit_batches():
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 4).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.int64)
+    return [(X[i:i + 4], y[i:i + 4]) for i in range(0, 16, 4)]
+
+
+def _fresh_model():
+    paddle.seed(321)  # identical init across runs
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    return model
+
+
+def test_fit_resilient_commits_and_resumes(tmp_path):
+    batches = _fit_batches()
+    cfg = {"ckpt_dir": None, "ckpt_every": 3, "seed": 11}
+
+    # golden: straight 2-epoch run (8 steps)
+    m1 = _fresh_model()
+    m1.fit(batches, epochs=2, verbose=0,
+           resilient=dict(cfg, ckpt_dir=str(tmp_path / "a")))
+    assert checkpoint_step(latest_checkpoint(str(tmp_path / "a"))) == 8
+
+    # interrupted: 1 epoch, then a second fit resumes and finishes epoch 2
+    d = str(tmp_path / "b")
+    m2 = _fresh_model()
+    m2.fit(batches, epochs=1, verbose=0, resilient=dict(cfg, ckpt_dir=d))
+    assert checkpoint_step(latest_checkpoint(d)) == 4
+    m3 = _fresh_model()
+    m3.fit(batches, epochs=2, verbose=0, resilient=dict(cfg, ckpt_dir=d))
+    assert checkpoint_step(latest_checkpoint(d)) == 8
+
+    for k, v in m1._params.items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(m3._params[k]), err_msg=k)
+
+
+def test_fit_resilient_resumes_lr_schedule(tmp_path):
+    """Host-side optimizer state (LR scheduler position) must survive the
+    restart — otherwise a resumed warmup/decay restarts at step 0."""
+    d = str(tmp_path)
+    batches = _fit_batches()
+    paddle.seed(321)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(sched, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(batches, epochs=2, verbose=0,
+              resilient={"ckpt_dir": d, "seed": 9})
+    lr_after = model._optimizer.get_lr()  # decayed twice: 0.1 -> 0.025
+
+    paddle.seed(321)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sched2 = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    model2 = paddle.Model(net2)
+    model2.prepare(paddle.optimizer.SGD(sched2,
+                                        parameters=net2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.fit(batches, epochs=2, verbose=0,
+               resilient={"ckpt_dir": d, "seed": 9})  # resumes, trains 0
+    assert model2._optimizer.get_lr() == lr_after  # schedule not reset
+
+
+def test_fit_resilient_noop_when_fully_trained(tmp_path):
+    d = str(tmp_path)
+    batches = _fit_batches()
+    m1 = _fresh_model()
+    m1.fit(batches, epochs=1, verbose=0,
+           resilient={"ckpt_dir": d, "seed": 5})
+    w1 = {k: np.asarray(v) for k, v in m1._params.items()}
+    m2 = _fresh_model()
+    m2.fit(batches, epochs=1, verbose=0,
+           resilient={"ckpt_dir": d, "seed": 5})  # fully fast-forwarded
+    for k, v in w1.items():
+        np.testing.assert_array_equal(v, np.asarray(m2._params[k]),
+                                      err_msg=k)
