@@ -31,6 +31,7 @@ from .checkpoint import load_state_dict, save_state_dict
 from . import resilience  # noqa: F401
 from .resilience import (FaultInjected, commit_checkpoint, latest_checkpoint,
                          run_resilient)
+from . import comm_overlap  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model

@@ -1,0 +1,62 @@
+"""Overlapped + compressed gradient collectives.
+
+Bucketed, schedule-overlapped dp gradient synchronization (T3,
+arXiv:2401.16677) with an opt-in int8 error-feedback quantized all-reduce
+(EQuARX, arXiv:2506.17615). See overlap.py for the program structure,
+bucketing.py for the bucket plans, quantize.py for the wire format.
+
+Flag surface: FLAGS_comm_bucket_mb, FLAGS_comm_quantize,
+FLAGS_comm_overlap_microbatches, FLAGS_xla_latency_hiding_scheduler.
+Consumed by models.hybrid_engine.build_train_step (hybrid dp axis),
+distributed.sharding.group_sharded.build_sharded_train_step (stage-1/2
+microbatched overlap) and optimizer.gradient_merge (communicate once per
+k steps via make_merge_comm_fn).
+"""
+
+from .bucketing import (Bucket, BucketPlan, LeafSlot,  # noqa: F401
+                        build_bucket_plan, local_shape, pack_bucket,
+                        unpack_bucket)
+from .overlap import (CommOverlapConfig, config_from_flags,  # noqa: F401
+                      ef_plan_for, ef_residual_specs, init_ef_residuals,
+                      microbatched_reduced_grads, reduce_bucketed,
+                      reduce_scatter_tree)
+from .quantize import (dequantize_int8, ef_quantized_psum,  # noqa: F401
+                       quantize_int8)
+from .xla_flags import (OVERLAP_XLA_FLAGS,  # noqa: F401
+                        apply_xla_overlap_flags)
+
+__all__ = [
+    "Bucket", "BucketPlan", "LeafSlot", "build_bucket_plan", "local_shape",
+    "pack_bucket", "unpack_bucket",
+    "CommOverlapConfig", "config_from_flags", "ef_plan_for",
+    "ef_residual_specs", "init_ef_residuals", "microbatched_reduced_grads",
+    "reduce_bucketed", "reduce_scatter_tree",
+    "dequantize_int8", "ef_quantized_psum", "quantize_int8",
+    "OVERLAP_XLA_FLAGS", "apply_xla_overlap_flags", "make_merge_comm_fn",
+]
+
+
+def make_merge_comm_fn(axis, bucket_mb: float = 4.0, reduce_dtype=None,
+                       axis_size=None):
+    """Build the ``comm_fn`` for GradientMergeOptimizer: accumulate
+    locally for k steps, then ONE bucketed dp reduction of the merged
+    gradient (k-fold fewer collective launches and bytes than syncing
+    every micro step; pmean commutes with the sum, so the result is
+    identical for the full-precision path). Runs inside shard_map.
+
+    Deliberately no int8 option: error feedback needs residual state
+    carried across calls, and comm_fn is stateless — a quantized merge
+    sync would be biased every k steps with nothing correcting it. Use
+    the engine's per-step path (FLAGS_comm_quantize) for compression, or
+    reduce_dtype=bf16 here for a stateless 2x byte cut."""
+    from jax import lax
+
+    def comm_fn(merged):
+        n = axis_size if axis_size is not None else lax.axis_size(axis)
+        reduced, _ = reduce_bucketed(
+            merged, axis, axis_size=n,
+            bucket_bytes=bucket_mb * (1 << 20),
+            reduce_dtype=reduce_dtype, mean=True)
+        return reduced
+
+    return comm_fn

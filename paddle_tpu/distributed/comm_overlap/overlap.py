@@ -1,0 +1,244 @@
+"""Schedule-overlapped, bucketed dp gradient synchronization.
+
+Replaces the end-of-backward monolithic dp ``pmean`` with per-bucket
+collectives issued while compute is still in flight:
+
+* :func:`reduce_bucketed` — one fused flat collective per size-targeted
+  bucket (``FLAGS_comm_bucket_mb``); optionally int8-quantized with
+  error feedback (``FLAGS_comm_quantize=int8``, see quantize.py).
+* :func:`reduce_scatter_tree` — the ZeRO-1 form: per-leaf
+  ``psum_scatter`` over dp (each rank keeps only its update shard).
+* :func:`microbatched_reduced_grads` — gradient accumulation inside
+  ``lax.scan`` whose carry holds already-REDUCED buckets: microbatch
+  m's collectives are issued inside iteration m, so XLA's async
+  collectives + latency-hiding scheduler (``FLAGS_xla_latency_hiding_
+  scheduler``) overlap them with microbatch m+1's forward/backward —
+  the T3 (arXiv:2401.16677) fine-grained-overlap structure expressed
+  as one jitted program.
+
+Everything here runs INSIDE shard_map (explicit per-device values,
+explicit named-axis collectives) and is deterministic: same inputs, same
+program, same bits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .bucketing import (BucketPlan, build_bucket_plan, local_shape,
+                        pack_bucket, unpack_bucket)
+from .quantize import ef_quantized_psum
+
+__all__ = ["CommOverlapConfig", "config_from_flags", "reduce_bucketed",
+           "reduce_scatter_tree", "microbatched_reduced_grads",
+           "ef_plan_for", "init_ef_residuals", "ef_residual_specs"]
+
+_QUANT_MODES = ("", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommOverlapConfig:
+    """Knobs for the bucketed-overlap gradient sync.
+
+    bucket_mb: target bucket size in MB of wire bytes (<= 0: one bucket).
+    quantize: "" (full precision) or "int8" (error-feedback quantized
+        all-reduce; needs example_params at build time for the residual
+        state, and is only defined for the replicated dp path).
+    microbatches: grad-accumulation slices inside the overlap scan; 1
+        keeps the single-backward structure (buckets alone still let the
+        scheduler overlap collectives with the optimizer update).
+    reduce_dtype: wire dtype for the non-quantized path (e.g. bf16);
+        None reduces in the gradients' own dtype.
+    """
+    bucket_mb: float = 4.0
+    quantize: str = ""
+    microbatches: int = 1
+    reduce_dtype: Any = None
+
+    def __post_init__(self):
+        from ...enforce import enforce, enforce_in
+        enforce_in(self.quantize, _QUANT_MODES, op="CommOverlapConfig",
+                   name="quantize")
+        enforce(self.microbatches >= 1,
+                "microbatches must be >= 1", op="CommOverlapConfig",
+                microbatches=self.microbatches)
+
+    @property
+    def bucket_bytes(self) -> float:
+        return self.bucket_mb * (1 << 20)
+
+
+def config_from_flags() -> Optional[CommOverlapConfig]:
+    """The flag-driven opt-in: None (feature off) unless one of
+    FLAGS_comm_bucket_mb / FLAGS_comm_quantize /
+    FLAGS_comm_overlap_microbatches asks for it."""
+    from ...flags import flag
+    bmb = float(flag("comm_bucket_mb"))
+    quant = str(flag("comm_quantize") or "")
+    micro = max(int(flag("comm_overlap_microbatches")), 1)
+    if bmb <= 0 and not quant and micro <= 1:
+        return None
+    return CommOverlapConfig(bucket_mb=bmb if bmb > 0 else 0.0,
+                             quantize=quant, microbatches=micro)
+
+
+def reduce_bucketed(grads, axis, *, axis_size: int,
+                    plan: Optional[BucketPlan] = None,
+                    bucket_bytes: float = 0.0, quantize: str = "",
+                    residuals: Optional[List[jax.Array]] = None,
+                    reduce_dtype=None, weight: float = 1.0,
+                    mean: bool = True):
+    """Bucketed dp reduction of a LOCAL grad pytree (inside shard_map).
+
+    Packs each bucket into one flat buffer and issues ONE collective per
+    bucket (int8 error-feedback psum when quantize="int8"). `weight`
+    pre-scales the gradients (1/M for microbatch accumulation) BEFORE
+    quantization so residuals carry consistently-scaled error. Returns
+    ``(reduced_tree, new_residuals)``; new_residuals is None unless
+    quantized. Elementwise identical to per-leaf ``lax.pmean`` for the
+    full-precision path (psum of a concatenation == concatenation of
+    psums)."""
+    leaves, treedef = jax.tree.flatten(grads)
+    if plan is None:
+        plan = build_bucket_plan(leaves, bucket_bytes)
+    out: List[Any] = list(leaves)
+    new_residuals: Optional[List[jax.Array]] = [] if quantize else None
+    for bucket in plan.buckets:
+        if quantize == "int8":
+            flat = pack_bucket(leaves, bucket, dtype=jnp.float32)
+            if weight != 1.0:
+                flat = flat * jnp.float32(weight)
+            res = (residuals[bucket.index] if residuals is not None
+                   else jnp.zeros_like(flat))
+            red, new_res = ef_quantized_psum(
+                flat, res, axis,
+                mean_divisor=float(axis_size) if mean else 1.0)
+            new_residuals.append(new_res)
+        else:
+            flat = pack_bucket(leaves, bucket, dtype=reduce_dtype)
+            if weight != 1.0:
+                flat = flat * jnp.asarray(weight, flat.dtype)
+            red = lax.psum(flat, axis)
+            if mean:
+                red = red / axis_size
+        for leaf_index, piece in unpack_bucket(red, bucket):
+            out[leaf_index] = piece
+    return jax.tree.unflatten(treedef, out), new_residuals
+
+
+def reduce_scatter_tree(grads, zdims, axis, *, axis_size: int,
+                        reduce_dtype=None, weight: float = 1.0,
+                        mean: bool = True):
+    """ZeRO-1 gradient reduction: psum_scatter each leaf over `axis`
+    along its shard dim (zdim < 0: plain pmean — tiny replicated leaves).
+    Same per-leaf semantics as the hybrid engine's monolithic pass, but
+    callable per microbatch inside the overlap scan so the scatters hide
+    under the next microbatch's compute. None grads pass through."""
+    def one(g, zd):
+        if g is None:
+            return None
+        gr = g.astype(reduce_dtype) if reduce_dtype is not None else g
+        if weight != 1.0:
+            gr = gr * jnp.asarray(weight, gr.dtype)
+        if zd < 0:
+            red = lax.pmean(gr, axis) if mean else lax.psum(gr, axis)
+        else:
+            red = lax.psum_scatter(gr, axis, scatter_dimension=zd,
+                                   tiled=True)
+            if mean:
+                red = red / axis_size
+        return red.astype(g.dtype)
+
+    return jax.tree.map(one, grads, zdims,
+                        is_leaf=lambda x: x is None)
+
+
+def microbatched_reduced_grads(loss_fn: Callable, params,
+                               batch_args: Sequence[jax.Array],
+                               num_microbatches: int,
+                               reduce_fn: Callable,
+                               residuals=None):
+    """Gradient accumulation with in-scan bucket reduction.
+
+    Splits each batch arg's leading (local-batch) dim into
+    `num_microbatches` slices and scans; every iteration computes that
+    microbatch's grads and immediately reduces them via
+    ``reduce_fn(grads, residuals) -> (reduced, new_residuals)`` (which
+    must fold the 1/M weight), accumulating the REDUCED result into an
+    fp32 carry. The collectives of iteration m sit in the program before
+    iteration m+1's compute — exactly the structure the latency-hiding
+    scheduler overlaps. Returns ``(mean_loss, grads, new_residuals)``
+    with grads cast back to their original dtypes."""
+    from ...enforce import enforce
+    M = int(num_microbatches)
+    b = batch_args[0].shape[0]
+    enforce(M >= 1 and b % M == 0,
+            "comm-overlap microbatches must divide the local batch",
+            op="comm_overlap.microbatched_reduced_grads", batch=b,
+            microbatches=M)
+    vg = jax.value_and_grad(lambda p, *a: loss_fn(p, *a))
+
+    def one(mb_args, res):
+        loss, g = vg(params, *mb_args)
+        red, res = reduce_fn(g, res)
+        return loss, red, res
+
+    if M == 1:
+        loss, red, res = one(tuple(batch_args), residuals)
+        return loss, red, res
+
+    slices = tuple(a.reshape((M, b // M) + a.shape[1:]) for a in batch_args)
+    # carry structure via ABSTRACT eval — peeling a real first microbatch
+    # out of the scan would compile the fwd/bwd body twice
+    loss_sh, red_sh, _ = jax.eval_shape(one, tuple(s[0] for s in slices),
+                                        residuals)
+    acc0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, jnp.float32),
+                        red_sh)
+
+    def body(carry, mb):
+        acc, res, lsum = carry
+        loss, red, res = one(mb, res)
+        acc = jax.tree.map(lambda a, r: a + r.astype(jnp.float32), acc, red)
+        return (acc, res, lsum + loss), None
+
+    (acc, res, lsum), _ = lax.scan(
+        body, (acc0, residuals, jnp.zeros((), loss_sh.dtype)), slices)
+    grads = jax.tree.map(lambda a, sd: a.astype(sd.dtype), acc, red_sh)
+    return lsum / M, grads, res
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback residual state (persists across steps; threaded by the
+# hybrid engine as opt_state["comm_ef"]).
+# ---------------------------------------------------------------------------
+def ef_plan_for(example_params, specs, mesh,
+                bucket_bytes: float) -> BucketPlan:
+    """Bucket plan over the LOCAL (per-device shard) gradient shapes — the
+    shapes reduce_bucketed actually sees inside shard_map. Built once at
+    build time so the residual state and the traced program agree."""
+    leaves, treedef = jax.tree.flatten(example_params)
+    spec_leaves = treedef.flatten_up_to(specs)
+    local = [jax.ShapeDtypeStruct(local_shape(p.shape, s, mesh), p.dtype)
+             for p, s in zip(leaves, spec_leaves)]
+    return build_bucket_plan(local, bucket_bytes)
+
+
+def ef_residual_specs(plan: BucketPlan, mesh) -> List[P]:
+    """Residuals are fully device-varying (each rank's rounding error):
+    one flat leading dim sharded over EVERY mesh axis."""
+    return [P(tuple(mesh.axis_names))] * plan.n_buckets
+
+
+def init_ef_residuals(plan: BucketPlan, mesh) -> List[jax.Array]:
+    n_dev = int(mesh.devices.size)
+    out = []
+    for bucket, spec in zip(plan.buckets, ef_residual_specs(plan, mesh)):
+        arr = jnp.zeros((n_dev * bucket.size,), jnp.float32)
+        out.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+    return out

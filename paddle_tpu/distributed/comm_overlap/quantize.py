@@ -1,0 +1,60 @@
+"""int8 quantized all-reduce with error feedback (EQuARX-style,
+arXiv:2506.17615; also the DGC/1-bit-Adam error-feedback discipline).
+
+Per-bucket symmetric int8 quantization of the dp gradient all-reduce:
+
+* the bucket scale is AGREED across the axis first (pmax of local absmax)
+  so every rank quantizes onto the same grid and the int32 psum of codes
+  dequantizes exactly;
+* the quantization error stays on each rank as an fp32 RESIDUAL that is
+  added back into the next reduction (error feedback) — the long-run
+  update is unbiased, which is what keeps loss curves inside tolerance;
+* master accumulation stays fp32 end to end: only the wire format is int8
+  (a 4x byte cut vs fp32, 2x vs bf16 — EQuARX reports negligible loss
+  impact at this operating point).
+
+Runs inside shard_map (explicit collectives over a named axis).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["quantize_int8", "dequantize_int8", "ef_quantized_psum"]
+
+_QMAX = 127.0
+
+
+def quantize_int8(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Symmetric round-to-nearest onto the int8 grid `scale * [-127, 127]`."""
+    return jnp.clip(jnp.round(x / scale), -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def ef_quantized_psum(flat: jax.Array, residual: jax.Array, axis,
+                      mean_divisor: float = 1.0):
+    """Error-feedback int8 all-reduce of one flat fp32 bucket.
+
+    Returns ``(reduced, new_residual)`` where reduced is the fp32
+    cross-axis SUM of the (residual-corrected) inputs divided by
+    `mean_divisor`, and new_residual holds this rank's quantization error
+    for the next call. The int32 psum of codes is exact for axis sizes up
+    to 2^24 ranks, so the only loss is each rank's local rounding — which
+    the residual recovers on the next reduction."""
+    x = flat.astype(jnp.float32) + residual
+    absmax = jnp.max(jnp.abs(x))
+    # one scalar pmax per bucket: every rank must quantize onto the SAME
+    # grid or the summed codes would be meaningless
+    shared = lax.pmax(absmax, axis)
+    scale = jnp.maximum(shared, jnp.finfo(jnp.float32).tiny) / _QMAX
+    q = quantize_int8(x, scale)
+    new_residual = x - dequantize_int8(q, scale)
+    summed = lax.psum(q.astype(jnp.int32), axis)
+    reduced = summed.astype(jnp.float32) * scale / mean_divisor
+    return reduced, new_residual

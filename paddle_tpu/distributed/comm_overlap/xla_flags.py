@@ -1,0 +1,19 @@
+"""XLA async-collective / latency-hiding-scheduler knobs.
+
+The bucketed-overlap program (overlap.py) only EXPOSES the opportunity:
+per-bucket collectives sit in the HLO before later microbatches' compute.
+Whether they actually run concurrently is the scheduler's call — these
+libtpu/XLA flags turn the latency-hiding scheduler and async collective
+fusion on. They must reach the process environment BEFORE the first jax
+computation initializes the backend, which is why the canonical binding
+lives in ``paddle_tpu.flags`` (``FLAGS_xla_latency_hiding_scheduler``, a
+leaf module importable at bootstrap); this module re-exports the helper
+for direct callers.
+"""
+
+from __future__ import annotations
+
+from ...flags import (OVERLAP_XLA_FLAGS,  # noqa: F401
+                      apply_xla_overlap_flags)
+
+__all__ = ["OVERLAP_XLA_FLAGS", "apply_xla_overlap_flags"]

@@ -16,7 +16,8 @@ import os
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag",
+           "OVERLAP_XLA_FLAGS", "apply_xla_overlap_flags"]
 
 _REGISTRY: Dict[str, "_Flag"] = {}
 _LOCK = threading.RLock()
@@ -346,6 +347,70 @@ define_flag("fault_inject", "",
             "disarms every site. Sites are documented in "
             "distributed/resilience/faults.py (bound to faults.configure).",
             on_set=_bind_fault_inject)
+
+# --- gradient-collective overlap / compression -----------------------------
+# (consumed by distributed.comm_overlap + models.hybrid_engine +
+# distributed.sharding.group_sharded; see README "Performance")
+define_flag("comm_bucket_mb", 0.0,
+            "Bucket size (MB) for bucketed dp gradient collectives: the "
+            "grad pytree is packed into flat buckets of this many wire "
+            "bytes and each bucket reduces as ONE collective, issued "
+            "early enough for the latency-hiding scheduler to overlap it "
+            "with compute. <= 0 disables bucketing (monolithic pmean) "
+            "unless comm_quantize/comm_overlap_microbatches engage the "
+            "overlap path, which then uses a single bucket (consumed by "
+            "comm_overlap.config_from_flags).")
+define_flag("comm_quantize", "",
+            "Opt-in wire compression for the dp gradient all-reduce: "
+            "'int8' = per-bucket-scaled int8 with error-feedback "
+            "residuals (EQuARX-style; fp32 master accumulation). Empty = "
+            "full precision. Replicated dp path only — ZeRO-1 "
+            "reduce-scatter refuses it (consumed by "
+            "comm_overlap.config_from_flags).")
+define_flag("comm_overlap_microbatches", 1,
+            "Gradient-accumulation microbatches inside the overlap scan: "
+            "each microbatch's bucket collectives issue while later "
+            "microbatches still compute. 1 keeps a single backward "
+            "(consumed by comm_overlap.config_from_flags and "
+            "group_sharded.build_sharded_train_step).")
+
+# async-collective / latency-hiding scheduler knobs: the overlap program
+# exposes the opportunity; these make XLA take it. Env must be written
+# BEFORE the first jax computation initializes the backend.
+OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def apply_xla_overlap_flags(enabled: bool, env=None) -> None:
+    """Append the overlap scheduler flags to LIBTPU_INIT_ARGS. Idempotent,
+    and a flag NAME already present (either value — e.g. an explicit
+    ...=false from the operator) is left untouched. Disabling does not
+    scrub flags already consumed by an initialized backend — it only
+    stops adding them."""
+    if not enabled:
+        return
+    env = os.environ if env is None else env
+    current = env.get("LIBTPU_INIT_ARGS", "")
+    present = {tok.split("=", 1)[0] for tok in current.split()}
+    missing = [f for f in OVERLAP_XLA_FLAGS
+               if f.split("=", 1)[0] not in present]
+    if missing:
+        env["LIBTPU_INIT_ARGS"] = " ".join(
+            ([current] if current else []) + missing)
+
+
+define_flag("xla_latency_hiding_scheduler", False,
+            "Turn on XLA's latency-hiding scheduler + async collective "
+            "fusion (LIBTPU_INIT_ARGS; must be set before the first jax "
+            "computation). Pairs with FLAGS_comm_bucket_mb so the "
+            "per-bucket collectives actually hide under backward "
+            "compute.", on_set=apply_xla_overlap_flags)
 
 # --- data / io -------------------------------------------------------------
 define_flag("dataloader_num_workers", 0,

@@ -212,11 +212,22 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 epoch_logs = {}
-                for step, batch in enumerate(loader):
-                    if (res is not None and epoch == skip_epochs
-                            and step < skip_batches):
-                        global_step += 1
-                        continue
+                # resilient fast-forward runs on the RAW loader, BEFORE
+                # the prefetch wrapper: skipped batches must not pay a
+                # host->device transfer just to be dropped
+                batches = iter(loader)
+                epoch_skip = (skip_batches if res is not None
+                              and epoch == skip_epochs else 0)
+                for _ in range(epoch_skip):
+                    next(batches, None)
+                    global_step += 1
+                # device double-buffering: the next batch's host->device
+                # DMA rides under the current step's compute (async
+                # device_put) instead of serializing before each dispatch
+                from ..io import prefetch_to_device
+                for step, batch in enumerate(
+                        prefetch_to_device(batches, size=2),
+                        start=epoch_skip):
                     cbks.on_train_batch_begin(step)
                     inputs, labels = self._split_batch(batch)
                     lr = self._optimizer.get_lr()

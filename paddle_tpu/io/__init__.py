@@ -10,7 +10,8 @@ the bottleneck is HBM/compute, not Python, so process pools are optional
 
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset, random_split)
-from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .dataloader import (DataLoader, default_collate_fn, get_worker_info,
+                         prefetch_to_device)
 from .token_loader import TokenFileLoader
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
@@ -20,6 +21,7 @@ __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "DataLoader", "default_collate_fn", "get_worker_info",
+    "prefetch_to_device",
     "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
     "DistributedBatchSampler", "WeightedRandomSampler", "SubsetRandomSampler",
     "TokenFileLoader",

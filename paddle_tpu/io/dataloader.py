@@ -15,7 +15,8 @@ from ..enforce import InvalidTypeError
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info",
+           "prefetch_to_device"]
 
 _worker_info = threading.local()
 
@@ -50,6 +51,52 @@ def default_collate_fn(batch):
         return np.stack([np.asarray(b) for b in batch])
     except Exception:
         return list(batch)
+
+
+def _is_device_puttable(leaf):
+    import jax
+    return isinstance(leaf, (np.ndarray, np.generic, jax.Array))
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Device double-buffering: keep `size` batches' host->device
+    transfers in flight ahead of consumption.
+
+    ``jax.device_put`` is asynchronous — it returns immediately with the
+    DMA enqueued — so holding a small deque of already-put batches means
+    the NEXT batch's transfer rides under the CURRENT step's compute
+    instead of serializing before the dispatch (the input-pipeline
+    equivalent of the comm_overlap gradient schedule). Array leaves
+    (numpy / jax) are transferred, to `sharding` when given; non-array
+    leaves (strings, python scalars) pass through untouched.
+
+    Used by hapi.Model.fit and bench.py; wrap any batch iterator:
+        for batch in prefetch_to_device(loader, size=2): ...
+    """
+    import collections
+
+    import jax
+
+    from ..enforce import enforce_ge
+    enforce_ge(size, 1, op="prefetch_to_device", name="size")
+
+    def put(batch):
+        return jax.tree.map(
+            lambda leaf: (jax.device_put(leaf, sharding)
+                          if _is_device_puttable(leaf) else leaf), batch)
+
+    it = iter(iterator)
+    buf = collections.deque()
+    done = False
+    while True:
+        while not done and len(buf) < size:
+            try:
+                buf.append(put(next(it)))
+            except StopIteration:
+                done = True
+        if not buf:
+            return
+        yield buf.popleft()
 
 
 class DataLoader:

@@ -11,7 +11,9 @@ Two executions of the same architecture:
 * ``hybrid`` engine — functional stacked-parameter form for the explicit
   SPMD path: vocab-parallel embedding + Megatron TP inside each block (over
   'mp'), scan+ppermute pipeline over 'pp' (spmd_pipeline), dp gradient
-  pmean, all inside ONE shard_map/jit program. This is the TPU-native
+  sync (monolithic pmean, or bucketed/overlapped/int8-quantized via
+  distributed.comm_overlap — FLAGS_comm_bucket_mb et al.), all inside
+  ONE shard_map/jit program. This is the TPU-native
   equivalent of the reference's PipelineParallel+TensorParallel meta_parallel
   stack (fleet/meta_parallel/pipeline_parallel.py:547,
   fleet/layers/mpu/mp_layers.py).
@@ -524,9 +526,9 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, schedule: str = "1F1B",
                             grad_reduce_dtype="auto",
-                            zero1_dp: bool = False):
+                            zero1_dp: bool = False, comm_overlap="auto"):
     """Compile the full hybrid train step: one program containing embedding,
-    pipelined blocks, vocab-parallel loss, backward, dp grad pmean and the
+    pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
 
     virtual_pp > 1 selects the interleaved schedule; shard_params then
@@ -534,6 +536,13 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     saved from these sharded params are in that layout — reload through
     the same shard_params). schedule="ZBH1" selects the zero-bubble
     pipeline (what PipelineZeroBubblePass sets on a TrainSpec).
+
+    comm_overlap: "auto" (flag-driven, default off) / None /
+    CommOverlapConfig — replaces the monolithic end-of-backward dp pmean
+    with bucketed, schedule-overlapped (optionally int8 error-feedback)
+    collectives; see hybrid_engine.build_train_step. When the overlap
+    scan accumulates over its own microbatches, the per-dp-rank batch
+    must divide comm microbatches x pipeline num_microbatches.
     """
     from .hybrid_engine import build_train_step
 
@@ -547,7 +556,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
-        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp)
+        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
+        comm_overlap=comm_overlap)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

@@ -1,10 +1,12 @@
 """Generic hybrid-parallel train-step builder shared by the model families.
 
 Compiles ONE program containing: forward (vocab-parallel embed, pipelined
-blocks, TP collectives), backward, dp gradient pmean, and the optimizer
-update — the TPU-native equivalent of the reference's per-strategy wrapper
-stack (fleet/meta_parallel/*). Model files supply a per-device loss_fn and a
-PartitionSpec tree; XLA schedules every collective over ICI.
+blocks, TP collectives), backward, dp gradient sync (monolithic pmean, or
+the distributed.comm_overlap bucketed/overlapped/int8 schedule), and the
+optimizer update — the TPU-native equivalent of the reference's
+per-strategy wrapper stack (fleet/meta_parallel/*). Model files supply a
+per-device loss_fn and a PartitionSpec tree; XLA schedules every
+collective over ICI.
 """
 
 from __future__ import annotations
@@ -173,7 +175,8 @@ def _global_clip_scale(red, leaves_spec, leaves_z, mesh: Mesh, dp_axis,
 def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
-                     grad_reduce_dtype="auto", zero1_dp: bool = False):
+                     grad_reduce_dtype="auto", zero1_dp: bool = False,
+                     comm_overlap="auto"):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -195,7 +198,20 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     Reference: DygraphShardingOptimizer (stage 1) under
     HybridParallelOptimizer. Requires the per-leaf optimizer protocol
     (AdamW-family; name filters ride the ctx protocol) and supports
-    ClipGradByGlobalNorm/ByValue."""
+    ClipGradByGlobalNorm/ByValue.
+
+    comm_overlap: bucketed, schedule-overlapped dp gradient collectives
+    (distributed.comm_overlap) replacing the monolithic end-of-backward
+    reduction — per-bucket psum (replicated) / per-leaf psum_scatter
+    (zero1_dp), optionally issued per accumulation microbatch inside a
+    lax.scan so they hide under later microbatches' compute, and
+    optionally int8-quantized with error-feedback residuals (threaded as
+    opt_state["comm_ef"]; needs example_params; replicated path only).
+    "auto" reads FLAGS_comm_bucket_mb / FLAGS_comm_quantize /
+    FLAGS_comm_overlap_microbatches (all default off); pass a
+    CommOverlapConfig to force, or None to disable. Self-synchronizing
+    optimizers (_skips_grad_sync) own the dp axis, so overlap is inert
+    for them — pair them with comm_overlap.make_merge_comm_fn instead."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -220,6 +236,35 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     else:
         sspec = state_specs_for(optimizer, specs, example_params)
 
+    # -- bucketed/overlapped dp gradient collectives -------------------------
+    from ..distributed import comm_overlap as _co
+    skips_dp = getattr(optimizer, "_skips_grad_sync", False)
+    ocfg = _co.config_from_flags() if comm_overlap == "auto" else comm_overlap
+    if ocfg is not None and skips_dp:
+        # LocalSGD/DGC/GradientMerge(comm_fn=...) own the dp axis — there
+        # is no per-step dp reduction here to bucket or quantize
+        ocfg = None
+    ef_plan = None
+    if ocfg is not None and ocfg.quantize:
+        from ..enforce import enforce
+        enforce(not zero1_dp,
+                "comm_quantize=int8 is the replicated all-reduce path; "
+                "zero1_dp reduce-scatters shards whose codes cannot share "
+                "a bucket scale — disable one of the two",
+                op="build_train_step")
+        enforce(example_params is not None,
+                "comm_quantize=int8 needs example_params (the "
+                "error-feedback residual state is sized from the local "
+                "gradient shapes at build time)", op="build_train_step")
+        ef_plan = _co.ef_plan_for(example_params, specs, mesh,
+                                  ocfg.bucket_bytes)
+    opt_sspec = sspec
+    if ef_plan is not None:
+        # residuals ride the optimizer state so the step signature and
+        # checkpoint surface stay (params, state, batch..., lr)
+        sspec = {"opt": opt_sspec,
+                 "comm_ef": _co.ef_residual_specs(ef_plan, mesh)}
+
     def shard_params(params):
         return jax.tree.map(
             lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
@@ -228,18 +273,24 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     def init_state(params):
         # zeros_like under jit preserves input shardings; zero1 pins the
         # state to its dp-sharded specs instead (1/dp per-chip moments)
-        return jax.jit(
+        inner = jax.jit(
             optimizer.init_state,
             out_shardings=jax.tree.map(
-                lambda s: NamedSharding(mesh, s), sspec))(params)
+                lambda s: NamedSharding(mesh, s), opt_sspec))(params)
+        if ef_plan is not None:
+            return {"opt": inner,
+                    "comm_ef": _co.init_ef_residuals(ef_plan, mesh)}
+        return inner
 
-    def _zero1_apply(params, grads, opt_state, lr):
+    def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
         """Per-leaf ZeRO-1 update inside shard_map: reduce-scatter the
         leaf's grad over dp, update only this rank's param/state shard,
         all-gather the new params. Leaves with no dp-shardable dim stay
         replicated (pmean + full update). The per-leaf name/ctx/rng
         protocol comes from Optimizer._leaf_items (one implementation
-        across every per-leaf loop)."""
+        across every per-leaf loop). pre_reduced=True: grads arrived
+        already scattered/averaged (the comm_overlap scan reduced them
+        under backward) — skip pass 1's collectives."""
         from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
 
         dp = mesh.shape[dp_axis]
@@ -251,22 +302,26 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         leaves_spec = treedef.flatten_up_to(specs)
 
         # pass 1: reduce grads (scatter where dp-sharded)
-        red = []
         clip = optimizer._grad_clip
-        for (p, g, s, ctx, rng), zd in zip(items, leaves_z):
-            if g is None:
-                red.append(None)
-                continue
-            if extra_grad_axes:
-                g = lax.pmean(g, tuple(extra_grad_axes))
-            gr = g.astype(grad_reduce_dtype) \
-                if grad_reduce_dtype is not None else g
-            if zd < 0:
-                gm = lax.pmean(gr, dp_axis).astype(g.dtype)
-            else:
-                gm = (lax.psum_scatter(gr, dp_axis, scatter_dimension=zd,
-                                       tiled=True) / dp).astype(g.dtype)
-            red.append(gm)
+        if pre_reduced:
+            red = [g for (_, g, _, _, _) in items]
+        else:
+            red = []
+            for (p, g, s, ctx, rng), zd in zip(items, leaves_z):
+                if g is None:
+                    red.append(None)
+                    continue
+                if extra_grad_axes:
+                    g = lax.pmean(g, tuple(extra_grad_axes))
+                gr = g.astype(grad_reduce_dtype) \
+                    if grad_reduce_dtype is not None else g
+                if zd < 0:
+                    gm = lax.pmean(gr, dp_axis).astype(g.dtype)
+                else:
+                    gm = (lax.psum_scatter(gr, dp_axis,
+                                           scatter_dimension=zd,
+                                           tiled=True) / dp).astype(g.dtype)
+                red.append(gm)
 
         scale = None
         if isinstance(clip, ClipGradByGlobalNorm):
@@ -303,22 +358,70 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 {"step": step_no,
                  "slots": jax.tree.unflatten(treedef, new_s)})
 
+    def _overlap_grads(params, tokens, labels, residuals):
+        """Bucketed/overlapped dp gradient path: grads come back already
+        dp-REDUCED (and scattered under zero1), with each microbatch's
+        per-bucket collectives issued inside the accumulation scan."""
+        dp = mesh.shape[dp_axis]
+        extra_axes = tuple(extra_grad_axes)
+        weight = 1.0 / ocfg.microbatches
+        # config's own wire dtype wins; fall back to the engine-level
+        # grad_reduce_dtype (fleet fp16_allreduce) when unset
+        wire_dtype = (ocfg.reduce_dtype if ocfg.reduce_dtype is not None
+                      else grad_reduce_dtype)
+
+        def reduce_fn(g, res):
+            if extra_axes:
+                # sep/context-parallel partial grads combine in their own
+                # dtype, exactly as the monolithic path does
+                g = jax.tree.map(lambda x: lax.pmean(x, extra_axes), g)
+            if zero1_dp:
+                red = _co.reduce_scatter_tree(
+                    g, zdims, dp_axis, axis_size=dp,
+                    reduce_dtype=wire_dtype, weight=weight)
+                return red, res
+            return _co.reduce_bucketed(
+                g, dp_axis, axis_size=dp, plan=ef_plan,
+                bucket_bytes=ocfg.bucket_bytes, quantize=ocfg.quantize,
+                residuals=res,
+                reduce_dtype=(None if ocfg.quantize else wire_dtype),
+                weight=weight)
+
+        return _co.microbatched_reduced_grads(
+            lambda p, t, l: loss_fn(p, t, l), params, (tokens, labels),
+            ocfg.microbatches, reduce_fn, residuals=residuals)
+
     def local_step(params, opt_state, tokens, labels, lr):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, labels))(params)
-        if zero1_dp:
-            new_params, new_state = _zero1_apply(params, grads, opt_state,
-                                                 lr)
+        ef = None
+        if ef_plan is not None:
+            ef, opt_state = opt_state["comm_ef"], opt_state["opt"]
+
+        def rewrap(new_params, new_state, new_ef, loss):
+            if ef_plan is not None:
+                new_state = {"opt": new_state, "comm_ef": new_ef}
             return new_params, new_state, loss
+
+        if ocfg is not None:
+            loss, grads, ef = _overlap_grads(params, tokens, labels, ef)
+            if zero1_dp:
+                new_params, new_state = _zero1_apply(
+                    params, grads, opt_state, lr, pre_reduced=True)
+                return rewrap(new_params, new_state, ef, loss)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, tokens, labels))(params)
+            if zero1_dp:
+                new_params, new_state = _zero1_apply(params, grads,
+                                                     opt_state, lr)
+                return rewrap(new_params, new_state, ef, loss)
         # dp gradient reduction (the EagerReducer equivalent — one pmean,
         # fused and overlapped by XLA). Self-synchronizing optimizers
         # (LocalSGD/DGC: _skips_grad_sync) own the dp axis but NOT the
         # extra axes (sep/context-parallel partial grads must always be
         # combined — skipping them would train on wrong gradients).
-        skips_dp = getattr(optimizer, "_skips_grad_sync", False)
         dp_axes = () if skips_dp else (dp_axis,)
         extra_axes = tuple(extra_grad_axes)
-        if dp_axes or extra_axes:
+        if ocfg is None and (dp_axes or extra_axes):
             def reduce_one(g):
                 # extra axes (sep/context-parallel) combine genuinely
                 # PARTIAL gradients — always in the grad's own dtype; the
@@ -392,9 +495,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             step_no = opt_state["step"] + 1
             new_p, new_slots = optimizer._apply_leaves(
                 params, grads, opt_state["slots"], lr, step_no)
-            return new_p, {"step": step_no, "slots": new_slots}, loss
+            return rewrap(new_p, {"step": step_no, "slots": new_slots},
+                          ef, loss)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
-        return new_params, new_state, loss
+        return rewrap(new_params, new_state, ef, loss)
 
     step = _shard_map(
         local_step, mesh=mesh,

@@ -23,16 +23,38 @@ __all__ = ["GradientMergeOptimizer"]
 
 class GradientMergeOptimizer:
     """Wraps any functional optimizer (init_state/apply) with k-step
-    gradient accumulation."""
+    gradient accumulation. The accumulator is ALWAYS fp32, whatever the
+    param/grad dtype — k bf16 adds of k-times-smaller micrograds lose
+    low bits every step and the merged update drifts from the big-batch
+    gradient (regression-tested against one big batch).
 
-    def __init__(self, inner, k_steps: int, avg: bool = True):
+    comm_fn: accumulate-locally / communicate-once-per-k-steps. When set,
+    the train engine hands this wrapper dp-UNreduced local gradients
+    (`_skips_grad_sync`), they accumulate locally for k_steps, and
+    comm_fn (e.g. ``comm_overlap.make_merge_comm_fn(dp_axis)`` — one
+    bucketed reduction of the merged grad) runs on the merged gradient
+    only when the inner update fires: 1/k the collective launches and
+    bytes, identical math for the full-precision path (the dp mean
+    commutes with the k-step sum)."""
+
+    def __init__(self, inner, k_steps: int, avg: bool = True,
+                 comm_fn=None):
         enforce_ge(k_steps, 1, op="GradientMergeOptimizer",
                    name="k_steps")
         self._inner = inner
         self.k_steps = int(k_steps)
         self.avg = avg
+        self._comm_fn = comm_fn
         self._eager_count = 0
         self._eager_acc = None
+
+    @property
+    def _skips_grad_sync(self):
+        # with a comm_fn the wrapper owns the dp reduction (at merge
+        # time); otherwise inherit the inner's behavior (False for the
+        # standard family)
+        return (self._comm_fn is not None
+                or getattr(self._inner, "_skips_grad_sync", False))
 
     # the hybrid optimizer swaps _grad_clip; it must land on the optimizer
     # that actually applies it (the inner), not shadow it on this wrapper
@@ -47,6 +69,8 @@ class GradientMergeOptimizer:
     def init_state(self, params):
         return {
             "inner": self._inner.init_state(params),
+            # fp32 accumulator regardless of param/grad dtype — see class
+            # docstring (bf16 accumulation loses the k-step tail bits)
             "acc": jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params),
             "count": jnp.zeros((), jnp.int32),
@@ -61,6 +85,9 @@ class GradientMergeOptimizer:
         def do_update(_):
             scale = 1.0 / k if self.avg else 1.0
             merged = jax.tree.map(lambda a: a * scale, acc)
+            if self._comm_fn is not None:
+                # the once-per-k-steps sync of the merged gradient
+                merged = self._comm_fn(merged)
             new_params, new_inner = self._inner.apply(
                 params, merged, state["inner"], lr)
             zeroed = jax.tree.map(jnp.zeros_like, acc)

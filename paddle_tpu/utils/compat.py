@@ -6,7 +6,26 @@ import functools
 
 import jax
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "axis_size"]
+
+
+def axis_size(axis_name):
+    """jax.lax.axis_size across versions: newer jax exposes it directly;
+    on 0.4.x the bound frame comes from jax.core.axis_frame (which
+    already returns the size as an int there)."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= axis_size(a)
+        return n
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+# every engine/model file calls lax.axis_size at trace time; fill it in
+# on jax versions that predate the public accessor
+if not hasattr(jax.lax, "axis_size"):
+    jax.lax.axis_size = axis_size
 
 
 def shard_map(f=None, *, mesh, in_specs, out_specs, check=False, **kwargs):

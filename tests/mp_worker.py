@@ -102,9 +102,9 @@ def run_checkpoint_roundtrip(mesh, params, path):
     # metadata) before anyone reads
     multihost_utils.sync_global_devices("mp_worker_ckpt_saved")
     full = load_full_state_dict(path)["params"]
-    flat_full = dict(jax.tree.leaves_with_path(full))
+    flat_full = dict(jax.tree_util.tree_leaves_with_path(full))
     ok = True
-    for pth, v in jax.tree.leaves_with_path(sd["params"]):
+    for pth, v in jax.tree_util.tree_leaves_with_path(sd["params"]):
         whole = np.asarray(flat_full[pth])
         for shard in v.addressable_shards:
             if not np.array_equal(np.asarray(jax.device_get(shard.data)),

@@ -1,0 +1,116 @@
+"""Donation guard (ISSUE 2 satellite): the sharded train step must donate
+params + optimizer state, so the overlap path's extra buffers (fp32
+accumulators, EF residuals) can't silently double HBM — without donation
+XLA keeps the input AND output copies of every param/moment live across
+the step boundary.
+
+Asserted via the compiled executable's input/output aliasing (the
+compiled-HLO form of jit's donate_argnums) rather than donation warnings,
+which the CPU backend does not always emit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import comm_overlap as co
+from paddle_tpu.distributed.sharding.group_sharded import \
+    build_sharded_train_step
+
+
+def _job():
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(64, 32).astype(np.float32)),
+              "b": jnp.zeros((32,), jnp.float32)}
+    xs = jnp.asarray(rng.randn(16, 64).astype(np.float32))
+    ys = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+
+    def loss_fn(p, x, y):
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    return params, xs, ys, loss_fn
+
+
+def _aliased_bytes(compiled):
+    """Donated input bytes of a compiled executable: prefer
+    memory_analysis (exact), fall back to parsing input_output_alias out
+    of the compiled HLO (always present when donation took effect)."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None and getattr(ma, "alias_size_in_bytes", 0):
+            return int(ma.alias_size_in_bytes)
+    except Exception:
+        pass
+    txt = compiled.as_text()
+    return (1 << 20) if "input_output_alias" in txt else 0
+
+
+def _param_state_bytes(p, st):
+    return sum(x.nbytes for x in jax.tree.leaves((p, st)))
+
+
+def test_sharded_train_step_donates_params_and_state():
+    mesh = dist.build_mesh({"sharding": 8})
+    params, xs, ys, loss_fn = _job()
+    opt = paddle.optimizer.AdamW(1e-3)
+    step, place, compile_for = build_sharded_train_step(
+        loss_fn, opt, mesh, level="os_g", data_axes=("sharding",))
+    p, st = place(params)
+    jstep, batch_sharding = compile_for(p)
+    xs_s = jax.device_put(xs, batch_sharding)
+    ys_s = jax.device_put(ys, batch_sharding)
+    compiled = jstep.lower(p, st, xs_s, ys_s,
+                           jnp.float32(1e-3)).compile()
+    aliased = _aliased_bytes(compiled)
+    assert aliased > 0, "params/opt state are NOT donated"
+    # donation must actually take: inputs are consumed by the call
+    out = jstep(p, st, xs_s, ys_s, jnp.float32(1e-3))
+    jax.block_until_ready(out)
+    assert all(x.is_deleted() for x in jax.tree.leaves(p)), \
+        "donated params still alive after the step"
+
+
+def test_sharded_microbatched_overlap_step_still_donates():
+    """The overlap path adds fp32 scan accumulators; donation of params +
+    state must survive it (the whole point of the guard)."""
+    mesh = dist.build_mesh({"sharding": 8})
+    params, xs, ys, loss_fn = _job()
+    opt = paddle.optimizer.AdamW(1e-3)
+    step, place, compile_for = build_sharded_train_step(
+        loss_fn, opt, mesh, level="os_g", data_axes=("sharding",),
+        microbatches=4)
+    p, st = place(params)
+    jstep, batch_sharding = compile_for(p)
+    compiled = jstep.lower(p, st, jax.device_put(xs, batch_sharding),
+                           jax.device_put(ys, batch_sharding),
+                           jnp.float32(1e-3)).compile()
+    assert _aliased_bytes(compiled) > 0
+
+
+def test_hybrid_overlap_step_memory_sane():
+    """hybrid engine + EF residuals: compiled peak stays within a small
+    multiple of params+state+grads (no silent HBM doubling from the
+    overlap buffers)."""
+    mesh = dist.build_mesh({"dp": 8})
+    params, xs, ys, loss_fn = _job()
+    specs = {"w": P(), "b": P()}
+    from paddle_tpu.models.hybrid_engine import build_train_step
+    opt = paddle.optimizer.AdamW(1e-3)
+    step, shard, init = build_train_step(
+        loss_fn, specs, mesh, opt,
+        comm_overlap=co.CommOverlapConfig(bucket_mb=1e-4, quantize="int8"),
+        example_params=jax.eval_shape(lambda: params))
+    p = shard(params)
+    st = init(p)
+    compiled = step.lower(p, st, xs, ys, jnp.float32(1e-3)).compile()
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is None or not getattr(ma, "temp_size_in_bytes", 0):
+        import pytest
+        pytest.skip("backend exposes no memory analysis")
+    budget = 8 * _param_state_bytes(p, st) + xs.nbytes + ys.nbytes
+    assert ma.temp_size_in_bytes + ma.output_size_in_bytes < 4 * budget

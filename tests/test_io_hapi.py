@@ -36,6 +36,60 @@ def test_dataloader_shuffle_covers_all():
     assert np.allclose(flat, np.arange(16))
 
 
+def test_prefetch_to_device_order_and_placement():
+    """ISSUE 2 satellite: device double-buffering — batches come back in
+    order, as device arrays, with non-array leaves untouched."""
+    import jax
+    from paddle_tpu.io import prefetch_to_device
+
+    batches = [(np.full((2, 3), i, np.float32), {"tag": f"b{i}"})
+               for i in range(7)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 7
+    for i, (arr, meta) in enumerate(out):
+        assert isinstance(arr, jax.Array)
+        assert float(arr[0, 0]) == i  # order preserved
+        assert meta["tag"] == f"b{i}"  # non-array leaf passes through
+
+
+def test_prefetch_to_device_keeps_transfers_ahead():
+    """The wrapper must PULL from the source iterator ahead of the
+    consumer (that's the overlap) and still drain it fully."""
+    from paddle_tpu.io import prefetch_to_device
+
+    pulled = []
+
+    def src():
+        for i in range(5):
+            pulled.append(i)
+            yield np.full((2,), i, np.float32)
+
+    it = prefetch_to_device(src(), size=3)
+    first = next(it)
+    assert float(first[0]) == 0
+    assert len(pulled) >= 3  # source read ahead of consumption
+    rest = list(it)
+    assert len(rest) == 4
+    assert pulled == list(range(5))
+
+
+def test_prefetch_to_device_through_dataloader_and_sharding():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.io import prefetch_to_device
+
+    ds = TensorDataset([np.arange(32).reshape(16, 2).astype(np.float32),
+                        np.arange(16).astype(np.int64)])
+    dl = DataLoader(ds, batch_size=8)
+    mesh = dist.build_mesh({"dp": 8})
+    sharding = NamedSharding(mesh, P("dp"))
+    out = list(prefetch_to_device(dl, size=2, sharding=sharding))
+    assert len(out) == 2
+    assert out[0][0].sharding == sharding
+    np.testing.assert_allclose(np.asarray(out[1][1]), np.arange(8, 16))
+
+
 def test_distributed_batch_sampler_partitions():
     ds = TensorDataset([np.arange(10).astype(np.float32)])
     seen = []

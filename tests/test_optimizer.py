@@ -315,6 +315,53 @@ def test_gradient_merge_grad_clip_lands_on_inner():
     assert isinstance(inner._grad_clip, HybridParallelClipGrad)
 
 
+def test_gradient_merge_accumulates_fp32_for_bf16_grads():
+    """ISSUE 2 satellite regression: merged grads accumulate in fp32
+    regardless of param/grad dtype. k bf16 micrograds of ~1/k magnitude
+    summed in bf16 would lose the low bits each add (bf16 has 8 mantissa
+    bits); the fp32 accumulator must reproduce the one-big-batch update
+    to fp32 accuracy."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.optimizer import GradientMergeOptimizer
+
+    k = 16
+    rng = np.random.RandomState(0)
+    # zero params + lr 1.0: the merged param IS the (negated) merged
+    # gradient, so accumulator precision is directly observable
+    params = {"w": jnp.zeros((256,), jnp.bfloat16)}
+    grads = [jnp.asarray((1e-3 * (1 + 0.5 * np.sin(i)) *
+                          rng.randn(256)).astype(np.float32))
+             for i in range(k)]
+
+    gm = GradientMergeOptimizer(paddle.optimizer.SGD(1.0), k_steps=k)
+    state = gm.init_state(params)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree.leaves(state["acc"]))
+    p = params
+    for g in grads:
+        # bf16 wire grads (the dp reduce-dtype case)
+        p, state = gm.apply(p, {"w": g.astype(jnp.bfloat16)}, state, 1.0)
+
+    mean_g = np.mean([np.asarray(g.astype(jnp.bfloat16), np.float32)
+                      for g in grads], axis=0)
+    got = np.asarray(p["w"], np.float32)
+
+    # what a bf16 accumulator would have produced instead
+    acc16 = jnp.zeros((256,), jnp.bfloat16)
+    for g in grads:
+        acc16 = acc16 + g.astype(jnp.bfloat16)
+    bf16_err = np.abs(np.asarray(acc16, np.float32) / k + (-mean_g)).max()
+
+    # fp32 accumulation: only the ONE final bf16 param store rounds —
+    # strictly tighter than k accumulated bf16 truncations
+    fp32_err = np.abs(got + mean_g).max()
+    assert fp32_err <= 2e-5, fp32_err
+    assert bf16_err > 2e-6  # the failure mode the fp32 accumulator avoids
+    assert fp32_err < bf16_err, (fp32_err, bf16_err)
+
+
 def test_state_specs_for_wrapper_without_example():
     """Fallback path must handle wrapper state structures too."""
     import jax.numpy as jnp
