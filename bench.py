@@ -183,6 +183,101 @@ def _run_overlap_config(jax, paddle, G, conf, iters):
     }
 
 
+def _run_fp8_config(jax, paddle, G, conf, iters, parity_steps=50):
+    """bf16 vs delayed-scaling fp8 GEMMs on the dense single-chip path
+    (FLAGS_fp8 / quantization/fp8.py): steady-state step time for both,
+    plus loss parity over `parity_steps` training steps from the same
+    init/batch (the acceptance gate: <= 2e-2 relative at the last step).
+    On CPU the float8 dtypes are emulated, so step-time there measures
+    bookkeeping overhead only — the MXU speedup needs hardware."""
+    import jax.numpy as jnp
+    from paddle_tpu.quantization import fp8 as f8
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    batch, seq = conf["batch"], conf["seq"]
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=conf["max_seq_len"],
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    params0 = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    def make_opt():
+        return paddle.optimizer.AdamW(
+            learning_rate=1e-4,
+            moment_dtype=jnp.bfloat16 if on_tpu else None)
+
+    def run(fp8, steps):
+        opt = make_opt()
+        # fresh param buffers per run — both steps donate their carries
+        params = jax.tree.map(jnp.copy, params0)
+        state = jax.jit(opt.init_state)(params)
+        if fp8:
+            meta = f8.init_fp8_meta(G.GPT_FP8_SITES, cfg.num_layers)
+            step = f8.make_fp8_train_step(
+                lambda p, s, t, l: G.dense_loss(p, t, l, cfg, fp8=s), opt)
+            carry = (params, state, meta)
+
+            def one(carry):
+                p, st, m = carry
+                p, st, m, loss = step(p, st, m, tokens, labels,
+                                      jnp.float32(1e-4))
+                return (p, st, m), loss
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(p, st, t, l):
+                loss, grads = jax.value_and_grad(
+                    lambda p: G.dense_loss(p, t, l, cfg))(p)
+                p, st = opt.apply(p, grads, st, 1e-4)
+                return p, st, loss
+            carry = (params, state)
+
+            def one(carry):
+                p, st = carry
+                p, st, loss = step(p, st, tokens, labels)
+                return (p, st), loss
+
+        tc0 = time.perf_counter()
+        carry, loss = one(carry)
+        losses = [float(loss)]  # forces completion
+        compile_s = time.perf_counter() - tc0
+        # exactly `steps` total steps regardless of iters: the timed
+        # window is capped so the parity gate always measures the step
+        # count it reports
+        timed = min(iters, steps - 1)
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            carry, loss = one(carry)
+        float(loss)
+        dt = (time.perf_counter() - t0) / max(timed, 1)
+        for _ in range(steps - 1 - timed):
+            carry, loss = one(carry)
+        losses.append(float(loss))
+        return dt, compile_s, losses
+
+    t_bf16, compile_bf16, l_bf16 = run(False, parity_steps)
+    t_fp8, compile_fp8, l_fp8 = run(True, parity_steps)
+    rel = abs(l_fp8[-1] - l_bf16[-1]) / max(abs(l_bf16[-1]), 1e-9)
+    return {
+        "config_hash": _config_hash(conf),
+        "step_ms": {"bf16": round(t_bf16 * 1e3, 2),
+                    "fp8": round(t_fp8 * 1e3, 2)},
+        "speedup": round(t_bf16 / t_fp8, 3),
+        "compile_s": {"bf16": round(compile_bf16, 2),
+                      "fp8": round(compile_fp8, 2)},
+        "loss_final": {"bf16": round(l_bf16[-1], 4),
+                       "fp8": round(l_fp8[-1], 4)},
+        "loss_rel_diff": round(rel, 5),
+        "loss_parity_ok": bool(rel <= 2e-2),
+        "parity_steps": parity_steps,
+        "cpu_emulated": not on_tpu,
+    }
+
+
 def main():
     import os
 
@@ -233,6 +328,13 @@ def main():
     # FLAGS_comm_quantize): per-phase comms fraction + step times
     out["overlap"] = _run_overlap_config(jax, paddle, G, overlap_conf,
                                          overlap_iters)
+    # delayed-scaling fp8 GEMMs (FLAGS_fp8): bf16 vs fp8 step time +
+    # 50-step loss-parity gate on the dense single-chip path
+    fp8_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
+    if not on_tpu:
+        fp8_conf["batch"] = 2
+    out["fp8"] = _run_fp8_config(jax, paddle, G, fp8_conf,
+                                 iters if on_tpu else 3)
     print(json.dumps(out))
 
 

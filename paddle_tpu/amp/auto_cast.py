@@ -93,13 +93,17 @@ def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = 
     Reference: python/paddle/amp/auto_cast.py (amp_guard). level O1 casts
     white-listed ops to `dtype`; O2 casts everything except the black list.
     On TPU `dtype` defaults to bfloat16 (no GradScaler needed); float16 is
-    supported for parity testing.
+    supported for parity testing. Level "O3" is O2 plus delayed-scaling
+    fp8 GEMMs for the dense transformer stack (equivalent to FLAGS_fp8 —
+    consumed via quantization.fp8.fp8_enabled by the model build steps;
+    op-level casts under O3 behave exactly as O2, since fp8 quantization
+    happens inside fp8_dot, not via the white/black lists).
     """
     del use_promote  # promote is the only inter-op behavior we implement
     if dtype is None:
         from ..flags import flag
         dtype = flag("amp_dtype")
-    enforce_in(level, ("O0", "O1", "O2"), op="amp.auto_cast",
+    enforce_in(level, ("O0", "O1", "O2", "O3"), op="amp.auto_cast",
                name="level")
     prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
             set(_STATE.white), set(_STATE.black))
@@ -200,12 +204,12 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     Returns (models, optimizers) like the reference.
     """
     del save_dtype
-    enforce_in(level, ("O1", "O2"), op="amp.decorate", name="level")
+    enforce_in(level, ("O1", "O2", "O3"), op="amp.decorate", name="level")
     target = _resolve_dtype(dtype)
 
     single_model = not isinstance(models, (list, tuple))
     model_list = [models] if single_model else list(models)
-    if level == "O2":
+    if level in ("O2", "O3"):  # O3 decorates params exactly as O2
         for m in model_list:
             for layer in m.sublayers(include_self=True):
                 if type(layer).__name__.startswith(_KEEP_FP32_LAYERS):

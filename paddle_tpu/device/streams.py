@@ -162,14 +162,26 @@ class Stream:
         with self._lock:
             self._prune()  # keep the window bounded by completion, not cap
             self._inflight.extend(arrs)
-            # window still over cap after pruning: the dispatching thread
-            # waits on the oldest work (the CUDA-queue-depth analogue) so
-            # tracking stays bounded WITHOUT forgetting live work. Lock is
-            # held — same-stream dispatchers queue behind the wait, which
-            # is the ordering a full hardware queue imposes anyway.
-            while len(self._inflight) > _INFLIGHT_CAP:
-                _block_all((self._inflight[0],))
-                self._inflight.popleft()
+        # window still over cap after pruning: the dispatching thread
+        # waits on the oldest work (the CUDA-queue-depth analogue) so
+        # tracking stays bounded WITHOUT forgetting live work. The device
+        # wait happens OUTSIDE the lock (ADVICE r5) — a potentially long
+        # block while holding it would stall concurrent query()/
+        # Event.record()/synchronize() readers. The entry is only POPPED
+        # (under the lock, if still at the head) after it completed, so
+        # readers never observe live work as missing — the conservative-
+        # ordering contract survives. Same-stream dispatchers racing here
+        # both block on completed work at worst (a _block_all on finished
+        # arrays returns immediately).
+        while True:
+            with self._lock:
+                if len(self._inflight) <= _INFLIGHT_CAP:
+                    return
+                oldest = self._inflight[0]
+            _block_all((oldest,))
+            with self._lock:
+                if self._inflight and self._inflight[0] is oldest:
+                    self._inflight.popleft()
 
     def _note(self, arr) -> None:
         self._note_many((arr,))

@@ -37,7 +37,9 @@ backward that only accumulates the fp32 global grad-norm² (the forward's
 cached boundary activations serve both passes — no second forward), then
 pass 2 is the normal fused update backward with every grad scaled by the
 shared clip coefficient. Cost: one extra param down-stream + backward
-flops (~+40% step time on the host-link-bound 6.7B tier). By-value clip
+flops (measured +26% step time on the host-link-bound tiers: 25.4 vs
+20.2 s/step on the 6.7B GPT, 27.7 vs 22.0 on Llama-2 7B — BASELINE.md
+round 5). By-value clip
 is free — it fuses into the per-block update. Reference equivalents:
 GroupShardedStage3 param slicing with clip (group_sharded_stage3.py:85
 region) and HybridParallelClipGrad (hybrid_parallel_optimizer.py:41).

@@ -447,6 +447,23 @@ define_flag("gemm_use_half_precision_compute_type", False,
 define_flag("amp_dtype", "bfloat16",
             "Default autocast dtype (consumed by amp.auto_cast when no "
             "dtype is passed).")
+define_flag("fp8", False,
+            "Delayed-scaling fp8 training for the dense transformer "
+            "stack: the qkv/proj/fc1/fc2 GEMMs (and the Llama q/k/v/o/"
+            "gate/up/down equivalents) run with e4m3 forward operands, "
+            "e5m2 backward cotangents and fp32 accumulation; per-tensor "
+            "scales come from a rolling amax history riding "
+            "opt_state['fp8_meta']. Equivalent to amp.auto_cast("
+            "level='O3') (consumed by quantization.fp8.fp8_enabled via "
+            "models gpt/llama build_hybrid_train_step and bench.py).")
+define_flag("fp8_amax_history", 16,
+            "Rolling amax-history window length for fp8 delayed scaling "
+            "(consumed by quantization.fp8.init_fp8_meta).")
+define_flag("fp8_margin", 0,
+            "Extra powers of two of headroom on fp8 scales: scale = "
+            "2^margin * amax / dtype_max — raise when fresh outliers "
+            "saturate too often (consumed by "
+            "quantization.fp8.update_fp8_meta).")
 define_flag("bf16_stochastic_rounding_moments", True,
             "Stochastically round bf16 Adam moment2 stores (consumed by "
             "optimizer._store_moment; nearest rounding freezes the "

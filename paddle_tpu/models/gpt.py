@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
+from ..quantization.fp8 import site_mm as _fp8_mm
 from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
     spmd_pipeline, spmd_pipeline_interleaved, spmd_pipeline_zero_bubble,
     vpp_block_permutation, vpp_chunk_blocks, vpp_wrap_shard_params)
@@ -40,7 +41,11 @@ from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
 __all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
            "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
            "build_hybrid_train_step", "split_streamed_params",
-           "init_streamed_params", "streamed_fns"]
+           "init_streamed_params", "streamed_fns", "GPT_FP8_SITES"]
+
+# the dense-stack GEMM sites that run fp8 under FLAGS_fp8 / amp O3 (the
+# attention einsums, LM head and embedding stay bf16 — quantization.fp8)
+GPT_FP8_SITES = ("qkv", "proj", "fc1", "fc2")
 
 
 @dataclasses.dataclass
@@ -225,14 +230,20 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp"):
+def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None):
     """One transformer block, explicit Megatron TP (runs inside shard_map;
     degenerates correctly at mp degree 1).
 
     QKV channel layout is HEAD-MAJOR: [H, heads * 3 * head_dim], so a
     contiguous column shard over 'mp' holds COMPLETE heads (each with its
     q, k and v) — a [H, 3H] q|k|v-major packing would split heads across
-    ranks and silently corrupt attention under TP."""
+    ranks and silently corrupt attention under TP.
+
+    fp8: this layer's {site: {x, w, g}} delayed scales (replicated over
+    dp/mp) routing the four GEMMs through quantization.fp8.fp8_dot; each
+    rank quantizes its LOCAL weight shard with the shared per-tensor
+    scale, and the engine pmaxes the observed amaxes over dp/mp before
+    the meta update."""
     mp = lax.axis_size(mp_axis)
     heads_local = cfg.num_heads // mp
     B, S, H = x.shape
@@ -240,7 +251,8 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp"):
 
     h = _ln(x, p["ln1_g"], p["ln1_b"])
     hi = mp_ops.c_identity(h, mp_axis)
-    qkv = (hi.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+    qkv = (_fp8_mm(fp8, "qkv")(hi.astype(cfg.dtype),
+                               p["qkv_w"].astype(cfg.dtype))
            + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
     qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
     # registry op: Pallas flash on TPU (the engine's shard_map runs with
@@ -250,16 +262,17 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp"):
     attn = F.scaled_dot_product_attention(
         qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
     attn = attn.reshape(B, S, H // mp)
-    out = attn @ p["proj_w"].astype(cfg.dtype)  # row-parallel: [B, S, H]
+    out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
     out = mp_ops.mp_allreduce(out, mp_axis) + p["proj_b"].astype(cfg.dtype)
     x = x + out
 
     h = _ln(x, p["ln2_g"], p["ln2_b"])
     hi = mp_ops.c_identity(h, mp_axis)
-    m = (hi.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+    m = (_fp8_mm(fp8, "fc1")(hi.astype(cfg.dtype),
+                             p["fc1_w"].astype(cfg.dtype))
          + p["fc1_b"].astype(cfg.dtype))
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-    m = m @ p["fc2_w"].astype(cfg.dtype)
+    m = _fp8_mm(fp8, "fc2")(m, p["fc2_w"].astype(cfg.dtype))
     m = mp_ops.mp_allreduce(m, mp_axis) + p["fc2_b"].astype(cfg.dtype)
     return x + m
 
@@ -309,13 +322,17 @@ def dense_embed(params, tokens, cfg: GPTConfig):
     return x.astype(cfg.dtype)
 
 
-def dense_block(p, x, cfg: GPTConfig):
+def dense_block(p, x, cfg: GPTConfig, fp8=None):
     """One transformer block on an UNstacked per-layer param tree — shared
-    by the scan in dense_forward and the param-streaming trainer."""
+    by the scan in dense_forward and the param-streaming trainer. fp8:
+    this layer's {site: {x, w, g}} delayed scales — the qkv/proj/fc1/fc2
+    GEMMs route through quantization.fp8.fp8_dot (None = plain bf16/f32
+    path, bitwise-unchanged)."""
     from jax.ad_checkpoint import checkpoint_name
     B, S, H = x.shape
     h = _ln(x, p["ln1_g"], p["ln1_b"])
-    qkv = (h.astype(cfg.dtype) @ p["qkv_w"].astype(cfg.dtype)
+    qkv = (_fp8_mm(fp8, "qkv")(h.astype(cfg.dtype),
+                               p["qkv_w"].astype(cfg.dtype))
            + p["qkv_b"].astype(cfg.dtype))
     # checkpoint_name tags are inert under plain jax.checkpoint; the
     # selective remat policy (dense_forward remat_save=) keys on them
@@ -327,14 +344,17 @@ def dense_block(p, x, cfg: GPTConfig):
         qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
         is_causal=True)
     attn = checkpoint_name(attn, "attn_out")
-    out = attn.reshape(B, S, H) @ p["proj_w"].astype(cfg.dtype)
+    out = _fp8_mm(fp8, "proj")(attn.reshape(B, S, H),
+                               p["proj_w"].astype(cfg.dtype))
     x = x + out + p["proj_b"].astype(cfg.dtype)
     h = _ln(x, p["ln2_g"], p["ln2_b"])
-    m = (h.astype(cfg.dtype) @ p["fc1_w"].astype(cfg.dtype)
+    m = (_fp8_mm(fp8, "fc1")(h.astype(cfg.dtype),
+                             p["fc1_w"].astype(cfg.dtype))
          + p["fc1_b"].astype(cfg.dtype))
     m = checkpoint_name(m, "fc1")
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-    return x + m @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+    return (x + _fp8_mm(fp8, "fc2")(m, p["fc2_w"].astype(cfg.dtype))
+            + p["fc2_b"].astype(cfg.dtype))
 
 
 def lm_logsumexp_ce(logits, labels):
@@ -359,7 +379,7 @@ def dense_head_loss(params, x, labels, cfg: GPTConfig):
 
 
 def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
-                  remat_save=("attn_out", "qkv")):
+                  remat_save=("attn_out", "qkv"), fp8=None):
     """Single-device forward over the stacked-parameter pytree (no
     collectives). Same math/layout as the hybrid engine — head-major QKV.
     remat=True checkpoints each block (recompute in backward) — the memory/
@@ -369,13 +389,22 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
     1.3B flagship (578.6 vs 600.7 ms/step full-remat, one v5e, round 4:
     skips recomputing the qkv projection and the flash forward at
     ~128 MB/layer of saved activations); pass remat_save=() for the
-    minimum-memory full-remat form (bigger-than-HBM configs)."""
+    minimum-memory full-remat form (bigger-than-HBM configs).
+
+    fp8: per-layer delayed scales, stacked [L] like the block params (see
+    quantization.fp8.init_fp8_meta) — they ride the same scan, so each
+    layer's amax observation comes back separately instead of summed. The
+    selective-remat policy additionally saves the quantized operands
+    (FP8_REMAT_NAMES) so backward reuses them instead of re-quantizing."""
     x = dense_embed(params, tokens, cfg)
 
-    def block(p, x):
-        return dense_block(p, x, cfg)
+    def block(p, x, f=None):
+        return dense_block(p, x, cfg, fp8=f)
 
     if remat and remat_save:
+        if fp8 is not None:
+            from ..quantization.fp8 import FP8_REMAT_NAMES
+            remat_save = tuple(remat_save) + tuple(FP8_REMAT_NAMES)
         blk = jax.checkpoint(
             block,
             policy=jax.checkpoint_policies.save_only_these_names(
@@ -385,21 +414,27 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
     else:
         blk = block
 
-    def body(carry, p):
-        return blk(p, carry), None
-
-    x, _ = lax.scan(body, x, params["blocks"])
+    if fp8 is not None:
+        def body(carry, pf):
+            p, f = pf
+            return blk(p, carry, f), None
+        x, _ = lax.scan(body, x, (params["blocks"], fp8))
+    else:
+        def body(carry, p):
+            return blk(p, carry), None
+        x, _ = lax.scan(body, x, params["blocks"])
     x = _ln(x, params["lnf_g"], params["lnf_b"])
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
 
 
 def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True,
-               remat_save=("attn_out", "qkv")):
+               remat_save=("attn_out", "qkv"), fp8=None):
     """remat_save threads through to dense_forward — bigger-than-HBM
     callers (benchmarks/offload_bench.py moments tier) pass () for the
-    minimum-memory full-remat form."""
+    minimum-memory full-remat form. fp8: per-layer delayed scales (see
+    dense_forward)."""
     logits = dense_forward(params, tokens, cfg, remat=remat,
-                           remat_save=remat_save)
+                           remat_save=remat_save, fp8=fp8)
     return lm_logsumexp_ce(logits, labels)
 
 
@@ -476,7 +511,7 @@ def streamed_fns(cfg: GPTConfig):
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1,
-                   schedule: str = "1F1B"):
+                   schedule: str = "1F1B", fp8=None):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
@@ -484,23 +519,41 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     vpp_block_permutation order — build_hybrid_train_step does this).
     schedule="ZBH1" selects the zero-bubble pipeline
     (PipelineZeroBubblePass / spmd_pipeline_zero_bubble).
+    fp8: this pp rank's stacked [L/pp] delayed scales (sharded over pp
+    like the block params); 1F1B schedule only — the interleaved/ZB
+    permutations would need the same block reorder applied to the scales.
     """
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
             "per-dp-rank batch must be divisible by num_microbatches",
             op="gpt.hybrid_loss_fn", batch_local=b_local, microbatches=M)
+    enforce(fp8 is None or (virtual_pp == 1 and schedule == "1F1B"),
+            "fp8 delayed scaling supports the 1F1B schedule only",
+            op="gpt.hybrid_loss_fn", virtual_pp=virtual_pp,
+            schedule=schedule)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x + params["wpe"][None, :S]
     x = x.astype(cfg.dtype)
     x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
 
     def stage_fn(block_params, h):
+        if fp8 is not None:
+            blocks, scales = block_params
+
+            def body(carry, pf):
+                p, f = pf
+                return _block_fn(p, carry, cfg, mp_axis, fp8=f), None
+            out, _ = lax.scan(body, h, (blocks, scales))
+            return out
+
         def body(carry, p):
             return _block_fn(p, carry, cfg, mp_axis), None
         out, _ = lax.scan(body, h, block_params)
         return out
 
+    stage_params = (params["blocks"] if fp8 is None
+                    else (params["blocks"], fp8))
     if virtual_pp > 1:
         out = spmd_pipeline_interleaved(
             stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
@@ -509,7 +562,7 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         out = spmd_pipeline_zero_bubble(stage_fn, params["blocks"], x_mb,
                                         axis=pp_axis)
     else:
-        out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+        out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
     out = out.reshape(b_local, S, cfg.hidden_size)
     out = _ln(out, params["lnf_g"], params["lnf_b"])
     from ..distributed.fleet.layers.mpu import mp_ops
@@ -526,7 +579,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, schedule: str = "1F1B",
                             grad_reduce_dtype="auto",
-                            zero1_dp: bool = False, comm_overlap="auto"):
+                            zero1_dp: bool = False, comm_overlap="auto",
+                            fp8="auto"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -543,13 +597,36 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     collectives; see hybrid_engine.build_train_step. When the overlap
     scan accumulates over its own microbatches, the per-dp-rank batch
     must divide comm microbatches x pipeline num_microbatches.
+
+    fp8: "auto" (FLAGS_fp8 / amp O3, default off) / bool — route the
+    block GEMMs (GPT_FP8_SITES) through delayed-scaling fp8_dot; the
+    (scale, amax_history) state rides opt_state["fp8_meta"], sharded
+    over pp with the stacked blocks, and amaxes pmax over dp/mp (+extra
+    axes) so scales stay replicated. 1F1B schedule only.
     """
     from .hybrid_engine import build_train_step
+    from ..quantization import fp8 as _f8
 
-    def loss_fn(p, tokens, labels):
-        return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
-                              dp_axis, pp_axis, mp_axis,
-                              virtual_pp=virtual_pp, schedule=schedule)
+    fp8_plan = _f8.resolve_fp8_plan(
+        fp8, GPT_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
+        amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
+    if fp8_plan is not None:
+        enforce(virtual_pp == 1 and schedule == "1F1B",
+                "fp8 delayed scaling supports the 1F1B schedule only "
+                "(scales are stacked per layer and must follow any block "
+                "permutation)", op="gpt.build_hybrid_train_step",
+                virtual_pp=virtual_pp, schedule=schedule)
+
+        def loss_fn(p, tokens, labels, scales):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis,
+                                  virtual_pp=virtual_pp, schedule=schedule,
+                                  fp8=scales)
+    else:
+        def loss_fn(p, tokens, labels):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis,
+                                  virtual_pp=virtual_pp, schedule=schedule)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
@@ -557,7 +634,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        comm_overlap=comm_overlap)
+        comm_overlap=comm_overlap, fp8=fp8_plan)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

@@ -176,7 +176,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
-                     comm_overlap="auto"):
+                     comm_overlap="auto", fp8=None):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -211,7 +211,19 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     FLAGS_comm_overlap_microbatches (all default off); pass a
     CommOverlapConfig to force, or None to disable. Self-synchronizing
     optimizers (_skips_grad_sync) own the dp axis, so overlap is inert
-    for them — pair them with comm_overlap.make_merge_comm_fn instead."""
+    for them — pair them with comm_overlap.make_merge_comm_fn instead.
+
+    fp8: a quantization.fp8.fp8_plan dict (models build it) enabling
+    delayed-scaling fp8 GEMMs in the loss: loss_fn then takes a fourth
+    arg (the scale tree), value_and_grad runs over (params, scales) so
+    the scale 'gradients' deliver this step's amax observations, those
+    pmax over plan["axes"] (the axes scales are replicated on), and
+    update_fp8_meta rotates the history. The (scale, amax_history) state
+    rides opt_state["fp8_meta"] exactly as the int8 error-feedback
+    residuals ride opt_state["comm_ef"] — same step signature, same
+    checkpoint surface, donation preserved. Not composed with
+    comm_overlap (the overlap scan's weighted accumulation would corrupt
+    the amax semantics — disable one of the two)."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -258,12 +270,27 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 "gradient shapes at build time)", op="build_train_step")
         ef_plan = _co.ef_plan_for(example_params, specs, mesh,
                                   ocfg.bucket_bytes)
+    fp8_plan = fp8
+    if fp8_plan is not None:
+        from ..enforce import enforce
+        enforce(ocfg is None,
+                "fp8 delayed scaling is not composed with comm_overlap: "
+                "the overlap scan's weighted gradient accumulation would "
+                "sum/scale the amax observations riding the scale "
+                "cotangents — disable FLAGS_comm_* or fp8",
+                op="build_train_step")
+        from ..quantization import fp8 as _f8
+        fp8_axes = tuple(a for a in fp8_plan.get("axes", ())
+                         if a in mesh.axis_names)
     opt_sspec = sspec
     if ef_plan is not None:
         # residuals ride the optimizer state so the step signature and
         # checkpoint surface stay (params, state, batch..., lr)
         sspec = {"opt": opt_sspec,
                  "comm_ef": _co.ef_residual_specs(ef_plan, mesh)}
+    elif fp8_plan is not None:
+        # fp8 (scale, amax_history) state rides the same way
+        sspec = {"opt": opt_sspec, "fp8_meta": fp8_plan["specs"]}
 
     def shard_params(params):
         return jax.tree.map(
@@ -280,6 +307,11 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         if ef_plan is not None:
             return {"opt": inner,
                     "comm_ef": _co.init_ef_residuals(ef_plan, mesh)}
+        if fp8_plan is not None:
+            meta = jax.tree.map(
+                lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+                fp8_plan["init"](), fp8_plan["specs"])
+            return {"opt": inner, "fp8_meta": meta}
         return inner
 
     def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
@@ -344,11 +376,21 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             if scale is not None:
                 g = (g * scale).astype(g.dtype)
             if zd < 0:
+                # replicated leaf: every dp rank MUST run the identical
+                # update (same SR key included) or replicas drift
                 np_, ns_ = optimizer._update_ctx(ctx, p, g, s, lr,
                                                  step_no, rng=rng)
             else:
                 shard = p.shape[zd] // dp
                 p_sh = lax.dynamic_slice_in_dim(p, idx * shard, shard, zd)
+                if rng is not None:
+                    # dp-sharded leaf: each rank updates a DISTINCT param
+                    # shard — fold the dp rank into the per-leaf SR key,
+                    # else every shard gets the identical stochastic-
+                    # rounding noise pattern (ADVICE r5; mp/pp shards of
+                    # the per-leaf key remain correlated — accepted, the
+                    # per-leaf protocol has no mesh knowledge there)
+                    rng = jax.random.fold_in(rng, idx)
                 np_sh, ns_ = optimizer._update_ctx(ctx, p_sh, g, s, lr,
                                                    step_no, rng=rng)
                 np_ = lax.all_gather(np_sh, dp_axis, axis=zd, tiled=True)
@@ -392,13 +434,17 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             ocfg.microbatches, reduce_fn, residuals=residuals)
 
     def local_step(params, opt_state, tokens, labels, lr):
-        ef = None
+        ef = fmeta = None
         if ef_plan is not None:
             ef, opt_state = opt_state["comm_ef"], opt_state["opt"]
+        if fp8_plan is not None:
+            fmeta, opt_state = opt_state["fp8_meta"], opt_state["opt"]
 
-        def rewrap(new_params, new_state, new_ef, loss):
+        def rewrap(new_params, new_state, new_ef, new_fmeta, loss):
             if ef_plan is not None:
                 new_state = {"opt": new_state, "comm_ef": new_ef}
+            if fp8_plan is not None:
+                new_state = {"opt": new_state, "fp8_meta": new_fmeta}
             return new_params, new_state, loss
 
         if ocfg is not None:
@@ -406,14 +452,29 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             if zero1_dp:
                 new_params, new_state = _zero1_apply(
                     params, grads, opt_state, lr, pre_reduced=True)
-                return rewrap(new_params, new_state, ef, loss)
+                return rewrap(new_params, new_state, ef, fmeta, loss)
+        elif fp8_plan is not None:
+            # grads over (params, scales): the scale cotangents ARE the
+            # amax observations (quantization.fp8), pmax'd over the axes
+            # scales are replicated on so every rank derives identical
+            # next-step scales from the global amax
+            loss, (grads, amax) = jax.value_and_grad(
+                lambda p, s: loss_fn(p, tokens, labels, s),
+                argnums=(0, 1))(params, _f8.scales_of(fmeta))
+            if fp8_axes:
+                amax = jax.tree.map(lambda a: lax.pmax(a, fp8_axes), amax)
+            fmeta = _f8.update_fp8_meta(fmeta, amax)
+            if zero1_dp:
+                new_params, new_state = _zero1_apply(params, grads,
+                                                     opt_state, lr)
+                return rewrap(new_params, new_state, ef, fmeta, loss)
         else:
             loss, grads = jax.value_and_grad(
                 lambda p: loss_fn(p, tokens, labels))(params)
             if zero1_dp:
                 new_params, new_state = _zero1_apply(params, grads,
                                                      opt_state, lr)
-                return rewrap(new_params, new_state, ef, loss)
+                return rewrap(new_params, new_state, ef, fmeta, loss)
         # dp gradient reduction (the EagerReducer equivalent — one pmean,
         # fused and overlapped by XLA). Self-synchronizing optimizers
         # (LocalSGD/DGC: _skips_grad_sync) own the dp axis but NOT the
@@ -496,9 +557,9 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             new_p, new_slots = optimizer._apply_leaves(
                 params, grads, opt_state["slots"], lr, step_no)
             return rewrap(new_p, {"step": step_no, "slots": new_slots},
-                          ef, loss)
+                          ef, fmeta, loss)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
-        return rewrap(new_params, new_state, ef, loss)
+        return rewrap(new_params, new_state, ef, fmeta, loss)
 
     step = _shard_map(
         local_step, mesh=mesh,

@@ -36,13 +36,18 @@ from ..nn import functional as F
 from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
     spmd_pipeline, spmd_pipeline_interleaved, vpp_chunk_blocks,
     vpp_wrap_shard_params)
+from ..quantization.fp8 import site_mm as _fp8_mm
 from .gpt import _vocab_parallel_ce, _vocab_parallel_embed
 
 __all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama2_7b", "llama2_13b",
            "llama3_8b", "init_hybrid_params", "hybrid_param_specs",
            "hybrid_loss_fn", "build_hybrid_train_step", "dense_forward",
            "dense_loss", "split_streamed_params", "init_streamed_params",
-           "streamed_fns"]
+           "streamed_fns", "LLAMA_FP8_SITES"]
+
+# the decoder GEMM sites that run fp8 under FLAGS_fp8 / amp O3 (attention,
+# RoPE, the LM head and embedding stay bf16 — quantization.fp8)
+LLAMA_FP8_SITES = ("q", "k", "v", "o", "gate", "up", "down")
 
 
 @dataclasses.dataclass
@@ -275,11 +280,14 @@ def _rms(x, g, eps):
                            + eps)).astype(x.dtype) * g
 
 
-def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp"):
+def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp",
+              fp8=None):
     """One decoder layer with explicit Megatron TP (inside shard_map).
     Column shards hold complete heads: q_w's out dim is head-major [hq·D],
     k_w/v_w's is [hkv·D] — contiguous mp shards keep q-head↔kv-head groups
-    rank-local (see module docstring)."""
+    rank-local (see module docstring). fp8: this layer's {site: {x, w, g}}
+    delayed scales routing the seven GEMMs (LLAMA_FP8_SITES) through
+    quantization.fp8.fp8_dot."""
     mp = lax.axis_size(mp_axis)
     hq, hkv = cfg.num_heads // mp, cfg.num_kv_heads // mp
     B, S, H = x.shape
@@ -288,22 +296,26 @@ def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp"):
 
     h = _rms(x, p["ln1_g"], cfg.rms_eps)
     hi = mp_ops.c_identity(h, mp_axis).astype(cd)
-    q = (hi @ p["q_w"].astype(cd)).reshape(B, S, hq, cfg.head_dim)
-    kk = (hi @ p["k_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
-    vv = (hi @ p["v_w"].astype(cd)).reshape(B, S, hkv, cfg.head_dim)
+    q = _fp8_mm(fp8, "q")(hi, p["q_w"].astype(cd)).reshape(
+        B, S, hq, cfg.head_dim)
+    kk = _fp8_mm(fp8, "k")(hi, p["k_w"].astype(cd)).reshape(
+        B, S, hkv, cfg.head_dim)
+    vv = _fp8_mm(fp8, "v")(hi, p["v_w"].astype(cd)).reshape(
+        B, S, hkv, cfg.head_dim)
     q, kk = _rope(q, cos, sin), _rope(kk, cos, sin)
     # registry attention (Pallas flash with native GQA on TPU — the
     # engine's shard_map runs check_vma=False so the kernel traces inside
     # it; composed fallback elsewhere). Heads are rank-local under TP.
     attn = _flash_gqa(q, kk, vv).reshape(B, S, H // mp)
-    out = attn @ p["o_w"].astype(cd)  # row-parallel
+    out = _fp8_mm(fp8, "o")(attn, p["o_w"].astype(cd))  # row-parallel
     x = x + mp_ops.mp_allreduce(out, mp_axis)
 
     h = _rms(x, p["ln2_g"], cfg.rms_eps)
     hi = mp_ops.c_identity(h, mp_axis).astype(cd)
-    m = jax.nn.silu((hi @ p["gate_w"].astype(cd)).astype(jnp.float32)
-                    ).astype(cd) * (hi @ p["up_w"].astype(cd))
-    m = m @ p["down_w"].astype(cd)  # row-parallel
+    m = jax.nn.silu(_fp8_mm(fp8, "gate")(hi, p["gate_w"].astype(cd))
+                    .astype(jnp.float32)).astype(cd) \
+        * _fp8_mm(fp8, "up")(hi, p["up_w"].astype(cd))
+    m = _fp8_mm(fp8, "down")(m, p["down_w"].astype(cd))  # row-parallel
     return x + mp_ops.mp_allreduce(m, mp_axis)
 
 
@@ -311,27 +323,30 @@ def dense_embed(params, tokens, cfg: LlamaConfig):
     return jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
 
 
-def dense_block(p, x, cfg: LlamaConfig):
+def dense_block(p, x, cfg: LlamaConfig, fp8=None):
     """One decoder layer on an UNstacked per-layer tree — shared by the
     scan in dense_forward and the param-streaming trainer (RoPE tables
-    are a deterministic function of static cfg + S; XLA folds them)."""
+    are a deterministic function of static cfg + S; XLA folds them).
+    fp8: this layer's {site: {x, w, g}} delayed scales (None = plain
+    path, bitwise-unchanged)."""
     cd = cfg.dtype
     B, S, H = x.shape
     cos, sin = rope_tables(cfg, S)
     h = _rms(x, p["ln1_g"], cfg.rms_eps).astype(cd)
-    q = (h @ p["q_w"].astype(cd)).reshape(B, S, cfg.num_heads,
-                                          cfg.head_dim)
-    k = (h @ p["k_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                          cfg.head_dim)
-    v = (h @ p["v_w"].astype(cd)).reshape(B, S, cfg.num_kv_heads,
-                                          cfg.head_dim)
+    q = _fp8_mm(fp8, "q")(h, p["q_w"].astype(cd)).reshape(
+        B, S, cfg.num_heads, cfg.head_dim)
+    k = _fp8_mm(fp8, "k")(h, p["k_w"].astype(cd)).reshape(
+        B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _fp8_mm(fp8, "v")(h, p["v_w"].astype(cd)).reshape(
+        B, S, cfg.num_kv_heads, cfg.head_dim)
     q, k = _rope(q, cos, sin), _rope(k, cos, sin)
     attn = _flash_gqa(q, k, v)
-    x = x + attn.reshape(B, S, H) @ p["o_w"].astype(cd)
+    x = x + _fp8_mm(fp8, "o")(attn.reshape(B, S, H), p["o_w"].astype(cd))
     h = _rms(x, p["ln2_g"], cfg.rms_eps).astype(cd)
-    m = jax.nn.silu((h @ p["gate_w"].astype(cd)).astype(jnp.float32)
-                    ).astype(cd) * (h @ p["up_w"].astype(cd))
-    return x + m @ p["down_w"].astype(cd)
+    m = jax.nn.silu(_fp8_mm(fp8, "gate")(h, p["gate_w"].astype(cd))
+                    .astype(jnp.float32)).astype(cd) \
+        * _fp8_mm(fp8, "up")(h, p["up_w"].astype(cd))
+    return x + _fp8_mm(fp8, "down")(m, p["down_w"].astype(cd))
 
 
 def dense_head_loss(params, x, labels, cfg: LlamaConfig):
@@ -345,20 +360,27 @@ def dense_head_loss(params, x, labels, cfg: LlamaConfig):
     return jnp.mean(lse - picked)
 
 
-def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True):
+def dense_forward(params, tokens, cfg: LlamaConfig, remat: bool = True,
+                  fp8=None):
     """Single-device forward over the stacked pytree (no collectives); same
-    math/layout as the hybrid engine."""
+    math/layout as the hybrid engine. fp8: per-layer delayed scales,
+    stacked [L] like the block params (see gpt.dense_forward)."""
     x = dense_embed(params, tokens, cfg)
 
-    def block(p, x):
-        return dense_block(p, x, cfg)
+    def block(p, x, f=None):
+        return dense_block(p, x, cfg, fp8=f)
 
     blk = jax.checkpoint(block) if remat else block
 
-    def body(carry, p):
-        return blk(p, carry), None
-
-    x, _ = lax.scan(body, x, params["blocks"])
+    if fp8 is not None:
+        def body(carry, pf):
+            p, f = pf
+            return blk(p, carry, f), None
+        x, _ = lax.scan(body, x, (params["blocks"], fp8))
+    else:
+        def body(carry, p):
+            return blk(p, carry), None
+        x, _ = lax.scan(body, x, params["blocks"])
     x = _rms(x, params["lnf_g"], cfg.rms_eps)
     return x.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
 
@@ -420,8 +442,9 @@ def streamed_fns(cfg: LlamaConfig):
             lambda p, x, labels: dense_head_loss(p, x, labels, cfg))
 
 
-def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
-    logits = dense_forward(params, tokens, cfg, remat=remat)
+def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True,
+               fp8=None):
+    logits = dense_forward(params, tokens, cfg, remat=remat, fp8=fp8)
     # bf16-logit logsumexp CE (one shared implementation — gpt.py)
     from .gpt import lm_logsumexp_ce
     return lm_logsumexp_ce(logits, labels)
@@ -429,30 +452,47 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True):
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp", virtual_pp: int = 1):
-    """Per-device loss of the full hybrid Llama (inside shard_map)."""
+                   mp_axis="mp", virtual_pp: int = 1, fp8=None):
+    """Per-device loss of the full hybrid Llama (inside shard_map). fp8:
+    this pp rank's stacked [L/pp] delayed scales (1F1B only — see
+    gpt.hybrid_loss_fn)."""
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
             "per-dp-rank batch must be divisible by num_microbatches",
             op="llama.hybrid_loss_fn", batch_local=b_local, microbatches=M)
+    enforce(fp8 is None or virtual_pp == 1,
+            "fp8 delayed scaling supports the 1F1B schedule only",
+            op="llama.hybrid_loss_fn", virtual_pp=virtual_pp)
     cos, sin = rope_tables(cfg, S)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x.astype(cfg.dtype)
     x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
 
     def stage_fn(block_params, h):
+        if fp8 is not None:
+            blocks, scales = block_params
+
+            def body(carry, pf):
+                p, f = pf
+                return _block_fn(p, carry, cos, sin, cfg, mp_axis,
+                                 fp8=f), None
+            out, _ = lax.scan(body, h, (blocks, scales))
+            return out
+
         def body(carry, p):
             return _block_fn(p, carry, cos, sin, cfg, mp_axis), None
         out, _ = lax.scan(body, h, block_params)
         return out
 
+    stage_params = (params["blocks"] if fp8 is None
+                    else (params["blocks"], fp8))
     if virtual_pp > 1:
         out = spmd_pipeline_interleaved(
             stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
             axis=pp_axis)
     else:
-        out = spmd_pipeline(stage_fn, params["blocks"], x_mb, axis=pp_axis)
+        out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
     out = out.reshape(b_local, S, cfg.hidden_size)
     out = _rms(out, params["lnf_g"], cfg.rms_eps)
     from ..distributed.fleet.layers.mpu import mp_ops
@@ -467,20 +507,35 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, grad_reduce_dtype="auto",
-                            zero1_dp: bool = False):
+                            zero1_dp: bool = False, fp8="auto"):
     from .hybrid_engine import build_train_step
+    from ..quantization import fp8 as _f8
 
-    def loss_fn(p, tokens, labels):
-        return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
-                              dp_axis, pp_axis, mp_axis,
-                              virtual_pp=virtual_pp)
+    fp8_plan = _f8.resolve_fp8_plan(
+        fp8, LLAMA_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
+        amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
+    if fp8_plan is not None:
+        enforce(virtual_pp == 1,
+                "fp8 delayed scaling supports the 1F1B schedule only",
+                op="llama.build_hybrid_train_step", virtual_pp=virtual_pp)
+
+        def loss_fn(p, tokens, labels, scales):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis,
+                                  virtual_pp=virtual_pp, fp8=scales)
+    else:
+        def loss_fn(p, tokens, labels):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis,
+                                  virtual_pp=virtual_pp)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
-        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp)
+        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
+        fp8=fp8_plan)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

@@ -402,3 +402,10 @@ def convert_to_int8(model, skip=()):
 
 
 __all__ += ["convert_to_int8"]
+
+# delayed-scaling e4m3/e5m2 TRAINING tier (fp8_dot / Fp8Linear / amax-meta
+# state) — submodule import only: fp8.py is jax-pure and must stay
+# importable from the functional model paths without the Layer surface
+from . import fp8  # noqa: E402,F401
+
+__all__ += ["fp8"]

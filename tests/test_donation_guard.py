@@ -89,6 +89,33 @@ def test_sharded_microbatched_overlap_step_still_donates():
     assert _aliased_bytes(compiled) > 0
 
 
+def test_fp8_train_step_donates_params_state_and_meta():
+    """ISSUE 3 satellite: the fp8 train step adds an fp8_meta carry
+    (scales + amax history); params, optimizer state AND the meta must
+    all stay donated — the delayed-scaling bookkeeping may not cost a
+    second resident copy of anything."""
+    from paddle_tpu.quantization import fp8 as f8
+    params, xs, ys, loss_fn = _job()
+    opt = paddle.optimizer.AdamW(1e-3)
+
+    def fp8_loss(p, scales, x, y):
+        return jnp.mean(
+            (f8.fp8_dot(x, p["w"], scales["gemm"]) + p["b"] - y) ** 2)
+
+    meta = f8.init_fp8_meta(("gemm",))
+    step = f8.make_fp8_train_step(fp8_loss, opt)
+    state = jax.jit(opt.init_state)(params)
+    lr = jnp.float32(1e-3)
+    compiled = step.lower(params, state, meta, xs, ys, lr).compile()
+    assert _aliased_bytes(compiled) > 0, \
+        "fp8 step does NOT donate params/opt state/fp8_meta"
+    out = step(params, state, meta, xs, ys, lr)
+    jax.block_until_ready(out)
+    assert all(x.is_deleted()
+               for x in jax.tree.leaves((params, state, meta))), \
+        "donated fp8 step inputs still alive after the step"
+
+
 def test_hybrid_overlap_step_memory_sane():
     """hybrid engine + EF residuals: compiled peak stays within a small
     multiple of params+state+grads (no silent HBM doubling from the
