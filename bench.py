@@ -88,13 +88,16 @@ def _run_config(jax, paddle, G, conf, iters):
 
     tokens_per_sec = batch * seq * iters / dt
 
-    # params count (excluding embeddings for flops-per-token ~ 6N rule)
+    # analytic FLOPs/token + peak from the observability subsystem (the
+    # one copy of the 6N + 12LHS accounting; exact-N from the live params
+    # keeps this frozen series bit-identical to prior rounds)
+    from paddle_tpu.observability import flops as _flops
     n_params = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(params))
-    n_emb = int(np.prod(params["wte"].shape)) + int(np.prod(params["wpe"].shape))
-    flops_per_token = 6 * (n_params - n_emb) + 12 * cfg.num_layers * cfg.hidden_size * seq
-    achieved_flops = tokens_per_sec * flops_per_token
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
-    return tokens_per_sec, achieved_flops / peak, n_params, compile_s
+    flops_per_token = _flops.gpt_flops_per_token(cfg, seq,
+                                                 params=params)["model"]
+    mfu = _flops.mfu(tokens_per_sec, flops_per_token,
+                     _flops.peak_flops(jax.devices()))
+    return tokens_per_sec, mfu, n_params, compile_s
 
 
 def _run_overlap_config(jax, paddle, G, conf, iters):
@@ -278,6 +281,63 @@ def _run_fp8_config(jax, paddle, G, conf, iters, parity_steps=50):
     }
 
 
+def _run_telemetry_config(jax, paddle, G, conf, iters,
+                          comms_fraction=None):
+    """Step accounting through the observability StepTimer: compile vs
+    steady split, per-phase (data-wait vs device step) ms breakdown, MFU
+    from the analytic FLOPs model, and the measured comms fraction from
+    the overlap probe — the 'where does step time go' section."""
+    import jax.numpy as jnp
+    from paddle_tpu.io import prefetch_to_device
+    from paddle_tpu.observability import StepTimer, flops as _flops
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    batch, seq = conf["batch"], conf["seq"]
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=conf["max_seq_len"],
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    fpt = _flops.gpt_flops_per_token(cfg, seq, params=params)
+    fpt_hw = _flops.gpt_flops_per_token(cfg, seq, params=params,
+                                        remat="full")
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, moment_dtype=jnp.bfloat16 if on_tpu else None)
+    state = jax.jit(opt.init_state)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: G.dense_loss(p, tokens, labels, cfg))(params)
+        params, state = opt.apply(params, grads, state, 1e-4)
+        return params, state, loss
+
+    timer = StepTimer(tokens_per_step=batch * seq,
+                      flops_per_token=fpt["model"],
+                      peak_flops=_flops.peak_flops(jax.devices()))
+    rng = np.random.RandomState(0)
+    feed = prefetch_to_device(
+        ((rng.randint(0, cfg.vocab_size, (batch, seq)),
+          rng.randint(0, cfg.vocab_size, (batch, seq)))
+         for _ in range(iters + 1)))
+    for _ in range(iters + 1):
+        with timer.phase("data"):
+            tokens, labels = next(feed)
+        with timer.step():  # first completed step records compile_s
+            params, state, loss = step(params, state, jnp.asarray(tokens),
+                                       jnp.asarray(labels))
+            float(loss)
+    if comms_fraction is not None:
+        timer.set_comms_fraction(comms_fraction)
+    report = timer.report()
+    report["config_hash"] = _config_hash(conf)
+    report["flops_per_token"] = {"model": fpt["model"],
+                                 "hardware_full_remat": fpt_hw["hardware"]}
+    return report
+
+
 def main():
     import os
 
@@ -335,6 +395,15 @@ def main():
         fp8_conf["batch"] = 2
     out["fp8"] = _run_fp8_config(jax, paddle, G, fp8_conf,
                                  iters if on_tpu else 3)
+    # step accounting (observability.StepTimer): compile/steady split,
+    # data-vs-step phase breakdown, analytic-FLOPs MFU and the measured
+    # comms_fraction — where the step time goes, round over round
+    tele_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
+    if not on_tpu:
+        tele_conf["batch"] = 2
+    out["telemetry"] = _run_telemetry_config(
+        jax, paddle, G, tele_conf, iters if on_tpu else 3,
+        comms_fraction=out["overlap"]["comms_fraction"])
     print(json.dumps(out))
 
 

@@ -163,7 +163,7 @@ def microbatched_reduced_grads(loss_fn: Callable, params,
                                batch_args: Sequence[jax.Array],
                                num_microbatches: int,
                                reduce_fn: Callable,
-                               residuals=None):
+                               residuals=None, with_obs: bool = False):
     """Gradient accumulation with in-scan bucket reduction.
 
     Splits each batch arg's leading (local-batch) dim into
@@ -174,7 +174,12 @@ def microbatched_reduced_grads(loss_fn: Callable, params,
     fp32 carry. The collectives of iteration m sit in the program before
     iteration m+1's compute — exactly the structure the latency-hiding
     scheduler overlaps. Returns ``(mean_loss, grads, new_residuals)``
-    with grads cast back to their original dtypes."""
+    with grads cast back to their original dtypes.
+
+    with_obs=True additionally collects observability.observe() calls
+    made inside loss_fn (threaded out of value_and_grad as aux so tracers
+    never escape the scan) and returns a 4th element: a {name: value}
+    dict averaged over the microbatches."""
     from ...enforce import enforce
     M = int(num_microbatches)
     b = batch_args[0].shape[0]
@@ -182,34 +187,60 @@ def microbatched_reduced_grads(loss_fn: Callable, params,
             "comm-overlap microbatches must divide the local batch",
             op="comm_overlap.microbatched_reduced_grads", batch=b,
             microbatches=M)
-    vg = jax.value_and_grad(lambda p, *a: loss_fn(p, *a))
+    if with_obs:
+        from ...observability.metrics import collecting, obs_dict
+
+        def fwd(p, *a):
+            with collecting() as sink:
+                loss = loss_fn(p, *a)
+            return loss, obs_dict(sink)
+
+        avg = jax.value_and_grad(fwd, has_aux=True)
+
+        def vg(p, *a):
+            (loss, obs), g = avg(p, *a)
+            return loss, g, obs
+    else:
+        _vg = jax.value_and_grad(lambda p, *a: loss_fn(p, *a))
+
+        def vg(p, *a):
+            loss, g = _vg(p, *a)
+            return loss, g, {}
 
     def one(mb_args, res):
-        loss, g = vg(params, *mb_args)
+        loss, g, obs = vg(params, *mb_args)
         red, res = reduce_fn(g, res)
-        return loss, red, res
+        return loss, red, res, obs
 
     if M == 1:
-        loss, red, res = one(tuple(batch_args), residuals)
-        return loss, red, res
+        loss, red, res, obs = one(tuple(batch_args), residuals)
+        return (loss, red, res, obs) if with_obs else (loss, red, res)
 
     slices = tuple(a.reshape((M, b // M) + a.shape[1:]) for a in batch_args)
     # carry structure via ABSTRACT eval — peeling a real first microbatch
     # out of the scan would compile the fwd/bwd body twice
-    loss_sh, red_sh, _ = jax.eval_shape(one, tuple(s[0] for s in slices),
-                                        residuals)
+    loss_sh, red_sh, _, obs_sh = jax.eval_shape(
+        one, tuple(s[0] for s in slices), residuals)
     acc0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, jnp.float32),
                         red_sh)
+    obs0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, jnp.float32),
+                        obs_sh)
 
     def body(carry, mb):
-        acc, res, lsum = carry
-        loss, red, res = one(mb, res)
+        acc, res, lsum, osum = carry
+        loss, red, res, obs = one(mb, res)
         acc = jax.tree.map(lambda a, r: a + r.astype(jnp.float32), acc, red)
-        return (acc, res, lsum + loss), None
+        osum = jax.tree.map(lambda a, o: a + o.astype(jnp.float32),
+                            osum, obs)
+        return (acc, res, lsum + loss, osum), None
 
-    (acc, res, lsum), _ = lax.scan(
-        body, (acc0, residuals, jnp.zeros((), loss_sh.dtype)), slices)
+    (acc, res, lsum, osum), _ = lax.scan(
+        body, (acc0, residuals, jnp.zeros((), loss_sh.dtype), obs0),
+        slices)
     grads = jax.tree.map(lambda a, sd: a.astype(sd.dtype), acc, red_sh)
+    obs = jax.tree.map(lambda o: o / M, osum)
+    if with_obs:
+        return lsum / M, grads, res, obs
     return lsum / M, grads, res
 
 

@@ -214,18 +214,17 @@ class Fleet:
             return fn, out, vol
 
         fn, out_spec, vol = make(comm_type)
-        from jax import shard_map as _smap
+        # version-proof shard_map (jax 0.4.x has no top-level jax.shard_map
+        # and spells the replication-check kwarg check_rep) — the same
+        # compat shim every engine uses
+        from ...utils import shard_map as _smap
         for mb in sizes_mb:
             elems = max(mb * (1 << 20) // 4 // (n * n) * (n * n), n * n)
             x = jax.device_put(
                 jnp.ones((elems,), jnp.float32),
                 NamedSharding(mesh, P("x")))
-            try:
-                smapped = _smap(fn, mesh=mesh, in_specs=P("x"),
-                                out_specs=out_spec, check_vma=False)
-            except TypeError:  # older jax spells the flag check_rep
-                smapped = _smap(fn, mesh=mesh, in_specs=P("x"),
-                                out_specs=out_spec, check_rep=False)
+            smapped = _smap(fn, mesh=mesh, in_specs=P("x"),
+                            out_specs=out_spec)
             run = jax.jit(smapped)
             run(x).block_until_ready()  # compile
             t0 = time.perf_counter()

@@ -23,7 +23,25 @@ import sys
 
 import numpy as np
 
-__all__ = ["run_training", "spawn_cluster", "spawn_and_check", "main"]
+__all__ = ["run_training", "spawn_cluster", "spawn_and_check", "main",
+           "ClusterUnsupported"]
+
+
+class ClusterUnsupported(RuntimeError):
+    """The platform cannot run the spawned multi-process cluster at all
+    (as opposed to the workload failing): the jax build lacks
+    cross-process CPU collectives, the coordinator cannot bind, etc.
+    Tests catch this to SKIP with the reason instead of erroring."""
+
+
+# worker-output signatures that mean "this platform can't do multiprocess
+# jax", not "the workload is broken" — deliberately NARROW: rendezvous
+# timeouts / hangs / init-path tracebacks stay hard failures (a deadlock
+# regression must not report as "platform cannot spawn")
+_UNSUPPORTED_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "multi-process computations are not supported",
+)
 
 # env that would leak the parent's jax/launcher identity into workers
 _SCRUB_ENV = ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES",
@@ -79,6 +97,11 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
                 p.kill()
     for p, out in zip(procs, outs):
         if p.returncode != 0:
+            for marker in _UNSUPPORTED_MARKERS:
+                if marker in out:
+                    raise ClusterUnsupported(
+                        f"platform cannot run the {nproc}-process cluster "
+                        f"({marker!r}):\n{out[-1500:]}")
             raise RuntimeError(f"worker failed (rc={p.returncode}):\n"
                                f"{out[-4000:]}")
     results = []

@@ -142,6 +142,15 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
     if grace_s is None:
         grace_s = float(flag("preempt_grace_s"))
 
+    def _emit(event: str, **fields):
+        """Crash-forensics JSONL (observability.events): every lifecycle
+        decision the loop takes — resume/skip/commit/SIGTERM/abort — lands
+        as one flushed line when FLAGS_telemetry_jsonl is set."""
+        from ...observability import get_event_log
+        log = get_event_log()
+        if log is not None:
+            log.emit(event, **fields)
+
     wd = watchdog or CommWatchdog(poll_interval=0.2)
     own_wd = watchdog is None
     escalation = {"pending": False}
@@ -175,12 +184,16 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
             state, start_step = template["state"], int(loaded["step"])
             info["resumed_from"] = ckpt
             assert start_step == checkpoint_step(ckpt)
+            _emit("resilience_resume", checkpoint=ckpt, step=start_step)
+    _emit("resilience_run_start", steps=steps, start_step=start_step,
+          ckpt_dir=ckpt_dir, ckpt_every=ckpt_every)
 
     def _commit(next_step, **kw):
         path = commit_checkpoint({"step": next_step, "state": state},
                                  ckpt_dir, next_step, store=store,
                                  keep_n=keep_n, **kw)
         info["final_checkpoint"] = path
+        _emit("resilience_commit", step=next_step, path=path)
         return path
 
     progress = {"done": start_step, "nonfinite": 0}
@@ -204,6 +217,8 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                 # keep the last good state
                 progress["nonfinite"] += 1
                 info["nonfinite_skips"] += 1
+                _emit("resilience_nonfinite_skip", step=i, loss=loss_val,
+                      consecutive=progress["nonfinite"])
                 if progress["nonfinite"] >= max_consecutive_nonfinite:
                     from ...amp.grad_scaler import nonfinite_report
                     raise NonFiniteLossError(
@@ -244,6 +259,8 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                 info["preempted"] = True
             done = progress["done"]
             if info["preempted"]:
+                _emit("resilience_sigterm", step=done,
+                      watchdog_abort=info["watchdog_abort"])
                 # preemption drain: flush in-flight async writers, then one
                 # final SYNCHRONOUS commit inside the grace budget
                 t0 = time.monotonic()
@@ -272,6 +289,11 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
 
     info["completed_steps"] = done
     info["watchdog"] = wd.stats()
+    _emit("resilience_run_end", completed_steps=done,
+          preempted=info["preempted"],
+          watchdog_abort=info["watchdog_abort"],
+          nonfinite_skips=info["nonfinite_skips"],
+          final_checkpoint=info["final_checkpoint"])
     if info["watchdog_abort"]:
         raise WatchdogTimeout(
             f"step {done} exceeded its {step_timeout}s budget; final "

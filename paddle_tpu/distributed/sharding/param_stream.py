@@ -56,11 +56,29 @@ import jax.numpy as jnp
 from .group_sharded import _leaf_streamable
 
 __all__ = ["build_param_streamed_train_step", "host_sharding",
-           "device_sharding", "park", "fetch"]
+           "device_sharding", "park", "fetch", "supports_pinned_host"]
 
 
 def _dev(device=None):
     return device if device is not None else jax.devices()[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _pinned_host_supported(device) -> bool:
+    try:
+        sh = jax.sharding.SingleDeviceSharding(device,
+                                               memory_kind="pinned_host")
+        jax.device_put(jnp.zeros((1,), jnp.float32), sh)
+        return True
+    except Exception:
+        return False
+
+
+def supports_pinned_host(device=None) -> bool:
+    """Whether the backend can address a ``pinned_host`` memory kind (TPU
+    runtimes can; CPU jax 0.4.x exposes only ``unpinned_host``). The
+    offload/streaming tiers need it; tests skip cleanly without it."""
+    return _pinned_host_supported(_dev(device))
 
 
 def host_sharding(device=None):

@@ -412,6 +412,41 @@ define_flag("xla_latency_hiding_scheduler", False,
             "per-bucket collectives actually hide under backward "
             "compute.", on_set=apply_xla_overlap_flags)
 
+# --- observability / telemetry ---------------------------------------------
+# (consumed by paddle_tpu.observability + models.hybrid_engine telemetry=,
+# Model.fit, resilience.run_resilient, inference.serving; see README
+# "Observability")
+define_flag("telemetry", False,
+            "Enable in-program telemetry: a fixed-shape metrics buffer "
+            "(loss, grad global-norm, nonfinite counts, comms wire bytes, "
+            "fp8 amax/scale drift + observe() series) rides the train-step "
+            "carry and is fetched every FLAGS_telemetry_interval steps. "
+            "Off = strict no-op: the compiled step is bitwise identical "
+            "(consumed by observability.telemetry_from_flags via "
+            "hybrid_engine.build_train_step(telemetry='auto')).")
+define_flag("telemetry_interval", 10,
+            "Steps per telemetry ring buffer / host fetch: one device "
+            "fetch per interval, zero extra dispatches (consumed by "
+            "observability.TelemetryConfig).")
+define_flag("telemetry_extra", "",
+            "Comma-separated user series names (observe() targets beyond "
+            "the builtins) registered into the flag-driven telemetry "
+            "buffer. Flag-driven configs are non-strict: an observed name "
+            "not registered here warns and drops instead of failing the "
+            "trace (consumed by observability.telemetry_from_flags).")
+define_flag("telemetry_jsonl", "",
+            "Path of the structured JSONL event log (flushed per line for "
+            "crash forensics). Empty disables it. Producers: the resilient "
+            "runner (resume/commit/skip/SIGTERM), TelemetryHost metric "
+            "intervals, Model.fit step reports, serving admits (consumed "
+            "by observability.events.get_event_log).")
+define_flag("telemetry_prometheus_port", 0,
+            "Port for the Prometheus text-format /metrics endpoint the "
+            "serving engine exposes (TTFT, tokens/s, queue depth, KV-pool "
+            "utilization, decode/prefill mix). 0 disables (consumed by "
+            "observability.prom.serve_registry via "
+            "inference.ServingEngine.serve_metrics).")
+
 # --- data / io -------------------------------------------------------------
 define_flag("dataloader_num_workers", 0,
             "Default DataLoader worker count when none is passed "

@@ -30,6 +30,19 @@ from .callbacks import config_callbacks
 __all__ = ["Model"]
 
 
+def _timed_iter(it, timer, name):
+    """Attribute the wall time spent WAITING on the input pipeline to a
+    StepTimer phase (the reader span of profiler.Benchmark, unified with
+    the observability step accounting)."""
+    while True:
+        with timer.phase(name):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
+
+
 def _metric_update(m: Metric, pred, labels):
     """Reference contract (hapi/model.py): update(*to_list(compute(...))) —
     compute may return a single array or a tuple to splat into update."""
@@ -170,6 +183,15 @@ class Model:
             self._train_step_fn = self._build_train_step()
         self.stop_training = False
 
+        # observability: with FLAGS_telemetry on, fit() accounts compile
+        # vs steady-state step time and the data-wait phase, and emits a
+        # fit_report event to the JSONL log at the end of training
+        from ..flags import flag as _flag
+        tele_timer = None
+        if _flag("telemetry"):
+            from ..observability import StepTimer
+            tele_timer = StepTimer()
+
         res = None
         if resilient:
             from ..distributed.resilience.fit import FitResilience
@@ -225,23 +247,29 @@ class Model:
                 # DMA rides under the current step's compute (async
                 # device_put) instead of serializing before each dispatch
                 from ..io import prefetch_to_device
-                for step, batch in enumerate(
-                        prefetch_to_device(batches, size=2),
-                        start=epoch_skip):
+                feed = prefetch_to_device(batches, size=2)
+                if tele_timer is not None:
+                    feed = _timed_iter(feed, tele_timer, "data")
+                for step, batch in enumerate(feed, start=epoch_skip):
                     cbks.on_train_batch_begin(step)
                     inputs, labels = self._split_batch(batch)
                     lr = self._optimizer.get_lr()
                     key = jax.random.fold_in(step_key, global_step)
-                    with (res.watch() if res is not None
+                    with (tele_timer.step() if tele_timer is not None
                           else contextlib.nullcontext()):
-                        (self._params, self._buffers, self._opt_state, loss,
-                         outputs) = self._train_step_fn(
-                            self._params, self._frozen, self._buffers,
-                            self._opt_state,
-                            jnp.asarray(lr, jnp.float32), key,
-                            tuple(jnp.asarray(x) for x in inputs),
-                            tuple(jnp.asarray(y) for y in labels))
-                    logs = {"loss": float(loss), "lr": lr}
+                        with (res.watch() if res is not None
+                              else contextlib.nullcontext()):
+                            (self._params, self._buffers, self._opt_state,
+                             loss, outputs) = self._train_step_fn(
+                                self._params, self._frozen, self._buffers,
+                                self._opt_state,
+                                jnp.asarray(lr, jnp.float32), key,
+                                tuple(jnp.asarray(x) for x in inputs),
+                                tuple(jnp.asarray(y) for y in labels))
+                        # the fetch is INSIDE the step span: without it
+                        # the timer would measure dispatch, not execution
+                        loss_val = float(loss)
+                    logs = {"loss": loss_val, "lr": lr}
                     for m in self._metrics:
                         r = _metric_update(m, outputs[0], labels)
                         logs[m.name() if isinstance(m.name(), str)
@@ -275,6 +303,12 @@ class Model:
             if res is not None:
                 res.__exit__(None, None, None)
         cbks.on_train_end()
+        if tele_timer is not None:
+            self.last_fit_telemetry = tele_timer.report()
+            from ..observability import get_event_log
+            log = get_event_log()
+            if log is not None:
+                log.emit("fit_report", report=self.last_fit_telemetry)
         self._sync_to_network()
         hist = [c for c in cbks.callbacks if type(c).__name__ == "History"]
         return hist[0].history if hist else None

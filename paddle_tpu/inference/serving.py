@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -63,6 +64,9 @@ class Request:
     prefill_done: int = 0
     output: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # telemetry (observability): submit wall clock + time-to-first-token
+    submit_time: float = 0.0
+    ttft_s: Optional[float] = None
 
 
 def _embed(params, tokens, pos, cfg):
@@ -329,6 +333,15 @@ class ServingEngine:
         self.adaptive_burst = adaptive_burst
         self.decode_microsteps = 0  # device decode steps issued (telemetry)
         self._pending_tok = np.zeros((max_batch,), np.int32)
+        # -- observability: per-engine Prometheus registry (TTFT, tokens/s,
+        # queue depth, KV-pool utilization, decode/prefill mix). Pure host
+        # floats updated inside step() — a scrape never adds a dispatch.
+        from ..observability import PromRegistry
+        self._num_blocks = num_blocks
+        self._prom = PromRegistry(namespace="paddle_tpu_serving")
+        self._metrics_server = None
+        self._t_first_step: Optional[float] = None
+        self._tokens_total = 0
 
         # params ride as ARGUMENTS (a closure would bake 4 bytes/param
         # into the serialized HLO — megabytes that also defeat donation)
@@ -439,9 +452,20 @@ class ServingEngine:
                     eos_id=None, on_token=None) -> int:
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(Request(rid, np.asarray(prompt, np.int32),
-                                  int(max_new_tokens), temperature, eos_id,
-                                  on_token))
+        r = Request(rid, np.asarray(prompt, np.int32),
+                    int(max_new_tokens), temperature, eos_id, on_token)
+        r.submit_time = time.perf_counter()
+        self.queue.append(r)
+        self._prom.counter_inc("requests_total",
+                               help="requests ever submitted")
+        self._prom.gauge_set("queue_depth", len(self.queue),
+                             help="requests waiting for a slot")
+        from ..observability import get_event_log
+        log = get_event_log()
+        if log is not None:
+            log.emit("serving_admit", rid=rid, prompt_len=len(r.prompt),
+                     max_new_tokens=r.max_new_tokens,
+                     queue_depth=len(self.queue))
         return rid
 
     def has_work(self) -> bool:
@@ -497,6 +521,12 @@ class ServingEngine:
     def _emit(self, r: Request, tok: int) -> bool:
         """Record a sampled token; True if the request just finished."""
         r.output.append(tok)
+        self._tokens_total += 1
+        if len(r.output) == 1:
+            r.ttft_s = time.perf_counter() - r.submit_time
+            self._prom.summary_observe(
+                "ttft_seconds", r.ttft_s,
+                help="submit-to-first-token latency")
         if r.on_token is not None:
             r.on_token(r.rid, tok)
         return (len(r.output) >= r.max_new_tokens
@@ -505,8 +535,22 @@ class ServingEngine:
     def step(self) -> List[Request]:
         """One engine iteration: admit -> one prefill chunk -> one decode
         step for all decoding slots. Returns requests finished this step."""
+        t_step0 = time.perf_counter()
+        if self._t_first_step is None:
+            self._t_first_step = t_step0
+        tokens_before = self._tokens_total
         finished: List[Request] = []
         self._admit()
+        # sample pool pressure while this step's admissions HOLD their
+        # blocks — end-of-step sampling would miss requests that allocate
+        # and complete within one engine step (block 0 is the reserved
+        # scratch block, never allocatable)
+        total_blocks = self._num_blocks - 1
+        if total_blocks:
+            self._prom.gauge_max(
+                "kv_pool_utilization_peak",
+                1.0 - len(self.free_blocks) / total_blocks,
+                help="high-water allocated fraction of the KV pool")
 
         # ---- one chunked-prefill slice for EVERY prefilling slot (one
         # program, one dispatch — not one engine step per request)
@@ -590,7 +634,76 @@ class ServingEngine:
                         finished.append(r)
                         self._finish(r)
                         break
+
+        self._step_metrics(t_step0, tokens_before, len(pre), len(dec),
+                           finished)
         return finished
+
+    # -- observability -------------------------------------------------------
+    def _step_metrics(self, t_step0, tokens_before, n_pre, n_dec, finished):
+        prom = self._prom
+        dt = max(time.perf_counter() - t_step0, 1e-9)
+        emitted = self._tokens_total - tokens_before
+        # end-of-step (post-free) pool state; the PEAK gauge is sampled
+        # post-admit at the top of step(), where the blocks are held
+        total = self._num_blocks - 1
+        util = 1.0 - len(self.free_blocks) / total if total else 0.0
+        prom.gauge_set("kv_pool_utilization", util,
+                       help="allocated fraction of the paged KV pool")
+        prom.gauge_max("kv_pool_utilization_peak", util)
+        prom.gauge_set("queue_depth", len(self.queue))
+        prom.gauge_set("running_requests",
+                       sum(s is not None for s in self.slots),
+                       help="slots occupied this step")
+        prom.counter_inc("engine_steps_total", help="engine iterations")
+        prom.counter_inc("tokens_total", emitted,
+                         help="sampled tokens emitted")
+        prom.counter_inc("prefill_slots_total", n_pre,
+                         help="slot-steps spent prefilling")
+        prom.counter_inc("decode_slots_total", n_dec,
+                         help="slot-steps spent decoding")
+        prom.gauge_set("prefill_decode_mix",
+                       n_pre / (n_pre + n_dec) if (n_pre + n_dec) else 0.0,
+                       help="prefill share of this step's active slots")
+        prom.gauge_set("step_tokens_per_sec", emitted / dt,
+                       help="tokens emitted by the last engine step / its "
+                            "wall time")
+        elapsed = max(time.perf_counter() - self._t_first_step, 1e-9)
+        prom.gauge_set("tokens_per_sec", self._tokens_total / elapsed,
+                       help="tokens emitted since the first engine step / "
+                            "elapsed wall time")
+        prom.counter_inc("requests_completed_total", len(finished),
+                         help="requests finished")
+        if finished:
+            from ..observability import get_event_log
+            log = get_event_log()
+            for r in finished:
+                prom.summary_observe(
+                    "request_seconds",
+                    time.perf_counter() - r.submit_time,
+                    help="submit-to-completion latency")
+                if log is not None:
+                    log.emit("serving_complete", rid=r.rid,
+                             tokens=len(r.output), ttft_s=r.ttft_s)
+
+    def metrics_text(self) -> str:
+        """Prometheus text-format exposition of the engine's telemetry
+        (TTFT, tokens/s, queue depth, KV-pool utilization, decode/prefill
+        mix) — the payload serve_metrics() exposes over HTTP."""
+        return self._prom.render()
+
+    @property
+    def prom(self):
+        return self._prom
+
+    def serve_metrics(self, port: Optional[int] = None):
+        """Start (or return) the /metrics HTTP endpoint. port None reads
+        FLAGS_telemetry_prometheus_port (0 there = disabled -> None);
+        port=0 binds an ephemeral port (read it from .port)."""
+        if self._metrics_server is None:
+            from ..observability import serve_registry
+            self._metrics_server = serve_registry(self._prom, port)
+        return self._metrics_server
 
 
 def generate_static_batch(params, cfg, prompts, max_new_tokens_list,

@@ -580,7 +580,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             virtual_pp: int = 1, schedule: str = "1F1B",
                             grad_reduce_dtype="auto",
                             zero1_dp: bool = False, comm_overlap="auto",
-                            fp8="auto"):
+                            fp8="auto", telemetry="auto"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -634,7 +634,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        comm_overlap=comm_overlap, fp8=fp8_plan)
+        comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

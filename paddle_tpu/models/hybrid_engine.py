@@ -142,33 +142,55 @@ def _spec_axes(spec: P) -> set:
     return s
 
 
-def _global_clip_scale(red, leaves_spec, leaves_z, mesh: Mesh, dp_axis,
-                       clip):
-    """TRUE global-norm clip coefficient inside shard_map: each leaf's
-    local sum-of-squares is divided by its replication factor (product of
-    mesh axes it is NOT sharded over), then one psum over ALL mesh axes
-    re-multiplies exactly once per distinct element. This is the
-    reference's HybridParallelClipGrad discipline
-    (hybrid_parallel_optimizer.py:41 — partial norms combined across
-    mp/pp/sharding before one shared coefficient); a naive
-    ClipGradByGlobalNorm under shard_map would clip each model-parallel
-    rank with a DIFFERENT partial norm."""
-    from ..nn.clip import sum_squares
+def _repl_factor(spec, zd, mesh: Mesh, dp_axis) -> int:
+    """How many ranks hold a copy of this leaf: product of mesh axes it is
+    NOT sharded over (zd >= 0 adds the ZeRO dp sharding)."""
+    sharded = _spec_axes(spec)
+    if zd is not None and zd >= 0:
+        sharded = sharded | {dp_axis}
+    repl = 1
+    for a in mesh.axis_names:
+        if a not in sharded:
+            repl *= mesh.shape[a]
+    return repl
 
-    all_axes = tuple(mesh.axis_names)
-    n2 = jnp.zeros((), jnp.float32)
+
+def _global_leaf_reduce(per_leaf, red, leaves_spec, leaves_z, mesh: Mesh,
+                        dp_axis):
+    """Replication-aware global reduction over a sharded grad list: each
+    leaf's local `per_leaf(g)` (an fp32 scalar) is divided by its
+    replication factor, then ONE psum over every mesh axis counts each
+    distinct element exactly once. The shared accounting under the
+    global-norm clip and the telemetry grad-norm/nonfinite series."""
+    acc = jnp.zeros((), jnp.float32)
     for g, sp, zd in zip(red, leaves_spec, leaves_z):
         if g is None:
             continue
-        sharded = _spec_axes(sp)
-        if zd is not None and zd >= 0:
-            sharded = sharded | {dp_axis}
-        repl = 1
-        for a in all_axes:
-            if a not in sharded:
-                repl *= mesh.shape[a]
-        n2 = n2 + sum_squares([g]) / repl
-    n2 = lax.psum(n2, all_axes)
+        acc = acc + per_leaf(g) / _repl_factor(sp, zd, mesh, dp_axis)
+    return lax.psum(acc, tuple(mesh.axis_names))
+
+
+def _global_sq_norm(red, leaves_spec, leaves_z, mesh: Mesh, dp_axis):
+    from ..nn.clip import sum_squares
+    return _global_leaf_reduce(lambda g: sum_squares([g]), red,
+                               leaves_spec, leaves_z, mesh, dp_axis)
+
+
+def _global_nonfinite_count(red, leaves_spec, leaves_z, mesh: Mesh,
+                            dp_axis):
+    return _global_leaf_reduce(
+        lambda g: jnp.sum((~jnp.isfinite(g)).astype(jnp.float32)),
+        red, leaves_spec, leaves_z, mesh, dp_axis)
+
+
+def _global_clip_scale(red, leaves_spec, leaves_z, mesh: Mesh, dp_axis,
+                       clip):
+    """TRUE global-norm clip coefficient inside shard_map (reference:
+    HybridParallelClipGrad, hybrid_parallel_optimizer.py:41 — partial
+    norms combined across mp/pp/sharding before one shared coefficient);
+    a naive ClipGradByGlobalNorm under shard_map would clip each
+    model-parallel rank with a DIFFERENT partial norm."""
+    n2 = _global_sq_norm(red, leaves_spec, leaves_z, mesh, dp_axis)
     return clip.scale_from_norm(jnp.sqrt(n2))
 
 
@@ -176,7 +198,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
-                     comm_overlap="auto", fp8=None):
+                     comm_overlap="auto", fp8=None, telemetry="auto",
+                     donate: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -212,6 +235,26 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     CommOverlapConfig to force, or None to disable. Self-synchronizing
     optimizers (_skips_grad_sync) own the dp axis, so overlap is inert
     for them — pair them with comm_overlap.make_merge_comm_fn instead.
+
+    telemetry: "auto" (FLAGS_telemetry, default off) / None /
+    observability.TelemetryConfig — in-program device metrics: a fixed
+    ring buffer {"data": f32[interval, n_series], "count": i32[]} rides
+    opt_state["telemetry"] exactly as fp8_meta/comm_ef do (composes with
+    both, and with zero1/donation), recording per step the loss, the
+    replication-aware global grad norm, the global nonfinite-element
+    count, the dp-collective wire bytes of THIS program's sync path
+    (monolithic / bucketed / int8 / reduce-scatter+all-gather, from the
+    same trace that issues them), fp8 amax/scale drift, and any
+    observability.observe() series made under the loss (threaded out of
+    value_and_grad — and out of the overlap scan — as aux outputs).
+    Fetch on the host with observability.TelemetryHost.poll: one device
+    fetch per interval, zero extra dispatches. When resolved off this is
+    a STRICT no-op — the compiled program is bitwise identical.
+
+    donate=True donates (params, opt_state) to the jitted step — the
+    telemetry/fp8/EF carries are donated with the rest, so none of the
+    bookkeeping costs a second resident copy. Off by default because a
+    donated carry must not be reused by the caller.
 
     fp8: a quantization.fp8.fp8_plan dict (models build it) enabling
     delayed-scaling fp8 GEMMs in the loss: loss_fn then takes a fourth
@@ -282,15 +325,41 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         from ..quantization import fp8 as _f8
         fp8_axes = tuple(a for a in fp8_plan.get("axes", ())
                          if a in mesh.axis_names)
+    # -- in-program telemetry (observability) --------------------------------
+    from .. import observability as _obs
+    tcfg = _obs.telemetry_from_flags() if telemetry == "auto" else telemetry
+    if tcfg is not None:
+        # rewrite (never merge) the build metadata: a config reused for a
+        # second build must not carry the previous engine's mesh/bucket
+        # accounting into this run's JSONL header
+        tcfg.static["mesh"] = {a: int(mesh.shape[a])
+                               for a in mesh.axis_names}
+        for k in ("comm_buckets_bytes", "comm_quantize",
+                  "comm_microbatches"):
+            tcfg.static.pop(k, None)
+        if ocfg is not None and example_params is not None:
+            # per-bucket wire bytes from the bucket plan over the LOCAL
+            # grad shapes (the int8 path's residual plan IS this plan)
+            plan = ef_plan if ef_plan is not None else _co.ef_plan_for(
+                example_params, specs, mesh, ocfg.bucket_bytes)
+            tcfg.static["comm_buckets_bytes"] = _obs.plan_wire_bytes(
+                plan, wire_itemsize=1 if ocfg.quantize else None)
+            tcfg.static["comm_quantize"] = ocfg.quantize or "none"
+            tcfg.static["comm_microbatches"] = ocfg.microbatches
+
+    # extra state riding the optimizer carry: the step signature and the
+    # checkpoint surface stay (params, state, batch..., lr) no matter
+    # which subset (EF residuals, fp8 meta, telemetry buffer) is on
     opt_sspec = sspec
+    wrap_specs = {}
     if ef_plan is not None:
-        # residuals ride the optimizer state so the step signature and
-        # checkpoint surface stay (params, state, batch..., lr)
-        sspec = {"opt": opt_sspec,
-                 "comm_ef": _co.ef_residual_specs(ef_plan, mesh)}
-    elif fp8_plan is not None:
-        # fp8 (scale, amax_history) state rides the same way
-        sspec = {"opt": opt_sspec, "fp8_meta": fp8_plan["specs"]}
+        wrap_specs["comm_ef"] = _co.ef_residual_specs(ef_plan, mesh)
+    if fp8_plan is not None:
+        wrap_specs["fp8_meta"] = fp8_plan["specs"]
+    if tcfg is not None:
+        wrap_specs["telemetry"] = _obs.buffer_specs(tcfg)
+    if wrap_specs:
+        sspec = {"opt": opt_sspec, **wrap_specs}
 
     def shard_params(params):
         return jax.tree.map(
@@ -304,14 +373,19 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             optimizer.init_state,
             out_shardings=jax.tree.map(
                 lambda s: NamedSharding(mesh, s), opt_sspec))(params)
+        extras = {}
         if ef_plan is not None:
-            return {"opt": inner,
-                    "comm_ef": _co.init_ef_residuals(ef_plan, mesh)}
+            extras["comm_ef"] = _co.init_ef_residuals(ef_plan, mesh)
         if fp8_plan is not None:
-            meta = jax.tree.map(
+            extras["fp8_meta"] = jax.tree.map(
                 lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
                 fp8_plan["init"](), fp8_plan["specs"])
-            return {"opt": inner, "fp8_meta": meta}
+        if tcfg is not None:
+            extras["telemetry"] = jax.tree.map(
+                lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+                _obs.init_buffer(tcfg), _obs.buffer_specs(tcfg))
+        if extras:
+            return {"opt": inner, **extras}
         return inner
 
     def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
@@ -322,7 +396,12 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         protocol comes from Optimizer._leaf_items (one implementation
         across every per-leaf loop). pre_reduced=True: grads arrived
         already scattered/averaged (the comm_overlap scan reduced them
-        under backward) — skip pass 1's collectives."""
+        under backward) — skip pass 1's collectives.
+
+        Returns (new_params, new_state, tele): tele is None unless
+        telemetry is on, else the grad-norm/nonfinite series computed
+        from the REDUCED (scattered) grads with the same replication
+        accounting the global-norm clip uses."""
         from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
 
         dp = mesh.shape[dp_axis]
@@ -354,6 +433,38 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                            scatter_dimension=zd,
                                            tiled=True) / dp).astype(g.dtype)
                 red.append(gm)
+
+        tele = None
+        if tcfg is not None:
+            tele = {
+                "grad_sq": _global_sq_norm(red, leaves_spec, leaves_z,
+                                           mesh, dp_axis),
+                "nonfinite": _global_nonfinite_count(
+                    red, leaves_spec, leaves_z, mesh, dp_axis),
+            }
+            # wire accounting (trace-time constants): RS/pmean of the
+            # grads (unless the overlap scan already counted them) + the
+            # param all-gather that closes every zero1 step
+            dpn = dp
+            f = (dpn - 1) / dpn
+            wire = (jnp.dtype(grad_reduce_dtype).itemsize
+                    if grad_reduce_dtype is not None else None)
+            rs_b = ag_b = 0.0
+            for (p, g, s, ctx, rng), zd in zip(items, leaves_z):
+                if g is None:
+                    continue
+                pb = float(p.size * jnp.dtype(p.dtype).itemsize)
+                gb = float(p.size * (wire if wire is not None
+                                     else jnp.dtype(p.dtype).itemsize))
+                if zd < 0:
+                    rs_b += 2 * f * gb   # pmean all-reduce
+                else:
+                    rs_b += f * gb       # psum_scatter
+                    ag_b += f * pb       # new-param all-gather
+            if not pre_reduced and tele_comms["reduce"] is None:
+                tele_comms["reduce"] = rs_b
+            if tele_comms["zero1"] is None:
+                tele_comms["zero1"] = ag_b
 
         scale = None
         if isinstance(clip, ClipGradByGlobalNorm):
@@ -398,12 +509,32 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
                 {"step": step_no,
-                 "slots": jax.tree.unflatten(treedef, new_s)})
+                 "slots": jax.tree.unflatten(treedef, new_s)},
+                tele)
+
+    def _overlap_bytes(g_leaves, z_leaves, wire_dtype):
+        """Trace-time dp wire bytes of ONE microbatch's overlap reduction
+        (ring accounting, same tables as fleet.collective_perf)."""
+        dpn = mesh.shape[dp_axis]
+        f = (dpn - 1) / dpn
+        total = 0.0
+        for g, zd in zip(g_leaves, z_leaves):
+            if g is None:
+                continue
+            if ocfg.quantize:
+                b = float(g.size)  # int8 codes on the wire
+            else:
+                wd = wire_dtype if wire_dtype is not None else g.dtype
+                b = float(g.size * jnp.dtype(wd).itemsize)
+            total += (f if (zero1_dp and zd >= 0) else 2 * f) * b
+        return total
 
     def _overlap_grads(params, tokens, labels, residuals):
         """Bucketed/overlapped dp gradient path: grads come back already
         dp-REDUCED (and scattered under zero1), with each microbatch's
-        per-bucket collectives issued inside the accumulation scan."""
+        per-bucket collectives issued inside the accumulation scan; with
+        telemetry on, observe() series collected under the loss ride out
+        as a 4th element."""
         dp = mesh.shape[dp_axis]
         extra_axes = tuple(extra_grad_axes)
         weight = 1.0 / ocfg.microbatches
@@ -417,6 +548,13 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 # sep/context-parallel partial grads combine in their own
                 # dtype, exactly as the monolithic path does
                 g = jax.tree.map(lambda x: lax.pmean(x, extra_axes), g)
+            if tcfg is not None and tele_comms["reduce"] is None:
+                # idempotent: the scan body may trace twice (eval_shape)
+                z_leaves = (jax.tree.structure(g).flatten_up_to(zdims)
+                            if zero1_dp else
+                            [-1] * len(jax.tree.leaves(g)))
+                tele_comms["reduce"] = ocfg.microbatches * _overlap_bytes(
+                    jax.tree.leaves(g), z_leaves, wire_dtype)
             if zero1_dp:
                 red = _co.reduce_scatter_tree(
                     g, zdims, dp_axis, axis_size=dp,
@@ -429,52 +567,116 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 reduce_dtype=(None if ocfg.quantize else wire_dtype),
                 weight=weight)
 
-        return _co.microbatched_reduced_grads(
+        out = _co.microbatched_reduced_grads(
             lambda p, t, l: loss_fn(p, t, l), params, (tokens, labels),
-            ocfg.microbatches, reduce_fn, residuals=residuals)
+            ocfg.microbatches, reduce_fn, residuals=residuals,
+            with_obs=tcfg is not None)
+        return out if tcfg is not None else out + ({},)
 
     def local_step(params, opt_state, tokens, labels, lr):
-        ef = fmeta = None
-        if ef_plan is not None:
-            ef, opt_state = opt_state["comm_ef"], opt_state["opt"]
-        if fp8_plan is not None:
-            fmeta, opt_state = opt_state["fp8_meta"], opt_state["opt"]
+        ef = fmeta = tbuf = None
+        if wrap_specs:
+            ef = opt_state.get("comm_ef")
+            fmeta = opt_state.get("fp8_meta")
+            tbuf = opt_state.get("telemetry")
+            opt_state = opt_state["opt"]
 
-        def rewrap(new_params, new_state, new_ef, new_fmeta, loss):
-            if ef_plan is not None:
-                new_state = {"opt": new_state, "comm_ef": new_ef}
-            if fp8_plan is not None:
-                new_state = {"opt": new_state, "fp8_meta": new_fmeta}
+        def tele_of(grads):
+            """grad-norm/nonfinite for the non-zero1 paths: grads are the
+            dp-SYNCHRONIZED tree here (after pmean / the overlap scan),
+            PRE-clip — the replication accounting matches the global-norm
+            clip's. (Self-synchronizing optimizers' unreduced grads yield
+            the dp-average of the local norms — a diagnostic, not the
+            norm of a synced gradient.)"""
+            treedef = jax.tree.structure(params)
+            lg = treedef.flatten_up_to(grads)
+            lsp = treedef.flatten_up_to(specs)
+            lz = [-1] * len(lg)
+            return {"grad_sq": _global_sq_norm(lg, lsp, lz, mesh, dp_axis),
+                    "nonfinite": _global_nonfinite_count(lg, lsp, lz, mesh,
+                                                         dp_axis)}
+
+        def rewrap(new_params, new_state, new_ef, new_fmeta, loss, *,
+                   tele=None, amax=None, obs=None):
+            """Common exit: fold this step's telemetry row into the ring
+            buffer, then re-attach the extra carries."""
+            new_tbuf = tbuf
+            if tcfg is not None:
+                vals = dict(obs or {})
+                vals["loss"] = loss
+                vals["grad_norm"] = jnp.sqrt(tele["grad_sq"])
+                vals["nonfinite_count"] = tele["nonfinite"]
+                vals["comms_bytes"] = ((tele_comms["reduce"] or 0.0)
+                                       + (tele_comms["zero1"] or 0.0))
+                if fp8_plan is not None and amax is not None:
+                    vals["fp8_amax_max"] = jnp.stack(
+                        [jnp.max(a) for a in jax.tree.leaves(amax)]).max()
+                    vals["fp8_scale_max"] = jnp.stack(
+                        [jnp.max(s) for s in
+                         jax.tree.leaves(_f8.scales_of(new_fmeta))]).max()
+                new_tbuf = _obs.update_buffer(tbuf, tcfg, vals)
+            if wrap_specs:
+                w = {"opt": new_state}
+                if ef_plan is not None:
+                    w["comm_ef"] = new_ef
+                if fp8_plan is not None:
+                    w["fp8_meta"] = new_fmeta
+                if tcfg is not None:
+                    w["telemetry"] = new_tbuf
+                new_state = w
             return new_params, new_state, loss
 
+        obs = {}
+        amax = None
         if ocfg is not None:
-            loss, grads, ef = _overlap_grads(params, tokens, labels, ef)
+            loss, grads, ef, obs = _overlap_grads(params, tokens, labels,
+                                                  ef)
             if zero1_dp:
-                new_params, new_state = _zero1_apply(
+                new_params, new_state, z1t = _zero1_apply(
                     params, grads, opt_state, lr, pre_reduced=True)
-                return rewrap(new_params, new_state, ef, fmeta, loss)
+                return rewrap(new_params, new_state, ef, fmeta, loss,
+                              tele=z1t, obs=obs)
         elif fp8_plan is not None:
             # grads over (params, scales): the scale cotangents ARE the
             # amax observations (quantization.fp8), pmax'd over the axes
             # scales are replicated on so every rank derives identical
             # next-step scales from the global amax
-            loss, (grads, amax) = jax.value_and_grad(
-                lambda p, s: loss_fn(p, tokens, labels, s),
-                argnums=(0, 1))(params, _f8.scales_of(fmeta))
+            fp8_loss = lambda p, s: loss_fn(p, tokens, labels, s)
+            if tcfg is not None:
+                def fp8_loss_obs(p, s):
+                    with _obs.collecting() as sink:
+                        l = fp8_loss(p, s)
+                    return l, _obs.metrics.obs_dict(sink)
+                (loss, obs), (grads, amax) = jax.value_and_grad(
+                    fp8_loss_obs, argnums=(0, 1), has_aux=True)(
+                        params, _f8.scales_of(fmeta))
+            else:
+                loss, (grads, amax) = jax.value_and_grad(
+                    fp8_loss, argnums=(0, 1))(params, _f8.scales_of(fmeta))
             if fp8_axes:
                 amax = jax.tree.map(lambda a: lax.pmax(a, fp8_axes), amax)
             fmeta = _f8.update_fp8_meta(fmeta, amax)
             if zero1_dp:
-                new_params, new_state = _zero1_apply(params, grads,
-                                                     opt_state, lr)
-                return rewrap(new_params, new_state, ef, fmeta, loss)
+                new_params, new_state, z1t = _zero1_apply(params, grads,
+                                                          opt_state, lr)
+                return rewrap(new_params, new_state, ef, fmeta, loss,
+                              tele=z1t, amax=amax, obs=obs)
         else:
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(p, tokens, labels))(params)
+            plain_loss = lambda p: loss_fn(p, tokens, labels)
+            if tcfg is not None:
+                def plain_loss_obs(p):
+                    with _obs.collecting() as sink:
+                        l = plain_loss(p)
+                    return l, _obs.metrics.obs_dict(sink)
+                (loss, obs), grads = jax.value_and_grad(
+                    plain_loss_obs, has_aux=True)(params)
+            else:
+                loss, grads = jax.value_and_grad(plain_loss)(params)
             if zero1_dp:
-                new_params, new_state = _zero1_apply(params, grads,
-                                                     opt_state, lr)
-                return rewrap(new_params, new_state, ef, fmeta, loss)
+                new_params, new_state, z1t = _zero1_apply(params, grads,
+                                                          opt_state, lr)
+                return rewrap(new_params, new_state, ef, fmeta, loss,
+                              tele=z1t, obs=obs)
         # dp gradient reduction (the EagerReducer equivalent — one pmean,
         # fused and overlapped by XLA). Self-synchronizing optimizers
         # (LocalSGD/DGC: _skips_grad_sync) own the dp axis but NOT the
@@ -499,6 +701,17 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 return g
 
             grads = jax.tree.map(reduce_one, grads)
+            if tcfg is not None and dp_axes and tele_comms["reduce"] is None:
+                # monolithic dp all-reduce wire bytes (trace-time const)
+                dpn = mesh.shape[dp_axis]
+                f = 2 * (dpn - 1) / dpn
+                wire = (jnp.dtype(grad_reduce_dtype).itemsize
+                        if grad_reduce_dtype is not None else None)
+                tele_comms["reduce"] = sum(
+                    f * g.size * (wire if wire is not None
+                                  else jnp.dtype(g.dtype).itemsize)
+                    for g in jax.tree.leaves(grads))
+        tele = tele_of(grads) if tcfg is not None else None
         # Norm-based clips under shard_map must see norms of WHOLE
         # tensors: the optimizer's own _grad_clip would compute each
         # mp/pp rank's norm from its local shard and scale shards of the
@@ -557,12 +770,20 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             new_p, new_slots = optimizer._apply_leaves(
                 params, grads, opt_state["slots"], lr, step_no)
             return rewrap(new_p, {"step": step_no, "slots": new_slots},
-                          ef, fmeta, loss)
+                          ef, fmeta, loss, tele=tele, amax=amax, obs=obs)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr)
-        return rewrap(new_params, new_state, ef, fmeta, loss)
+        return rewrap(new_params, new_state, ef, fmeta, loss, tele=tele,
+                      amax=amax, obs=obs)
 
+    # trace-time dp wire-byte accounting cells (telemetry comms_bytes):
+    # "reduce" is set once by whichever grad-sync path traces (monolithic
+    # pmean / overlap scan / zero1 pass 1), "zero1" by the param
+    # all-gather; a retrace re-derives identical values (grad shapes do
+    # not depend on the batch), so the idempotent set is safe
+    tele_comms = {"reduce": None, "zero1": None}
     step = _shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, sspec, data_spec, data_spec, P()),
         out_specs=(specs, sspec, P()))
-    return jax.jit(step), shard_params, init_state
+    return (jax.jit(step, donate_argnums=(0, 1) if donate else ()),
+            shard_params, init_state)

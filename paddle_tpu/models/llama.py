@@ -507,7 +507,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, grad_reduce_dtype="auto",
-                            zero1_dp: bool = False, fp8="auto"):
+                            zero1_dp: bool = False, fp8="auto",
+                            telemetry="auto"):
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
 
@@ -535,7 +536,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        fp8=fp8_plan)
+        fp8=fp8_plan, telemetry=telemetry)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

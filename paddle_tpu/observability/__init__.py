@@ -1,0 +1,52 @@
+"""Unified observability: in-program telemetry, step/MFU accounting and
+exportable traces across training and serving.
+
+The subsystem the perf work steers by (ISSUE 4): the bench's single
+tokens/s + MFU pair says nothing about WHERE step time goes; this package
+makes the split measurable without leaving the compiled program:
+
+* :mod:`.metrics` — ``observe(name, scalar)`` inside jitted code; a
+  fixed-shape ring buffer rides the train-step carry (like
+  ``opt_state["fp8_meta"]``) and the host fetches it once per
+  ``FLAGS_telemetry_interval`` steps. Built-in series: loss, grad
+  global-norm, nonfinite counts, dp-collective wire bytes (from the
+  comm_overlap bucket plans), FP8 amax/scale drift. Strict no-op
+  (bitwise-identical program) when ``FLAGS_telemetry`` is off.
+* :mod:`.step_timer` / :mod:`.flops` — compile vs steady-state split,
+  per-phase breakdown, analytic GPT/Llama FLOPs (fwd/bwd/remat-aware)
+  for MFU, comms fraction measured or estimated from bucket plans.
+* :mod:`.events` — flushed-per-line JSONL event log (crash forensics;
+  the resilient runner logs resumes/skips/commits/SIGTERM through it).
+* :mod:`.trace` — chrome-trace spans unified with ``paddle_tpu.profiler``.
+* :mod:`.prom` — Prometheus text-format scrape surface for the serving
+  engine (TTFT, tokens/s, queue depth, KV-pool utilization, decode/
+  prefill mix).
+
+Entry points: ``models.hybrid_engine.build_train_step(telemetry=)``,
+``Model.fit``, ``distributed.resilience.run_resilient``,
+``inference.ServingEngine`` and ``bench.py``. See README "Observability".
+"""
+
+from .events import EventLog, get_event_log, set_event_log
+from .flops import (collective_seconds, gpt_flops_per_token,
+                    llama_flops_per_token, mfu, param_count, peak_flops,
+                    plan_wire_bytes, transformer_flops_per_token)
+from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
+                      buffer_specs, collecting, init_buffer, observe,
+                      telemetry_from_flags, update_buffer)
+from .prom import MetricsServer, PromRegistry, serve_registry
+from .step_timer import StepTimer
+from .trace import capture_spans, span, write_chrome_trace
+
+__all__ = [
+    "TelemetryConfig", "TelemetryHost", "telemetry_from_flags", "observe",
+    "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
+    "update_buffer",
+    "StepTimer",
+    "gpt_flops_per_token", "llama_flops_per_token",
+    "transformer_flops_per_token", "param_count", "mfu", "peak_flops",
+    "collective_seconds", "plan_wire_bytes",
+    "EventLog", "get_event_log", "set_event_log",
+    "PromRegistry", "MetricsServer", "serve_registry",
+    "span", "capture_spans", "write_chrome_trace",
+]

@@ -1,0 +1,131 @@
+"""Structured JSONL event log — the crash-forensics channel.
+
+One JSON object per line, flushed per event, so a SIGKILL mid-run leaves
+every completed line readable (the same discipline as the resilience
+commit protocol's atomic writes). Schema: every event carries
+
+    {"ts": <unix seconds>, "pid": <os pid>, "event": "<kind>", ...fields}
+
+Producers: the resilient runner (resume/commit/skip/SIGTERM/abort), the
+TelemetryHost (decoded device-metric intervals), Model.fit (step reports)
+and the serving engine (admits/completions). The process-global log is
+bound to ``FLAGS_telemetry_jsonl``; pass an explicit :class:`EventLog`
+where a private file is wanted (tests, multi-run drivers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["EventLog", "get_event_log", "set_event_log"]
+
+
+class EventLog:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: str, **fields: Any) -> None:
+        rec = {"ts": round(time.time(), 6), "pid": os.getpid(),
+               "event": str(event)}
+        rec.update(fields)
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()  # per-line durability: forensics-friendly
+
+    def span(self, name: str):
+        """Host span recorded BOTH as begin/end JSONL events and as a
+        profiler HostEvent, so it lands in Profiler summaries/chrome
+        traces too (the unified-trace contract)."""
+        return _Span(self, name)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _Span:
+    def __init__(self, log: EventLog, name: str):
+        self._log = log
+        self._name = name
+        from ..profiler.utils import RecordEvent
+        self._rec = RecordEvent(name)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._log.emit("span_begin", name=self._name)
+        self._rec.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.end()
+        self._log.emit("span_end", name=self._name,
+                       duration_s=round(time.perf_counter() - self._t0, 6))
+        return False
+
+
+def _jsonable(x):
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return repr(x)
+
+
+_GLOBAL: Optional[EventLog] = None
+_GLOBAL_PATH: Optional[str] = None
+_EXPLICIT = False
+_LOCK = threading.Lock()
+
+
+def get_event_log() -> Optional[EventLog]:
+    """The process event log: an explicitly installed one
+    (:func:`set_event_log`) wins; otherwise the log bound to
+    FLAGS_telemetry_jsonl (None when the flag is empty), re-bound if the
+    flag changed since the last call."""
+    global _GLOBAL, _GLOBAL_PATH
+    with _LOCK:
+        if _EXPLICIT:
+            return _GLOBAL
+    from ..flags import flag
+    path = str(flag("telemetry_jsonl") or "")
+    with _LOCK:
+        if _EXPLICIT:
+            return _GLOBAL
+        if _GLOBAL is not None and _GLOBAL_PATH == path:
+            return _GLOBAL
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+            _GLOBAL, _GLOBAL_PATH = None, None
+        if path:
+            _GLOBAL = EventLog(path)
+            _GLOBAL_PATH = path
+    return _GLOBAL
+
+
+def set_event_log(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Install an explicit process log (tests/drivers) that shadows the
+    flag binding; set_event_log(None) restores flag-driven behavior.
+    Returns the previous log (not closed — caller owns both)."""
+    global _GLOBAL, _GLOBAL_PATH, _EXPLICIT
+    with _LOCK:
+        prev, _GLOBAL = _GLOBAL, log
+        _GLOBAL_PATH = log.path if log is not None else None
+        _EXPLICIT = log is not None
+    return prev

@@ -1,0 +1,149 @@
+"""Analytic FLOPs models for step accounting (MFU).
+
+One place for the math every bench/report needs (previously inlined in
+bench.py): per-token training FLOPs for the GPT and Llama families,
+fwd/bwd/remat-aware, plus the comms-time estimate that turns a
+comm_overlap bucket plan into an expected comms fraction.
+
+Conventions (the PaLM/Chinchilla accounting):
+
+* matmul params N (embeddings excluded) cost ``2N`` FLOPs/token forward
+  and ``4N`` backward — ``6N`` per trained token;
+* attention adds ``12 * L * H * S`` per token (QK^T + AV, fwd+bwd) for
+  seq len S — the causal-mask halving is deliberately NOT applied,
+  matching the frozen bench series;
+* ``model_flops`` counts the model's useful work (the MFU numerator);
+  ``hardware_flops`` additionally counts recomputation (full per-block
+  remat re-runs the forward: +2N +4LHS per token), which is what the
+  chip actually executes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["transformer_flops_per_token", "gpt_flops_per_token",
+           "llama_flops_per_token", "param_count", "mfu", "peak_flops",
+           "collective_seconds", "plan_wire_bytes"]
+
+_REMAT_MODES = ("none", "full", "selective")
+
+
+def param_count(params, exclude=("wte", "wpe", "emb", "embedding")) -> int:
+    """Matmul-relevant parameter count of a concrete/abstract param tree:
+    total leaves minus top-level embedding tables (6N-rule accounting)."""
+    import jax
+    import numpy as np
+    total = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(params))
+    emb = 0
+    if isinstance(params, dict):
+        for k in exclude:
+            if k in params:
+                emb += sum(int(np.prod(v.shape))
+                           for v in jax.tree.leaves(params[k]))
+    return total - emb
+
+
+def transformer_flops_per_token(*, n_params: int, num_layers: int,
+                                hidden_size: int, seq_len: int,
+                                remat: str = "none") -> Dict[str, float]:
+    """{"model": model FLOPs/token, "hardware": executed FLOPs/token}."""
+    if remat not in _REMAT_MODES:
+        raise ValueError(f"remat must be one of {_REMAT_MODES}, got {remat}")
+    attn = 12.0 * num_layers * hidden_size * seq_len
+    model = 6.0 * n_params + attn
+    fwd = 2.0 * n_params + attn / 3.0
+    hardware = model
+    if remat == "full":
+        hardware = model + fwd          # backward re-runs the forward
+    elif remat == "selective":
+        hardware = model + 0.5 * fwd    # half the forward recomputed
+    return {"model": model, "hardware": hardware}
+
+
+def _gpt_matmul_params(cfg) -> int:
+    h, L = cfg.hidden_size, cfg.num_layers
+    per_layer = 3 * h * h + h * h + h * cfg.ffn_hidden + cfg.ffn_hidden * h
+    return L * per_layer + h * cfg.vocab_size  # blocks + untied LM head
+
+
+def _llama_matmul_params(cfg) -> int:
+    h, L, d = cfg.hidden_size, cfg.num_layers, cfg.head_dim
+    kv = cfg.num_kv_heads * d
+    attn = h * h + 2 * h * kv + h * h              # q, k, v, o
+    ffn = 3 * h * cfg.intermediate_size            # gate, up, down
+    return L * (attn + ffn) + h * cfg.vocab_size
+
+
+def gpt_flops_per_token(cfg, seq_len: int, *, params=None,
+                        remat: str = "none") -> Dict[str, float]:
+    """FLOPs/token for a GPTConfig. Pass the concrete param tree to count
+    N exactly (what bench.py does — keeps its frozen series bit-stable);
+    otherwise N comes from the config analytically."""
+    n = (param_count(params) if params is not None
+         else _gpt_matmul_params(cfg))
+    return transformer_flops_per_token(
+        n_params=n, num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+        seq_len=seq_len, remat=remat)
+
+
+def llama_flops_per_token(cfg, seq_len: int, *, params=None,
+                          remat: str = "none") -> Dict[str, float]:
+    n = (param_count(params) if params is not None
+         else _llama_matmul_params(cfg))
+    return transformer_flops_per_token(
+        n_params=n, num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+        seq_len=seq_len, remat=remat)
+
+
+def peak_flops(devices=None) -> float:
+    """Per-chip peak (bf16 matmul FLOP/s) of the current backend. Known
+    TPU generations by device_kind; CPU gets a nominal 1e12 so MFU-shaped
+    numbers stay finite in smoke runs (never comparable to TPU rounds)."""
+    import jax
+    devices = devices if devices is not None else jax.devices()
+    kind = (getattr(devices[0], "device_kind", "") or "").lower()
+    table = {"v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v4": 275e12, "v6e": 918e12,
+             "v6 lite": 918e12, "v3": 123e12, "v2": 45e12}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12 if devices[0].platform.lower() == "tpu" else 1e12
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        peak: Optional[float] = None) -> float:
+    peak = peak_flops() if peak is None else peak
+    return tokens_per_sec * flops_per_token / peak
+
+
+# ---------------------------------------------------------------------------
+# Comms accounting from bucket plans.
+# ---------------------------------------------------------------------------
+def plan_wire_bytes(plan, *, wire_itemsize: Optional[int] = None) -> list:
+    """Per-bucket wire bytes of a comm_overlap BucketPlan (int8 quantized
+    plans pass wire_itemsize=1)."""
+    out = []
+    for b in plan.buckets:
+        if wire_itemsize is None:
+            out.append(int(b.nbytes))
+        else:
+            out.append(int(b.size * wire_itemsize))
+    return out
+
+
+def collective_seconds(wire_bytes: float, axis_size: int,
+                       bandwidth_gbs: float, op: str = "allreduce") -> float:
+    """Ring-algorithm time for one collective of `wire_bytes` payload over
+    `axis_size` ranks at `bandwidth_gbs` per-link GB/s (the accounting
+    collective_perf reports)."""
+    n = max(int(axis_size), 1)
+    if n == 1:
+        return 0.0
+    factor = {"allreduce": 2.0 * (n - 1) / n,
+              "reduce_scatter": (n - 1) / n,
+              "allgather": (n - 1) / n}.get(op)
+    if factor is None:
+        raise ValueError(f"unknown collective op {op!r}")
+    return wire_bytes * factor / (bandwidth_gbs * 1e9)

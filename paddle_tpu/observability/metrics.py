@@ -1,0 +1,274 @@
+"""In-program telemetry: a metrics registry usable inside jitted code.
+
+Reference: the profiler/statistics stack (python/paddle/profiler,
+paddle/fluid/platform/profiler) reports per-op host/device timings; this
+module is its device-METRICS half, built TPU-native: observations made
+inside the compiled train step accumulate into a fixed-shape ring buffer
+that rides the step carry (exactly as ``opt_state["fp8_meta"]`` and
+``opt_state["comm_ef"]`` do), and the host fetches the buffer once every
+``FLAGS_telemetry_interval`` steps — one extra device fetch per interval,
+zero extra dispatches, zero program changes when telemetry is off.
+
+Two producer surfaces:
+
+* **built-in series** — the hybrid engine computes grad global-norm,
+  nonfinite counts, per-step dp-collective wire bytes (from the
+  comm_overlap bucket plans), FP8 amax/scale drift and the loss, and
+  writes them into the buffer itself;
+* **user observations** — ``observe(name, scalar)`` anywhere under the
+  step's loss function. It is a *trace-time* registry: while the engine
+  traces the loss with :func:`collecting` active, observations are
+  captured and threaded out of the gradient transform as auxiliary
+  outputs; with telemetry off (no active collection) ``observe`` is
+  completely inert, so the compiled program is bitwise identical.
+
+The buffer layout is ``{"data": f32[interval, n_series], "count": i32[]}``
+with row ``count % interval`` written each step. Series order is
+``BUILTIN_SERIES + config.extra`` — deterministic from the config alone,
+so :class:`TelemetryHost` decodes fetched buffers without any side channel
+from the engine.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["TelemetryConfig", "telemetry_from_flags", "observe",
+           "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
+           "update_buffer", "TelemetryHost"]
+
+# always-present builtin slots (fp8 slots stay 0.0 when fp8 is off) — a
+# FIXED tuple so host decode needs only the config, never the engine
+BUILTIN_SERIES: Tuple[str, ...] = (
+    "loss", "grad_norm", "nonfinite_count", "comms_bytes",
+    "fp8_amax_max", "fp8_scale_max")
+
+_ACTIVE = threading.local()
+
+
+def observe(name: str, value) -> None:
+    """Record a named scalar from inside jitted code. A no-op unless a
+    telemetry collection is active for the current trace (the engine opens
+    one around the loss when ``telemetry`` is on), so sprinkling observe()
+    through model code costs nothing when telemetry is off."""
+    sink = getattr(_ACTIVE, "sink", None)
+    if sink is None:
+        return
+    import jax.numpy as jnp
+    sink.append((str(name), jnp.asarray(value, jnp.float32).reshape(())))
+
+
+@contextlib.contextmanager
+def collecting():
+    """Trace-time observation scope. Yields the sink list; the engine
+    turns it into a dict pytree and threads it out of value_and_grad as an
+    aux output (tracers never escape their trace)."""
+    prev = getattr(_ACTIVE, "sink", None)
+    _ACTIVE.sink = sink = []
+    try:
+        yield sink
+    finally:
+        _ACTIVE.sink = prev
+
+
+def obs_dict(sink: List[Tuple[str, Any]]) -> Dict[str, Any]:
+    """Collected observations as a dict pytree (string keys are static, so
+    the dict legally rides scan carries and aux outputs). Repeated names
+    accumulate by summation — a loop observing the same series adds up."""
+    out: Dict[str, Any] = {}
+    for name, v in sink:
+        out[name] = v if name not in out else out[name] + v
+    return out
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    """Device-telemetry knobs.
+
+    interval: steps between host fetches (ring-buffer depth).
+    extra: user series names (observe() targets beyond the builtins).
+    strict: with strict=True (the default for explicitly-built configs)
+        an observed name not listed in `extra` raises at trace time
+        rather than silently dropping data. Flag-driven configs
+        (telemetry_from_flags) are NON-strict: turning FLAGS_telemetry on
+        must never crash a model that observes series nobody registered —
+        unknown names are dropped with a one-time warning instead
+        (register them via FLAGS_telemetry_extra or an explicit config).
+    static: filled by the engine at build time with trace-time metadata
+        (per-bucket comms bytes from the bucket plan, wire dtype, axis
+        sizes); TelemetryHost emits it in the JSONL run header. The
+        engine rewrites it per build — reusing ONE config object across
+        several live engines leaves `static` (and the host header)
+        describing the most recent build only.
+    """
+    interval: int = 10
+    extra: Tuple[str, ...] = ()
+    strict: bool = True
+    static: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        self.interval = max(int(self.interval), 1)
+        self.extra = tuple(str(s) for s in self.extra)
+        dup = set(self.extra) & set(BUILTIN_SERIES)
+        if dup:
+            raise ValueError(f"extra series shadow builtins: {sorted(dup)}")
+
+    @property
+    def series(self) -> Tuple[str, ...]:
+        return BUILTIN_SERIES + self.extra
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series)
+
+
+def telemetry_from_flags() -> Optional[TelemetryConfig]:
+    """The flag-driven opt-in: None (strict no-op) unless FLAGS_telemetry
+    is set; interval from FLAGS_telemetry_interval, user series from
+    FLAGS_telemetry_extra (comma-separated). Non-strict — unregistered
+    observe() names warn and drop instead of failing the trace."""
+    from ..flags import flag
+    if not flag("telemetry"):
+        return None
+    extra = tuple(s.strip() for s in
+                  str(flag("telemetry_extra") or "").split(",")
+                  if s.strip())
+    return TelemetryConfig(interval=int(flag("telemetry_interval")),
+                           extra=extra, strict=False)
+
+
+# ---------------------------------------------------------------------------
+# Device buffer (rides the step carry as opt_state["telemetry"]).
+# ---------------------------------------------------------------------------
+def init_buffer(cfg: TelemetryConfig):
+    import jax.numpy as jnp
+    return {"data": jnp.zeros((cfg.interval, cfg.n_series), jnp.float32),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def buffer_specs(cfg: TelemetryConfig):
+    """Replicated specs — every rank writes the identical (or its local,
+    for the loss) row; the buffer is tiny ([interval, n_series] fp32)."""
+    del cfg
+    from jax.sharding import PartitionSpec as P
+    return {"data": P(), "count": P()}
+
+
+def update_buffer(buf, cfg: TelemetryConfig, values: Dict[str, Any]):
+    """Write one step's row at ``count % interval``. `values` maps series
+    name -> f32 scalar; builtin slots missing from `values` record 0.0.
+    An unknown name (not builtin, not in cfg.extra) is a build-time error
+    for strict configs; flag-driven (non-strict) configs warn once and
+    drop it."""
+    import jax.numpy as jnp
+    series = cfg.series
+    unknown = set(values) - set(series)
+    if unknown:
+        msg = (f"observe()d series {sorted(unknown)} not registered; add "
+               f"them to TelemetryConfig(extra=...) / "
+               f"FLAGS_telemetry_extra so the buffer has a slot")
+        if cfg.strict:
+            raise KeyError(msg)
+        import warnings
+        warnings.warn(msg + " — dropping them", stacklevel=2)
+    zero = jnp.zeros((), jnp.float32)
+    row = jnp.stack([jnp.asarray(values.get(s, zero),
+                                 jnp.float32).reshape(())
+                     for s in series])
+    idx = buf["count"] % cfg.interval
+    return {"data": buf["data"].at[idx].set(row),
+            "count": buf["count"] + 1}
+
+
+# ---------------------------------------------------------------------------
+# Host side: fetch + decode + JSONL.
+# ---------------------------------------------------------------------------
+class TelemetryHost:
+    """Fetches and decodes the device buffer on the interval cadence.
+
+    Call ``poll(state, step)`` after every train step with the step's
+    output carry; it issues ONE ``jax.device_get`` per completed interval
+    (``fetch_count`` says how many — the no-op/overhead tests assert it),
+    appends decoded rows to per-series host lists, and mirrors each
+    interval into the JSONL event log as a ``telemetry`` event.
+    ``flush(state)`` drains a partial tail interval at end of run."""
+
+    def __init__(self, cfg: TelemetryConfig, event_log=None):
+        self.cfg = cfg
+        self.series: Dict[str, List[float]] = {s: [] for s in cfg.series}
+        self.steps: List[int] = []
+        self.fetch_count = 0
+        self._event_log = event_log
+        self._header_emitted = False
+
+    def _log(self):
+        """An explicit event_log (ctor arg) wins; otherwise resolve the
+        flag-bound log FRESH on every use — get_event_log() closes and
+        rebinds on flag change, so caching its handle here would write to
+        a closed file (or silence logging forever if the flag was empty
+        at first poll)."""
+        if self._event_log is not None:
+            return self._event_log
+        from .events import get_event_log
+        return get_event_log()
+
+    def _emit_header(self):
+        log = self._log()
+        if log is not None and not self._header_emitted:
+            log.emit("telemetry_run", interval=self.cfg.interval,
+                     series=list(self.cfg.series),
+                     static=dict(self.cfg.static))
+            self._header_emitted = True
+
+    def _ingest(self, buf, n_rows: int):
+        import numpy as np
+        import jax
+        self.fetch_count += 1
+        host = jax.device_get(buf)  # the one fetch for this interval
+        data, count = np.asarray(host["data"]), int(host["count"])
+        interval = self.cfg.interval
+        # rows [count-n_rows, count) live at (step % interval); with a full
+        # interval that is simply rows 0..interval-1 in step order
+        first = count - n_rows
+        rows = [(s, data[s % interval]) for s in range(first, count)]
+        self._emit_header()
+        new = {}
+        for step, row in rows:
+            self.steps.append(step)
+            for i, name in enumerate(self.cfg.series):
+                self.series[name].append(float(row[i]))
+                new.setdefault(name, []).append(float(row[i]))
+        log = self._log()
+        if log is not None and rows:
+            log.emit("telemetry", first_step=rows[0][0],
+                     last_step=rows[-1][0], series=new)
+        return new
+
+    def _buf_of(self, state):
+        if isinstance(state, dict) and "telemetry" in state:
+            return state["telemetry"]
+        return None
+
+    def poll(self, state, step: int) -> Optional[Dict[str, List[float]]]:
+        """step is 0-based; fetches after steps interval-1, 2*interval-1,
+        ... Returns the interval's decoded series (or None between
+        fetches)."""
+        buf = self._buf_of(state)
+        if buf is None or (step + 1) % self.cfg.interval != 0:
+            return None
+        return self._ingest(buf, self.cfg.interval)
+
+    def flush(self, state) -> Optional[Dict[str, List[float]]]:
+        """Drain the partial tail interval (crash/end-of-run forensics)."""
+        buf = self._buf_of(state)
+        if buf is None:
+            return None
+        import jax
+        count = int(jax.device_get(buf["count"]))
+        tail = count - len(self.steps)
+        if tail <= 0:
+            return None
+        return self._ingest(buf, min(tail, self.cfg.interval))

@@ -1,0 +1,167 @@
+"""Prometheus text-format scrape surface (serving telemetry).
+
+A dependency-free subset of the Prometheus client: counters, gauges and
+summaries (sum+count pairs) rendered in text exposition format 0.0.4, plus
+a tiny threaded HTTP server exposing ``/metrics``. The serving engine
+keeps a :class:`PromRegistry` per process and updates it inside
+``ServingEngine.step``; ops point a scraper (or curl) at the port.
+
+No pull-time device work: every metric is a host float updated on the
+engine's own schedule, so a scrape can never add a TPU dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+__all__ = ["PromRegistry", "MetricsServer", "serve_registry"]
+
+_TYPES = ("counter", "gauge", "summary")
+
+
+class _Metric:
+    __slots__ = ("name", "mtype", "help", "value", "sum", "count")
+
+    def __init__(self, name: str, mtype: str, help_: str):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_
+        self.value = 0.0   # counter/gauge
+        self.sum = 0.0     # summary
+        self.count = 0
+
+
+class PromRegistry:
+    def __init__(self, namespace: str = "paddle_tpu"):
+        self.namespace = namespace
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, mtype: str, help_: str) -> _Metric:
+        assert mtype in _TYPES, mtype
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = _Metric(name, mtype, help_)
+            elif m.mtype != mtype:
+                raise ValueError(f"metric {name} is a {m.mtype}, "
+                                 f"not {mtype}")
+            return m
+
+    # -- update surface ------------------------------------------------------
+    def counter_inc(self, name: str, amount: float = 1.0, help: str = ""):
+        m = self._get(name, "counter", help)
+        with self._lock:
+            m.value += amount
+
+    def gauge_set(self, name: str, value: float, help: str = ""):
+        m = self._get(name, "gauge", help)
+        with self._lock:
+            m.value = float(value)
+
+    def gauge_max(self, name: str, value: float, help: str = ""):
+        """Set-if-greater — peak gauges (e.g. peak pool utilization)."""
+        m = self._get(name, "gauge", help)
+        with self._lock:
+            m.value = max(m.value, float(value))
+
+    def summary_observe(self, name: str, value: float, help: str = ""):
+        m = self._get(name, "summary", help)
+        with self._lock:
+            m.sum += float(value)
+            m.count += 1
+
+    def get(self, name: str) -> Optional[float]:
+        """Current value (summaries: mean of observations); None if the
+        metric was never touched. Accepts the bare or namespaced name."""
+        prefix = f"{self.namespace}_"
+        if self.namespace and name.startswith(prefix):
+            name = name[len(prefix):]
+        m = self._metrics.get(name)
+        if m is None:
+            return None
+        if m.mtype == "summary":
+            return m.sum / m.count if m.count else None
+        return m.value
+
+    # -- exposition ----------------------------------------------------------
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        ns = self.namespace
+        for m in metrics:
+            full = f"{ns}_{m.name}" if ns else m.name
+            if m.help:
+                lines.append(f"# HELP {full} {m.help}")
+            lines.append(f"# TYPE {full} {m.mtype}")
+            if m.mtype == "summary":
+                lines.append(f"{full}_sum {_fmt(m.sum)}")
+                lines.append(f"{full}_count {m.count}")
+            else:
+                lines.append(f"{full} {_fmt(m.value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class MetricsServer:
+    """Threaded /metrics endpoint over a registry (or any render()-able).
+    port=0 binds an ephemeral port; read it back from ``.port``."""
+
+    def __init__(self, registry: PromRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = reg.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                del a
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="paddle-tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def serve_registry(registry: PromRegistry,
+                   port: Optional[int] = None) -> Optional[MetricsServer]:
+    """Start a scrape endpoint; port None reads
+    FLAGS_telemetry_prometheus_port (0 = disabled -> None)."""
+    if port is None:
+        from ..flags import flag
+        port = int(flag("telemetry_prometheus_port"))
+        if port <= 0:
+            return None
+    return MetricsServer(registry, port=max(port, 0))

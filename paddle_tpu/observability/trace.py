@@ -1,0 +1,61 @@
+"""Chrome-trace spans, unified with the profiler's scheduler machinery.
+
+``span(name)`` IS the profiler's :class:`RecordEvent` — a span opened
+through the observability surface lands in the same process-global
+collector the :class:`paddle_tpu.profiler.Profiler` state machine drains,
+so its summary tables and ``export_chrome_tracing`` windows see telemetry
+spans with no extra plumbing. ``write_chrome_trace`` is the standalone
+export for code that wants a trace file without driving a Profiler
+session (same JSON schema as the profiler's exporter, so the files are
+interchangeable in chrome://tracing / Perfetto).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from ..profiler.utils import HostEvent, RecordEvent, collector
+
+__all__ = ["span", "capture_spans", "write_chrome_trace"]
+
+span = RecordEvent
+
+
+class capture_spans:
+    """Enable the host-span collector for a scope and hand back the events
+    recorded inside it (independent of any Profiler session; nested inside
+    one, the profiler keeps collecting — events are split, not lost)."""
+
+    def __enter__(self):
+        self._was_enabled = collector.enabled
+        collector.enabled = True
+        self.events: list = []
+        return self
+
+    def __exit__(self, *exc):
+        self.events = collector.drain()
+        collector.enabled = self._was_enabled
+        if self._was_enabled:
+            # hand the drained events back to the outer profiler session
+            for ev in self.events:
+                collector.add(ev)
+        return False
+
+
+def write_chrome_trace(path: str, events: Iterable[HostEvent],
+                       extra: Optional[Iterable[dict]] = None) -> str:
+    """Write chrome://tracing JSON from HostEvents (plus optional raw
+    trace dicts — e.g. instant events from a JSONL log)."""
+    trace = [{"name": ev.name, "ph": "X", "cat": ev.event_type,
+              "ts": ev.start * 1e6, "dur": ev.duration * 1e6,
+              "pid": os.getpid(), "tid": ev.tid}
+             for ev in events]
+    trace.extend(extra or ())
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace}, f)
+    return path

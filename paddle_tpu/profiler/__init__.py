@@ -1,5 +1,12 @@
 """paddle.profiler equivalent (reference: python/paddle/profiler/ +
-C++ tracers paddle/fluid/platform/profiler/ — SURVEY §5 tracing)."""
+C++ tracers paddle/fluid/platform/profiler/ — SURVEY §5 tracing).
+
+This package is the HOST-SPAN half (scheduler state machine, summary
+tables, chrome-trace export, step Benchmark timer). Device metrics,
+step/MFU accounting, JSONL event logs and the serving Prometheus scrape
+live in :mod:`paddle_tpu.observability` — `observability.span` records
+through this package's collector, so spans opened there appear in
+Profiler summaries and exports (see README "Observability")."""
 
 from .profiler import (Profiler, ProfilerState, ProfilerTarget, SummaryView,
                        export_chrome_tracing, make_scheduler)

@@ -14,7 +14,7 @@ import sys
 import numpy as np
 import pytest
 
-from paddle_tpu.distributed.mp_smoke import spawn_cluster
+from paddle_tpu.distributed.mp_smoke import ClusterUnsupported, spawn_cluster
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mp_worker.py")
@@ -23,9 +23,15 @@ WORKER = os.path.join(REPO, "tests", "mp_worker.py")
 @pytest.fixture(scope="module")
 def cluster_results(tmp_path_factory):
     ckpt = str(tmp_path_factory.mktemp("mp_ckpt"))
-    return spawn_cluster([sys.executable, WORKER], nproc=2,
-                         devices_per_proc=4, sentinel="RESULT ",
-                         extra_env={"MP_TEST_CKPT_DIR": ckpt}, timeout=240)
+    try:
+        return spawn_cluster([sys.executable, WORKER], nproc=2,
+                             devices_per_proc=4, sentinel="RESULT ",
+                             extra_env={"MP_TEST_CKPT_DIR": ckpt},
+                             timeout=240)
+    except ClusterUnsupported as e:
+        # this jax build can't run cross-process CPU collectives at all —
+        # skip (clear reason) rather than error the whole spawn family
+        pytest.skip(f"mp spawn unsupported on this platform: {e}")
 
 
 def test_two_process_loss_parity_vs_single_process(cluster_results):
@@ -71,6 +77,15 @@ def test_two_process_distributed_checkpoint(cluster_results):
         assert res["ckpt_ok"] is True
 
 
+def _spawn_and_check(n, golden, mode, timeout=240):
+    """spawn_and_check that converts platform incapability into a skip."""
+    from paddle_tpu.distributed import mp_smoke
+    try:
+        mp_smoke.spawn_and_check(n, golden, mode=mode, timeout=timeout)
+    except ClusterUnsupported as e:
+        pytest.skip(f"mp spawn unsupported on this platform: {e}")
+
+
 def test_two_process_ring_attention_parity():
     """Ring attention with the SEP axis spanning both processes: the
     ring's edge hops (2 of n with the contiguous hybrid layout) are
@@ -81,7 +96,7 @@ def test_two_process_ring_attention_parity():
 
     golden = mp_smoke.golden_for(8, "sepring")
     assert all(np.isfinite(golden)), golden
-    mp_smoke.spawn_and_check(8, golden, mode="sepring", timeout=240)
+    _spawn_and_check(8, golden, mode="sepring")
 
 
 @pytest.mark.parametrize("mode", ["pp1f1b", "ppzbh1"])
@@ -95,7 +110,7 @@ def test_two_process_pipeline_parity(mode):
 
     golden = mp_smoke.golden_for(8, mode)
     assert all(np.isfinite(golden)), golden
-    mp_smoke.spawn_and_check(8, golden, mode=mode, timeout=240)
+    _spawn_and_check(8, golden, mode=mode)
 
 
 def test_hybrid_mesh_construction_virtual():
@@ -149,4 +164,4 @@ def test_two_process_zero1_parity():
 
     golden = mp_smoke.golden_for(8, "z1dpmp")
     assert all(np.isfinite(golden)), golden
-    mp_smoke.spawn_and_check(8, golden, mode="z1dpmp", timeout=240)
+    _spawn_and_check(8, golden, mode="z1dpmp")

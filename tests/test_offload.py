@@ -14,6 +14,15 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed.sharding.group_sharded import (
     build_sharded_train_step, group_sharded_parallel)
+from paddle_tpu.distributed.sharding.param_stream import supports_pinned_host
+
+# CPU jax 0.4.x addresses only unpinned_host: the offload/streaming tiers
+# (which literally park bytes in pinned_host) cannot run there — skip with
+# the reason rather than fail (the TPU backend runs them all).
+requires_pinned_host = pytest.mark.skipif(
+    not supports_pinned_host(),
+    reason="backend has no pinned_host memory kind (CPU jax) — "
+           "offload/param-streaming tiers need it")
 
 
 def _mlp_job():
@@ -47,6 +56,7 @@ def _run(level, offload, steps=3):
     return losses, s
 
 
+@requires_pinned_host
 def test_sharded_offload_state_lives_on_host():
     _, state = _run("p_g_os", offload=True, steps=1)
     kinds = {leaf.sharding.memory_kind
@@ -56,12 +66,14 @@ def test_sharded_offload_state_lives_on_host():
 
 
 @pytest.mark.parametrize("level", ["os_g", "p_g_os"])
+@requires_pinned_host
 def test_sharded_offload_loss_parity(level):
     base, _ = _run(level, offload=False)
     off, _ = _run(level, offload=True)
     np.testing.assert_allclose(base, off, rtol=0, atol=1e-6)
 
 
+@requires_pinned_host
 def test_group_sharded_parallel_offload_eager():
     from paddle_tpu import nn
     from paddle_tpu.nn import functional_call, functional_train_graph
@@ -128,6 +140,7 @@ def test_recompute_offload_grad_parity():
                                    apply_decay_param_fun=lambda n: "w2"
                                    not in n),
 ], ids=["lars_exclude", "adamw_decay_fun"])
+@requires_pinned_host
 def test_sharded_offload_streams_name_aware_optimizers(mk_opt):
     """VERDICT r4 #9 / r3 weak-6: name-dependent optimizers (Lars
     exclude_from_weight_decay, AdamW apply_decay_param_fun) now LEAF-
@@ -216,6 +229,7 @@ class TestParamStreaming:
         params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
         return cfg, params, tokens, labels
 
+    @requires_pinned_host
     def test_streamed_matches_dense_training(self):
         from paddle_tpu.distributed.sharding.param_stream import (
             build_param_streamed_train_step)
@@ -251,6 +265,7 @@ class TestParamStreaming:
         np.testing.assert_allclose(stream_losses, dense_losses,
                                    rtol=2e-5, atol=2e-5)
 
+    @requires_pinned_host
     def test_streamed_params_live_on_host(self):
         from paddle_tpu.distributed.sharding.param_stream import (
             build_param_streamed_train_step)
@@ -268,6 +283,7 @@ class TestParamStreaming:
                      for leaf in jax.tree.leaves(tree)}
             assert kinds == {"pinned_host"}, kinds
 
+    @requires_pinned_host
     def test_streamed_init_never_builds_full_tree(self):
         from paddle_tpu.distributed.sharding.param_stream import park
         from paddle_tpu.models import gpt as G
@@ -283,6 +299,7 @@ class TestParamStreaming:
         assert (jax.tree.map(lambda a: a.shape, hp)
                 == jax.tree.map(lambda a: a.shape, ref))
 
+    @requires_pinned_host
     def test_streamed_llama_matches_dense_training(self):
         """The streamed trainer is model-agnostic: the Llama family
         (RMSNorm + GQA + RoPE + SwiGLU) streams with the same 5-program
@@ -359,6 +376,7 @@ class TestParamStreaming:
         lambda: paddle.nn.ClipGradByGlobalNorm(0.05),
         lambda: paddle.nn.ClipGradByValue(1e-4),
     ], ids=["global_norm", "by_value"])
+    @requires_pinned_host
     def test_streamed_clip_matches_dense_clip(self, mk_clip):
         """VERDICT r4 missing-1: the north-star recipe clips at global-norm
         1.0 — the streamed tier must run it. Two-pass streamed backward
