@@ -281,6 +281,90 @@ def _run_fp8_config(jax, paddle, G, conf, iters, parity_steps=50):
     }
 
 
+def _run_mp_overlap_config(jax, paddle, G, conf, iters):
+    """Tensor-parallel mp-axis overlap (FLAGS_mp_seq_parallel /
+    FLAGS_mp_collective_matmul): hybrid-engine step time for the
+    allreduce baseline vs sequence-parallel vs ring collective-matmul on
+    a dp x mp mesh, plus the activation-memory delta (compiled
+    temp_size) that sequence parallelism exists to buy. On the CPU smoke
+    this runs the forced 8-device virtual mesh — step times there
+    measure scheduling overhead only; the overlap win needs ICI."""
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+
+    n_dev = len(jax.devices())
+    mp = next((m for m in (4, 2) if n_dev % m == 0), None)
+    if mp is None:
+        return {"skipped": f"needs a device count divisible by 2 for an "
+                           f"mp axis, have {n_dev}"}
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    dp = n_dev // mp
+    mesh = dist.build_mesh({"dp": dp, "pp": 1, "mp": mp})
+    batch, seq = conf["batch"], conf["seq"]
+    # 2 microbatches per dp rank, batch divisible by both
+    batch = 2 * dp * max(1, batch // (2 * dp))
+    seq = (seq // mp) * mp
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=max(conf["max_seq_len"], seq),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    lr = jnp.float32(1e-4)
+
+    def timed(mode):
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-4,
+            moment_dtype=jnp.bfloat16 if on_tpu else None)
+        step, shard, init = G.build_hybrid_train_step(
+            cfg, mesh, opt, num_microbatches=2, mp_overlap=mode)
+        p = shard(params)
+        st = init(p)
+        # ONE AOT compile serves both the memory_analysis and the timed
+        # loop (jit's own call cache would compile the same program a
+        # second time)
+        tc0 = time.perf_counter()
+        compiled = step.lower(p, st, tokens, labels, lr).compile()
+        compile_s = time.perf_counter() - tc0
+        # activation/temp memory of the compiled step: what the
+        # seq-sharded residual stream + 1/mp saved activations buy
+        try:
+            ma = compiled.memory_analysis()
+            temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        except Exception:
+            temp = 0
+        p, st, loss = compiled(p, st, tokens, labels, lr)  # warmup
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, st, loss = compiled(p, st, tokens, labels, lr)
+        float(loss)
+        return (time.perf_counter() - t0) / iters, compile_s, temp
+
+    t_ar, c_ar, m_ar = timed(None)
+    t_sp, c_sp, m_sp = timed("seq_parallel")
+    t_cm, c_cm, m_cm = timed("collective_matmul")
+    return {
+        "config_hash": _config_hash(conf),
+        "devices": n_dev,
+        "mesh": {"dp": n_dev // mp, "pp": 1, "mp": mp},
+        "step_ms": {"allreduce": round(t_ar * 1e3, 2),
+                    "seq_parallel": round(t_sp * 1e3, 2),
+                    "collective_matmul": round(t_cm * 1e3, 2)},
+        "compile_s": {"allreduce": round(c_ar, 2),
+                      "seq_parallel": round(c_sp, 2),
+                      "collective_matmul": round(c_cm, 2)},
+        "temp_bytes": {"allreduce": m_ar, "seq_parallel": m_sp,
+                       "collective_matmul": m_cm},
+        "activation_delta_bytes": m_ar - m_sp,
+        "cpu_smoke": not on_tpu,
+    }
+
+
 def _run_telemetry_config(jax, paddle, G, conf, iters,
                           comms_fraction=None):
     """Step accounting through the observability StepTimer: compile vs
@@ -388,6 +472,12 @@ def main():
     # FLAGS_comm_quantize): per-phase comms fraction + step times
     out["overlap"] = _run_overlap_config(jax, paddle, G, overlap_conf,
                                          overlap_iters)
+    # mp-axis tensor-parallel overlap (FLAGS_mp_seq_parallel /
+    # FLAGS_mp_collective_matmul): allreduce vs seq-parallel vs ring
+    # collective-matmul step time + activation-memory delta
+    mp_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
+    out["mp_overlap"] = _run_mp_overlap_config(jax, paddle, G, mp_conf,
+                                               overlap_iters)
     # delayed-scaling fp8 GEMMs (FLAGS_fp8): bf16 vs fp8 step time +
     # 50-step loss-parity gate on the dense single-chip path
     fp8_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)

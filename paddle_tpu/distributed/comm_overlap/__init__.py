@@ -5,9 +5,16 @@ arXiv:2401.16677) with an opt-in int8 error-feedback quantized all-reduce
 (EQuARX, arXiv:2506.17615). See overlap.py for the program structure,
 bucketing.py for the bucket plans, quantize.py for the wire format.
 
+The mp (tensor-parallel) axis half lives in collective_matmul.py:
+sequence-parallel AG/RS block boundaries and the ring collective-matmul
+decomposition that interleaves those collectives with their producing/
+consuming GEMMs (entry points re-exported by fleet.layers.mpu.mp_ops).
+
 Flag surface: FLAGS_comm_bucket_mb, FLAGS_comm_quantize,
-FLAGS_comm_overlap_microbatches, FLAGS_xla_latency_hiding_scheduler.
+FLAGS_comm_overlap_microbatches, FLAGS_xla_latency_hiding_scheduler,
+FLAGS_mp_seq_parallel, FLAGS_mp_collective_matmul.
 Consumed by models.hybrid_engine.build_train_step (hybrid dp axis),
+models gpt/llama build_hybrid_train_step (mp_overlap= seq-parallel TP),
 distributed.sharding.group_sharded.build_sharded_train_step (stage-1/2
 microbatched overlap) and optimizer.gradient_merge (communicate once per
 k steps via make_merge_comm_fn).
@@ -16,6 +23,10 @@ k steps via make_merge_comm_fn).
 from .bucketing import (Bucket, BucketPlan, LeafSlot,  # noqa: F401
                         build_bucket_plan, local_shape, pack_bucket,
                         unpack_bucket)
+from .collective_matmul import (MP_OVERLAP_MODES,  # noqa: F401
+                                MpOverlapConfig, ag_matmul, ag_seq,
+                                matmul_rs, mp_overlap_from_flags,
+                                resolve_mp_overlap, rs_seq, scatter_seq)
 from .overlap import (CommOverlapConfig, config_from_flags,  # noqa: F401
                       ef_plan_for, ef_residual_specs, init_ef_residuals,
                       microbatched_reduced_grads, reduce_bucketed,
@@ -33,6 +44,9 @@ __all__ = [
     "reduce_bucketed", "reduce_scatter_tree",
     "dequantize_int8", "ef_quantized_psum", "quantize_int8",
     "OVERLAP_XLA_FLAGS", "apply_xla_overlap_flags", "make_merge_comm_fn",
+    "MP_OVERLAP_MODES", "MpOverlapConfig", "mp_overlap_from_flags",
+    "resolve_mp_overlap", "ag_matmul", "matmul_rs", "ag_seq", "rs_seq",
+    "scatter_seq",
 ]
 
 

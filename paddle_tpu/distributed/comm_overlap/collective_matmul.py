@@ -1,0 +1,414 @@
+"""Sequence-parallel TP collectives + ring collective-matmul for 'mp'.
+
+Two layers, both explicit-mode (they run INSIDE shard_map with the mp
+axis in scope):
+
+* **Sequence parallelism** (reference:
+  fleet/utils/sequence_parallel_utils.py AllGatherOp/ReduceScatterOp;
+  Megatron-LM sequence parallelism): between transformer blocks the
+  activations are sharded on the SEQUENCE dim over the mp axis, so each
+  per-layer ``c_identity -> GEMM -> mp_allreduce`` pair becomes
+  ``all_gather(S) -> GEMM -> reduce_scatter(S)``. Same wire bytes per
+  pair (an all-reduce IS a reduce-scatter + all-gather), but LayerNorm/
+  residual/dropout math and their saved activations shrink mp-fold.
+  :func:`ag_seq` / :func:`rs_seq` / :func:`scatter_seq` are the paired
+  fwd/bwd custom_vjp primitives, generalized to any sequence dim (the
+  models use ``[B, S, H]`` with seq at dim 1; the reference's PyLayers
+  are the dim-0 ``[s, b, h]`` special case).
+
+* **Collective matmul** (T3, arXiv:2401.16677; the TPU pod-scaling study
+  arXiv:1909.09756 attributes pod MFU to keeping mp collectives off the
+  critical path): :func:`ag_matmul` / :func:`matmul_rs` with
+  ``ring=True`` decompose the AG/RS into ``mp - 1`` chunked
+  ``lax.ppermute`` ring steps interleaved with the GEMM partial products
+  inside a ``lax.scan`` — each chunk's ICI transfer is independent of
+  the chunk GEMM issued in the same iteration, so the latency-hiding
+  scheduler overlaps transfer with MXU work instead of serializing one
+  monolithic collective against the full GEMM. The custom_vjp gives the
+  backward the same structure: one combined ring carries the rotating
+  operand chunk AND the travelling dx partial, computing the dw
+  contributions chunk by chunk (the RS-of-dx / AG-of-d-operand pattern).
+
+Chunking is the natural mp granularity: each ring step moves one
+``[B, S/mp, H]`` sequence shard — wire bytes identical to the fused
+AG/RS ((mp-1)/mp of the full activation), and bitwise-equal results for
+2-term sums (chunked GEMMs contract the same reduction dim in the same
+order; only the ring's partial-sum association differs, which is exact
+at mp=2 and within normal collective reassociation noise beyond).
+
+Everything degenerates correctly at mp degree 1 (plain local matmul, no
+collectives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...enforce import InvalidArgumentError, enforce
+
+__all__ = ["MpOverlapConfig", "mp_overlap_from_flags", "resolve_mp_overlap",
+           "require_axis", "scatter_seq", "ag_seq", "rs_seq", "ag_matmul",
+           "matmul_rs", "MP_OVERLAP_MODES"]
+
+MP_OVERLAP_MODES = ("seq_parallel", "collective_matmul")
+
+
+@dataclasses.dataclass(frozen=True)
+class MpOverlapConfig:
+    """Resolved mp-axis overlap mode for the hybrid engines.
+
+    mode: "seq_parallel" — fused AG/RS at the block boundaries;
+          "collective_matmul" — the same boundaries decomposed into
+          ppermute rings interleaved with the GEMMs (implies the
+          sequence-parallel activation layout).
+    """
+    mode: str = "seq_parallel"
+
+    def __post_init__(self):
+        enforce(self.mode in MP_OVERLAP_MODES,
+                f"mp overlap mode must be one of {MP_OVERLAP_MODES}",
+                op="MpOverlapConfig", mode=self.mode)
+
+    @property
+    def ring(self) -> bool:
+        return self.mode == "collective_matmul"
+
+
+def mp_overlap_from_flags() -> Optional[MpOverlapConfig]:
+    """Flag-driven opt-in: None (the allreduce path, bitwise unchanged)
+    unless FLAGS_mp_seq_parallel / FLAGS_mp_collective_matmul is set;
+    collective_matmul implies the sequence-parallel layout."""
+    from ...flags import flag
+    if flag("mp_collective_matmul"):
+        return MpOverlapConfig("collective_matmul")
+    if flag("mp_seq_parallel"):
+        return MpOverlapConfig("seq_parallel")
+    return None
+
+
+def resolve_mp_overlap(arg) -> Optional[MpOverlapConfig]:
+    """ONE resolution of a builder's mp_overlap= argument — gpt and llama
+    both route through here so flag semantics can never drift. "auto"
+    reads the flags (default off); None/False disables; True means
+    seq_parallel; a mode string or MpOverlapConfig forces."""
+    if arg == "auto":
+        return mp_overlap_from_flags()
+    if arg is None or arg is False:
+        return None
+    if arg is True:
+        return MpOverlapConfig("seq_parallel")
+    if isinstance(arg, str):
+        return MpOverlapConfig(arg)
+    return arg
+
+
+def require_axis(axis, op: str) -> int:
+    """Axis-existence validation for explicit-mode collectives: return the
+    mesh size of `axis`, raising a typed InvalidArgumentError (instead of
+    the opaque jax unbound-axis trace error) when the named axis is not
+    in scope — i.e. the op was called outside shard_map, or over a mesh
+    that doesn't define the axis."""
+    try:
+        return lax.axis_size(axis)
+    except Exception as e:
+        raise InvalidArgumentError(
+            f"mesh axis '{axis}' is not in scope: explicit-mode mp "
+            f"collectives must run inside shard_map over a mesh that "
+            f"defines this axis", op=op, axis=axis) from e
+
+
+def _seq_dim(x, dim: int, op: str) -> int:
+    d = dim if dim >= 0 else x.ndim + dim
+    enforce(0 <= d < x.ndim, f"sequence dim {dim} out of range for "
+            f"rank-{x.ndim} input", op=op, dim=dim, ndim=x.ndim)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Fused sequence-parallel primitives (paired fwd/bwd via custom_vjp)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_seq(x, axis: str = "mp", dim: int = 1):
+    """Take this rank's sequence shard along `dim`; backward all-gathers
+    (the block-stack entry: replicated embed output -> seq-sharded)."""
+    n = require_axis(axis, "scatter_seq")
+    d = _seq_dim(x, dim, "scatter_seq")
+    enforce(x.shape[d] % n == 0,
+            "sequence length must be divisible by the mp degree",
+            op="scatter_seq", seq=x.shape[d], mp=n)
+    idx = lax.axis_index(axis)
+    size = x.shape[d] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=d)
+
+
+def _scatter_seq_fwd(x, axis, dim):
+    return scatter_seq(x, axis, dim), None
+
+
+def _scatter_seq_bwd(axis, dim, res, g):
+    return (lax.all_gather(g, axis, axis=_seq_dim(g, dim, "scatter_seq"),
+                           tiled=True),)
+
+
+scatter_seq.defvjp(_scatter_seq_fwd, _scatter_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def ag_seq(x, axis: str = "mp", dim: int = 1):
+    """All-gather the sequence dim (entering a column-parallel GEMM);
+    backward reduce-scatters."""
+    require_axis(axis, "ag_seq")
+    return lax.all_gather(x, axis, axis=_seq_dim(x, dim, "ag_seq"),
+                          tiled=True)
+
+
+def _ag_seq_fwd(x, axis, dim):
+    return ag_seq(x, axis, dim), None
+
+
+def _ag_seq_bwd(axis, dim, res, g):
+    return (lax.psum_scatter(g, axis,
+                             scatter_dimension=_seq_dim(g, dim, "ag_seq"),
+                             tiled=True),)
+
+
+ag_seq.defvjp(_ag_seq_fwd, _ag_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def rs_seq(x, axis: str = "mp", dim: int = 1):
+    """Reduce-scatter the sequence dim (leaving a row-parallel GEMM);
+    backward all-gathers."""
+    n = require_axis(axis, "rs_seq")
+    d = _seq_dim(x, dim, "rs_seq")
+    enforce(x.shape[d] % n == 0,
+            "sequence length must be divisible by the mp degree",
+            op="rs_seq", seq=x.shape[d], mp=n)
+    return lax.psum_scatter(x, axis, scatter_dimension=d, tiled=True)
+
+
+def _rs_seq_fwd(x, axis, dim):
+    return rs_seq(x, axis, dim), None
+
+
+def _rs_seq_bwd(axis, dim, res, g):
+    return (lax.all_gather(g, axis, axis=_seq_dim(g, dim, "rs_seq"),
+                           tiled=True),)
+
+
+rs_seq.defvjp(_rs_seq_fwd, _rs_seq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring collective matmul
+# ---------------------------------------------------------------------------
+def _ring_perm(n: int):
+    return [(r, (r + 1) % n) for r in range(n)]
+
+
+def _seq_chunk(x, j, size: int):
+    """x[:, j*size:(j+1)*size, :] with a traced chunk index."""
+    return lax.dynamic_slice_in_dim(x, j * size, size, axis=1)
+
+
+def _seq_order(chunks, idx, n: int):
+    """Reassemble ring-scan outputs into sequence order.
+
+    chunks: [n, B, s, F] where chunks[i] belongs to sequence shard
+    (idx - i) mod n. Returns [B, n*s, F]."""
+    take = jnp.mod(idx - jnp.arange(n), n)  # i holding seq chunk j
+    chunks = jnp.take(chunks, take, axis=0)
+    return jnp.moveaxis(chunks, 0, 1).reshape(
+        chunks.shape[1], n * chunks.shape[2], chunks.shape[3])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ring_ag_matmul(x, w, axis):
+    """all_gather(x over seq) @ w, decomposed: the local [B, s, H] chunk
+    rotates around the mp ring while each arrived chunk multiplies w —
+    iteration i's ppermute is independent of its GEMM, so transfer
+    overlaps MXU work. x: [B, s, H], w: [H, F_local] -> [B, n*s, F]."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x @ w
+    idx = lax.axis_index(axis)
+    perm = _ring_perm(n)
+
+    def body(chunk, _):
+        nxt = lax.ppermute(chunk, axis, perm)  # fetch next chunk ...
+        y = chunk @ w                          # ... while this one computes
+        return nxt, y
+
+    last, ys = lax.scan(body, x, None, length=n - 1)
+    ys = jnp.concatenate([ys, (last @ w)[None]], axis=0)  # [n, B, s, F]
+    return _seq_order(ys, idx, n)
+
+
+def _ring_ag_matmul_fwd(x, w, axis):
+    return _ring_ag_matmul(x, w, axis), (x, w)
+
+
+def _ring_ag_matmul_bwd(axis, res, dy):
+    """One combined ring: the x chunk rotates for the dw accumulation
+    (AG-of-operand pattern) while the dx partial travels rank-to-rank
+    accumulating each rank's dy-shard contribution (RS-of-dx pattern)."""
+    x, w = res
+    n = lax.axis_size(axis)
+    if n == 1:
+        return (jnp.einsum("bsf,hf->bsh", dy, w).astype(x.dtype),
+                jnp.einsum("bsh,bsf->hf", x, dy).astype(w.dtype))
+    idx = lax.axis_index(axis)
+    perm = _ring_perm(n)
+    s = x.shape[1]
+
+    # step 0: own chunks, no incoming partials
+    acc = jnp.einsum("bsf,hf->bsh", _seq_chunk(dy, jnp.mod(idx + n - 1, n), s),
+                     w)
+    dw = jnp.einsum("bsh,bsf->hf", x, _seq_chunk(dy, idx, s))
+
+    def body(carry, i):
+        xc, acc, dw = carry
+        xn = lax.ppermute(xc, axis, perm)    # x chunk src (idx - i)
+        accn = lax.ppermute(acc, axis, perm)
+        # dx partial now targets seq chunk (idx - 1 - i); add this rank's
+        # dy-shard contribution (the GEMM is independent of both permutes)
+        accn = accn + jnp.einsum(
+            "bsf,hf->bsh", _seq_chunk(dy, jnp.mod(idx + 2 * n - 1 - i, n), s),
+            w)
+        dw = dw + jnp.einsum(
+            "bsh,bsf->hf", xn, _seq_chunk(dy, jnp.mod(idx + n - i, n), s))
+        return (xn, accn, dw), None
+
+    (xc, acc, dw), _ = lax.scan(body, (x, acc, dw), jnp.arange(1, n))
+    # after n-1 ring steps acc holds the complete dx for THIS rank's chunk
+    return acc.astype(x.dtype), dw.astype(w.dtype)
+
+
+_ring_ag_matmul.defvjp(_ring_ag_matmul_fwd, _ring_ag_matmul_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ring_matmul_rs(x, w, axis):
+    """reduce_scatter(x @ w over seq), decomposed: the partial sum for
+    each sequence chunk travels around the mp ring, each rank adding its
+    local GEMM contribution — the chunk GEMM is independent of the
+    arriving partial's ppermute. x: [B, S, I_local], w: [I_local, H] ->
+    [B, S/n, H] (this rank's summed chunk)."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x @ w
+    idx = lax.axis_index(axis)
+    perm = _ring_perm(n)
+    s = x.shape[1] // n
+
+    acc = _seq_chunk(x, jnp.mod(idx + n - 1, n), s) @ w
+
+    def body(acc, i):
+        accn = lax.ppermute(acc, axis, perm)
+        # arriving partial targets chunk (idx - 1 - i); add our GEMM
+        accn = accn + _seq_chunk(x, jnp.mod(idx + 2 * n - 1 - i, n), s) @ w
+        return accn, None
+
+    acc, _ = lax.scan(body, acc, jnp.arange(1, n))
+    return acc  # chunk idx, fully summed
+
+
+def _ring_matmul_rs_fwd(x, w, axis):
+    return _ring_matmul_rs(x, w, axis), (x, w)
+
+
+def _ring_matmul_rs_bwd(axis, res, dy):
+    """AG-type ring over the output cotangent: dy rotates; when holding
+    rank j's shard this rank emits dx chunk j (= dy_j @ w^T) and folds
+    x_chunk_j^T @ dy_j into dw."""
+    x, w = res
+    n = lax.axis_size(axis)
+    if n == 1:
+        return (jnp.einsum("bsh,ih->bsi", dy, w).astype(x.dtype),
+                jnp.einsum("bsi,bsh->ih", x, dy).astype(w.dtype))
+    idx = lax.axis_index(axis)
+    perm = _ring_perm(n)
+    s = dy.shape[1]
+
+    dxc0 = jnp.einsum("bsh,ih->bsi", dy, w)
+    dw = jnp.einsum("bsi,bsh->ih", _seq_chunk(x, idx, s), dy)
+
+    def body(carry, i):
+        dyc, dw = carry
+        dyn = lax.ppermute(dyc, axis, perm)  # dy shard src (idx - i)
+        j = jnp.mod(idx + n - i, n)
+        dxc = jnp.einsum("bsh,ih->bsi", dyn, w)
+        dw = dw + jnp.einsum("bsi,bsh->ih", _seq_chunk(x, j, s), dyn)
+        return (dyn, dw), dxc
+
+    (dyc, dw), dxs = lax.scan(body, (dy, dw), jnp.arange(1, n))
+    dxs = jnp.concatenate([dxc0[None], dxs], axis=0)  # [n, B, s, I]
+    return (_seq_order(dxs, idx, n).astype(x.dtype), dw.astype(w.dtype))
+
+
+_ring_matmul_rs.defvjp(_ring_matmul_rs_fwd, _ring_matmul_rs_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Entry points (what mp_ops re-exports)
+# ---------------------------------------------------------------------------
+def _plain_mm(a, b):
+    return a @ b
+
+
+def ag_matmul(x, w, axis: str = "mp", *, seq_dim: int = 1,
+              ring: bool = False, mm=None):
+    """``all_gather(x over seq_dim) @ w`` — the column-parallel entry of a
+    sequence-parallel block (backward reduce-scatters the input grad).
+
+    ring=True decomposes into the collective-matmul ppermute ring
+    (seq_dim 1, rank-3 input only). mm: alternate GEMM callable for the
+    fused path — the fp8 ``site_mm`` routing hook; the ring path refuses
+    it (per-chunk fp8_dot calls would each observe a partial amax and
+    their cotangents SUM, corrupting delayed scaling)."""
+    n = require_axis(axis, "ag_matmul")
+    enforce(x.shape[-1] == w.shape[0],
+            "ag_matmul contraction mismatch", op="ag_matmul",
+            x_shape=tuple(x.shape), w_shape=tuple(w.shape))
+    if ring:
+        enforce(mm is None, "ring collective-matmul cannot route through "
+                "an alternate GEMM (fp8 site_mm): per-chunk calls would "
+                "sum partial amax observations", op="ag_matmul")
+        enforce(x.ndim == 3 and _seq_dim(x, seq_dim, "ag_matmul") == 1,
+                "ring ag_matmul expects [B, S/mp, H] with seq at dim 1",
+                op="ag_matmul", shape=tuple(x.shape), seq_dim=seq_dim)
+        return _ring_ag_matmul(x, w, axis)
+    del n
+    return (mm or _plain_mm)(ag_seq(x, axis, seq_dim), w)
+
+
+def matmul_rs(x, w, axis: str = "mp", *, seq_dim: int = 1,
+              ring: bool = False, mm=None):
+    """``reduce_scatter(x @ w over seq_dim)`` — the row-parallel exit of a
+    sequence-parallel block (backward all-gathers the output grad).
+
+    ring=True decomposes into the collective-matmul ppermute ring
+    (seq_dim 1, rank-3 input only); mm as in :func:`ag_matmul`."""
+    n = require_axis(axis, "matmul_rs")
+    enforce(x.shape[-1] == w.shape[0],
+            "matmul_rs contraction mismatch", op="matmul_rs",
+            x_shape=tuple(x.shape), w_shape=tuple(w.shape))
+    d = _seq_dim(x, seq_dim, "matmul_rs")
+    enforce(x.shape[d] % n == 0,
+            "sequence length must be divisible by the mp degree",
+            op="matmul_rs", seq=x.shape[d], mp=n)
+    if ring:
+        enforce(mm is None, "ring collective-matmul cannot route through "
+                "an alternate GEMM (fp8 site_mm): per-chunk calls would "
+                "sum partial amax observations", op="matmul_rs")
+        enforce(x.ndim == 3 and d == 1,
+                "ring matmul_rs expects [B, S, I/mp] with seq at dim 1",
+                op="matmul_rs", shape=tuple(x.shape), seq_dim=seq_dim)
+        return _ring_matmul_rs(x, w, axis)
+    return rs_seq((mm or _plain_mm)(x, w), axis, seq_dim)

@@ -11,6 +11,12 @@ allgather/reduce-scatter pair of equal bandwidth but 1/mp activation memory.
 Explicit-mode (shard_map) ops, paired fwd/bwd via custom_vjp exactly as the
 reference's PyLayers; sequence dim is axis 0 ([s, b, h] layout) to match the
 reference's convention.
+
+The hybrid engines use the generalized (any seq dim) versions in
+``distributed.comm_overlap.collective_matmul`` — scatter_seq/ag_seq/rs_seq
+plus the ring collective-matmul entry points ``ag_matmul``/``matmul_rs``
+(FLAGS_mp_seq_parallel / FLAGS_mp_collective_matmul); this module keeps the
+reference-shaped layer surface.
 """
 
 from __future__ import annotations

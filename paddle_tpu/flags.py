@@ -373,6 +373,35 @@ define_flag("comm_overlap_microbatches", 1,
             "microbatches still compute. 1 keeps a single backward "
             "(consumed by comm_overlap.config_from_flags and "
             "group_sharded.build_sharded_train_step).")
+define_flag("mp_seq_parallel", False,
+            "Megatron-style sequence parallelism on the tensor-parallel "
+            "'mp' axis of the hybrid engines: between transformer blocks "
+            "activations are sharded over the SEQUENCE dim, and each "
+            "per-layer c_identity -> GEMM -> mp_allreduce pair becomes "
+            "all_gather(S) -> GEMM -> reduce_scatter(S). Same wire bytes "
+            "per pair, but LayerNorm/residual math, the saved "
+            "between-block activations and the pp ppermute transfers all "
+            "shrink mp-fold — larger microbatches under remat. Requires "
+            "S % mp == 0. Off (default): the allreduce path compiles "
+            "bitwise-identically (consumed by "
+            "comm_overlap.collective_matmul.mp_overlap_from_flags via "
+            "models gpt/llama build_hybrid_train_step(mp_overlap='auto')).")
+define_flag("mp_collective_matmul", False,
+            "Ring collective-matmul decomposition of the sequence-parallel "
+            "AG/RS boundaries (implies FLAGS_mp_seq_parallel): each "
+            "all-gather -> GEMM / GEMM -> reduce-scatter is decomposed "
+            "into mp-1 chunked lax.ppermute ring steps interleaved with "
+            "the GEMM partial products inside a lax.scan, forward AND "
+            "backward (custom_vjp), so each [B, S/mp, H] chunk's ICI "
+            "transfer overlaps the previous chunk's MXU work instead of "
+            "serializing one fused collective against the whole GEMM "
+            "(T3, arXiv:2401.16677). Chunk granularity is the natural "
+            "S/mp sequence shard. Not composable with FLAGS_fp8: the "
+            "ring's per-chunk fp8_dot calls would sum partial amax "
+            "observations (use plain FLAGS_mp_seq_parallel with fp8). "
+            "Pair with FLAGS_xla_latency_hiding_scheduler so XLA "
+            "actually overlaps the ppermutes (consumed by "
+            "comm_overlap.collective_matmul.mp_overlap_from_flags).")
 
 # async-collective / latency-hiding scheduler knobs: the overlap program
 # exposes the opportunity; these make XLA take it. Env must be written

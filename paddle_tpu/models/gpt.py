@@ -10,10 +10,12 @@ Two executions of the same architecture:
 
 * ``hybrid`` engine — functional stacked-parameter form for the explicit
   SPMD path: vocab-parallel embedding + Megatron TP inside each block (over
-  'mp'), scan+ppermute pipeline over 'pp' (spmd_pipeline), dp gradient
-  sync (monolithic pmean, or bucketed/overlapped/int8-quantized via
-  distributed.comm_overlap — FLAGS_comm_bucket_mb et al.), all inside
-  ONE shard_map/jit program. This is the TPU-native
+  'mp'; optionally sequence-parallel with ring collective-matmul overlap —
+  FLAGS_mp_seq_parallel / FLAGS_mp_collective_matmul via
+  distributed.comm_overlap.collective_matmul), scan+ppermute pipeline over
+  'pp' (spmd_pipeline), dp gradient sync (monolithic pmean, or
+  bucketed/overlapped/int8-quantized via distributed.comm_overlap —
+  FLAGS_comm_bucket_mb et al.), all inside ONE shard_map/jit program. This is the TPU-native
   equivalent of the reference's PipelineParallel+TensorParallel meta_parallel
   stack (fleet/meta_parallel/pipeline_parallel.py:547,
   fleet/layers/mpu/mp_layers.py).
@@ -230,7 +232,7 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None):
+def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
     """One transformer block, explicit Megatron TP (runs inside shard_map;
     degenerates correctly at mp degree 1).
 
@@ -243,37 +245,91 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None):
     dp/mp) routing the four GEMMs through quantization.fp8.fp8_dot; each
     rank quantizes its LOCAL weight shard with the shared per-tensor
     scale, and the engine pmaxes the observed amaxes over dp/mp before
-    the meta update."""
+    the meta update.
+
+    sp: None (plain TP: replicated activations, c_identity/mp_allreduce
+    pairs — bitwise-unchanged legacy path) or a
+    comm_overlap.MpOverlapConfig. With sp on, x arrives SEQUENCE-SHARDED
+    [B, S/mp, H]: each pair becomes ag_matmul / matmul_rs (all_gather on
+    the way into the column GEMM, reduce-scatter on the way out of the
+    row GEMM — same wire bytes, 1/mp the LayerNorm/residual math and
+    saved between-block activations), and sp.ring additionally decomposes
+    those collectives into ppermute rings interleaved with the GEMM
+    partial products (collective matmul; fp8 must be off — per-chunk
+    fp8_dot calls would sum partial amax observations)."""
     mp = lax.axis_size(mp_axis)
     heads_local = cfg.num_heads // mp
-    B, S, H = x.shape
+    B = x.shape[0]
+    H = cfg.hidden_size
     from ..distributed.fleet.layers.mpu import mp_ops
 
-    h = _ln(x, p["ln1_g"], p["ln1_b"])
-    hi = mp_ops.c_identity(h, mp_axis)
-    qkv = (_fp8_mm(fp8, "qkv")(hi.astype(cfg.dtype),
-                               p["qkv_w"].astype(cfg.dtype))
-           + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
+    if sp is None:
+        S = x.shape[1]
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        hi = mp_ops.c_identity(h, mp_axis)
+        qkv = (_fp8_mm(fp8, "qkv")(hi.astype(cfg.dtype),
+                                   p["qkv_w"].astype(cfg.dtype))
+               + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
+    else:
+        S = x.shape[1] * mp  # x is this rank's sequence shard
+        # replicated-but-sequence-parallel params (the reference's
+        # mark_as_sequence_parallel_parameter allreduce hook,
+        # sequence_parallel_utils.py:192): LayerNorm weights and the
+        # row-GEMM biases see only this rank's seq shard, so their local
+        # grads are PARTIAL — identity-fwd/psum-bwd (c_identity) restores
+        # the full-sequence gradient. mp-sharded leaves (qkv/fc1 weights
+        # and biases, proj/fc2 weights) never need this: their grads come
+        # from the gathered full-sequence activations.
+        p = dict(p)
+        for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "proj_b", "fc2_b"):
+            p[k] = mp_ops.c_identity(p[k], mp_axis)
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = (mp_ops.ag_matmul(
+            h.astype(cfg.dtype), p["qkv_w"].astype(cfg.dtype), mp_axis,
+            ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "qkv"))
+            + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
     qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
     # registry op: Pallas flash on TPU (the engine's shard_map runs with
     # check_vma=False, so the kernel traces inside it); composed O(S^2)
     # fallback elsewhere — heads are fully local under TP, so per-shard
-    # attention is the whole computation
+    # attention is the whole computation (always over the FULL sequence;
+    # only the between-block residual stream is seq-sharded under sp)
     attn = F.scaled_dot_product_attention(
         qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
     attn = attn.reshape(B, S, H // mp)
-    out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
-    out = mp_ops.mp_allreduce(out, mp_axis) + p["proj_b"].astype(cfg.dtype)
+    if sp is None:
+        out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
+        out = (mp_ops.mp_allreduce(out, mp_axis)
+               + p["proj_b"].astype(cfg.dtype))
+    else:
+        out = (mp_ops.matmul_rs(
+            attn, p["proj_w"].astype(cfg.dtype), mp_axis, ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "proj"))
+            + p["proj_b"].astype(cfg.dtype))
     x = x + out
 
     h = _ln(x, p["ln2_g"], p["ln2_b"])
-    hi = mp_ops.c_identity(h, mp_axis)
-    m = (_fp8_mm(fp8, "fc1")(hi.astype(cfg.dtype),
-                             p["fc1_w"].astype(cfg.dtype))
-         + p["fc1_b"].astype(cfg.dtype))
+    if sp is None:
+        hi = mp_ops.c_identity(h, mp_axis)
+        m = (_fp8_mm(fp8, "fc1")(hi.astype(cfg.dtype),
+                                 p["fc1_w"].astype(cfg.dtype))
+             + p["fc1_b"].astype(cfg.dtype))
+    else:
+        m = (mp_ops.ag_matmul(
+            h.astype(cfg.dtype), p["fc1_w"].astype(cfg.dtype), mp_axis,
+            ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "fc1"))
+            + p["fc1_b"].astype(cfg.dtype))
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-    m = _fp8_mm(fp8, "fc2")(m, p["fc2_w"].astype(cfg.dtype))
-    m = mp_ops.mp_allreduce(m, mp_axis) + p["fc2_b"].astype(cfg.dtype)
+    if sp is None:
+        m = _fp8_mm(fp8, "fc2")(m, p["fc2_w"].astype(cfg.dtype))
+        m = mp_ops.mp_allreduce(m, mp_axis) + p["fc2_b"].astype(cfg.dtype)
+    else:
+        m = (mp_ops.matmul_rs(
+            m, p["fc2_w"].astype(cfg.dtype), mp_axis, ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "fc2"))
+            + p["fc2_b"].astype(cfg.dtype))
     return x + m
 
 
@@ -508,10 +564,42 @@ def streamed_fns(cfg: GPTConfig):
             lambda p, x, labels: dense_head_loss(p, x, labels, cfg))
 
 
+def _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, num_microbatches,
+                  n_block_layers, virtual_pp=1):
+    """Deposit the analytic per-step mp wire bytes (trace-time constant)
+    for the telemetry comms_bytes series — one shared accounting for the
+    gpt and llama hybrid losses (both have 2 column/row GEMM pairs per
+    block: attention + MLP). See observability.metrics.mp_wire_bytes for
+    the per-term cost model.
+
+    Executed-block count per schedule (every pipeline tick executes the
+    stage body on every rank, bubbles included — those collectives move
+    real bytes): 1F1B runs M+P-1 ticks of all L/P local layers; the
+    interleaved schedule runs V*M+P-1 ticks of ONE L/(P*V)-layer chunk.
+    ZBH1's forward matches 1F1B and its split backward is approximated
+    by the same fwd+bwd pair model."""
+    from ..observability import metrics as _metrics
+    mp = lax.axis_size(mp_axis)
+    P_ = lax.axis_size(pp_axis)
+    b_local, S = tokens.shape
+    dt = jnp.dtype(cfg.dtype).itemsize
+    a_blk = (b_local // num_microbatches) * S * cfg.hidden_size * dt
+    a_full = b_local * S * cfg.hidden_size * dt
+    V = max(int(virtual_pp), 1)
+    executed = (V * num_microbatches + P_ - 1) * (n_block_layers / V)
+    mode = "allreduce" if sp is None else sp.mode
+    _metrics.note_mp_comm(mode, _metrics.mp_wire_bytes(
+        mode, mp,
+        gemm_pair_bytes=2.0 * executed * a_blk,
+        # embed psum + head boundary + the 4 CE reductions ([b, S, 1] f32)
+        allreduce_bytes=2.0 * a_full + 4.0 * b_local * S * 4,
+        scatter_bytes=a_full))
+
+
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1,
-                   schedule: str = "1F1B", fp8=None):
+                   schedule: str = "1F1B", fp8=None, sp=None):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
@@ -522,6 +610,11 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     fp8: this pp rank's stacked [L/pp] delayed scales (sharded over pp
     like the block params); 1F1B schedule only — the interleaved/ZB
     permutations would need the same block reorder applied to the scales.
+    sp: None (plain TP, bitwise-unchanged) or comm_overlap.MpOverlapConfig
+    — sequence-parallel TP: activations between blocks (and through the
+    pp ppermutes, whose transfers shrink mp-fold too) are seq-sharded
+    over mp; the LM head becomes an ag_matmul and the embedding output is
+    seq-scattered. Requires S % mp == 0.
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -532,10 +625,17 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
             "fp8 delayed scaling supports the 1F1B schedule only",
             op="gpt.hybrid_loss_fn", virtual_pp=virtual_pp,
             schedule=schedule)
+    from ..distributed.comm_overlap import collective_matmul as _cm
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x + params["wpe"][None, :S]
     x = x.astype(cfg.dtype)
-    x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
+    if sp is not None:
+        enforce(S % lax.axis_size(mp_axis) == 0,
+                "sequence parallelism needs S divisible by the mp degree",
+                op="gpt.hybrid_loss_fn", seq=S,
+                mp=lax.axis_size(mp_axis))
+        x = _cm.scatter_seq(x, mp_axis, dim=1)  # [b_local, S/mp, H]
+    x_mb = x.reshape(M, b_local // M, x.shape[1], cfg.hidden_size)
 
     def stage_fn(block_params, h):
         if fp8 is not None:
@@ -543,12 +643,13 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
 
             def body(carry, pf):
                 p, f = pf
-                return _block_fn(p, carry, cfg, mp_axis, fp8=f), None
+                return _block_fn(p, carry, cfg, mp_axis, fp8=f,
+                                 sp=sp), None
             out, _ = lax.scan(body, h, (blocks, scales))
             return out
 
         def body(carry, p):
-            return _block_fn(p, carry, cfg, mp_axis), None
+            return _block_fn(p, carry, cfg, mp_axis, sp=sp), None
         out, _ = lax.scan(body, h, block_params)
         return out
 
@@ -563,12 +664,29 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                                         axis=pp_axis)
     else:
         out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
-    out = out.reshape(b_local, S, cfg.hidden_size)
-    out = _ln(out, params["lnf_g"], params["lnf_b"])
+    out = out.reshape(b_local, x.shape[1], cfg.hidden_size)
     from ..distributed.fleet.layers.mpu import mp_ops
-    # column-parallel head: identity fwd / allreduce bwd on its input
-    out = mp_ops.c_identity(out, mp_axis)
-    logits_local = out.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+    lnf_g, lnf_b = params["lnf_g"], params["lnf_b"]
+    if sp is not None:
+        # final LN runs on the seq shard — its param grads are partial
+        # (see the _block_fn sp note)
+        lnf_g = mp_ops.c_identity(lnf_g, mp_axis)
+        lnf_b = mp_ops.c_identity(lnf_b, mp_axis)
+    out = _ln(out, lnf_g, lnf_b)
+    if sp is None:
+        # column-parallel head: identity fwd / allreduce bwd on its input
+        out = mp_ops.c_identity(out, mp_axis)
+        logits_local = (out.astype(cfg.dtype)
+                        @ params["head_w"].astype(cfg.dtype))
+    else:
+        # seq-sharded final LN, then AG -> column GEMM (bwd RS) — same
+        # wire as the allreduce-mode head boundary
+        logits_local = mp_ops.ag_matmul(
+            out.astype(cfg.dtype), params["head_w"].astype(cfg.dtype),
+            mp_axis, ring=sp.ring)
+    _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
+                  jax.tree.leaves(params["blocks"])[0].shape[0],
+                  virtual_pp=virtual_pp)
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
     return lax.pmean(total, dp_axis)
@@ -580,7 +698,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             virtual_pp: int = 1, schedule: str = "1F1B",
                             grad_reduce_dtype="auto",
                             zero1_dp: bool = False, comm_overlap="auto",
-                            fp8="auto", telemetry="auto"):
+                            fp8="auto", telemetry="auto",
+                            mp_overlap="auto"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -603,13 +722,31 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     (scale, amax_history) state rides opt_state["fp8_meta"], sharded
     over pp with the stacked blocks, and amaxes pmax over dp/mp (+extra
     axes) so scales stay replicated. 1F1B schedule only.
+
+    mp_overlap: "auto" (FLAGS_mp_seq_parallel / FLAGS_mp_collective_
+    matmul, default off) / None / mode string / MpOverlapConfig —
+    sequence-parallel TP over the mp axis, optionally with the AG/RS
+    boundaries decomposed into ppermute ring collective matmuls
+    (distributed.comm_overlap.collective_matmul). Off: the allreduce
+    path compiles BITWISE-identically to a build without the argument.
+    collective_matmul composes with everything but fp8 (the ring's
+    per-chunk GEMMs would sum partial amax observations — seq_parallel
+    itself composes with fp8 fine: the site GEMMs see the gathered
+    full-sequence input exactly as the allreduce path does).
     """
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
+    from ..distributed.comm_overlap.collective_matmul import \
+        resolve_mp_overlap
 
+    sp = resolve_mp_overlap(mp_overlap)
     fp8_plan = _f8.resolve_fp8_plan(
         fp8, GPT_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
         amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
+    # fp8 x ring-collective-matmul is refused by the engine (the ONE copy
+    # of that compose rule — hybrid_engine.build_train_step); S % mp
+    # divisibility is checked at trace time in hybrid_loss_fn (the
+    # runtime sequence length may be shorter than max_seq_len)
     if fp8_plan is not None:
         enforce(virtual_pp == 1 and schedule == "1F1B",
                 "fp8 delayed scaling supports the 1F1B schedule only "
@@ -621,12 +758,13 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
-                                  fp8=scales)
+                                  fp8=scales, sp=sp)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
-                                  virtual_pp=virtual_pp, schedule=schedule)
+                                  virtual_pp=virtual_pp, schedule=schedule,
+                                  sp=sp)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
@@ -634,7 +772,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry)
+        comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry,
+        mp_overlap=sp)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

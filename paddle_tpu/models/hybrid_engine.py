@@ -199,7 +199,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
                      comm_overlap="auto", fp8=None, telemetry="auto",
-                     donate: bool = False):
+                     mp_overlap=None, donate: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -266,7 +266,22 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     residuals ride opt_state["comm_ef"] — same step signature, same
     checkpoint surface, donation preserved. Not composed with
     comm_overlap (the overlap scan's weighted accumulation would corrupt
-    the amax semantics — disable one of the two)."""
+    the amax semantics — disable one of the two).
+
+    mp_overlap: metadata describing the mp-axis (tensor-parallel) comm
+    structure the LOSS FUNCTION implements — None (plain allreduce TP),
+    a comm_overlap.MpOverlapConfig, or a mode string ("seq_parallel" /
+    "collective_matmul"). The engine cannot inject the mp path (it lives
+    in the model's block bodies; gpt/llama build_hybrid_train_step
+    thread it via their own mp_overlap="auto"); here it (a) lands in the
+    telemetry JSONL header as static["mp_mode"], and (b) guards the
+    fp8 x ring-collective-matmul combination, which is invalid for the
+    same reason as fp8 x comm_overlap: the ring's per-chunk GEMMs would
+    sum partial amax observations. The mp-axis WIRE BYTES are not a
+    build-time constant (activation shapes appear at trace time), so the
+    models deposit them through observability.note_mp_comm inside the
+    loss trace; the engine opens the collecting scope around the step
+    body and folds the value into the comms_bytes telemetry series."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -325,6 +340,18 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         from ..quantization import fp8 as _f8
         fp8_axes = tuple(a for a in fp8_plan.get("axes", ())
                          if a in mesh.axis_names)
+    # -- mp-axis overlap metadata (the loss implements the path) -------------
+    mp_mode = None
+    if mp_overlap is not None:
+        mp_mode = getattr(mp_overlap, "mode", str(mp_overlap))
+        if fp8_plan is not None:
+            from ..enforce import enforce
+            enforce(mp_mode != "collective_matmul",
+                    "ring collective-matmul is not composed with fp8 "
+                    "delayed scaling: the per-chunk GEMMs would sum "
+                    "partial amax observations — use seq_parallel with "
+                    "fp8, or disable one of the two",
+                    op="build_train_step")
     # -- in-program telemetry (observability) --------------------------------
     from .. import observability as _obs
     tcfg = _obs.telemetry_from_flags() if telemetry == "auto" else telemetry
@@ -335,8 +362,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         tcfg.static["mesh"] = {a: int(mesh.shape[a])
                                for a in mesh.axis_names}
         for k in ("comm_buckets_bytes", "comm_quantize",
-                  "comm_microbatches"):
+                  "comm_microbatches", "mp_mode"):
             tcfg.static.pop(k, None)
+        if mp_mode is not None:
+            tcfg.static["mp_mode"] = mp_mode
         if ocfg is not None and example_params is not None:
             # per-bucket wire bytes from the bucket plan over the LOCAL
             # grad shapes (the int8 path's residual plan IS this plan)
@@ -574,6 +603,14 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         return out if tcfg is not None else out + ({},)
 
     def local_step(params, opt_state, tokens, labels, lr):
+        # trace-time mp wire-byte collection: the model's loss deposits
+        # its analytic per-step bytes via observability.note_mp_comm
+        # while it traces; pure Python — zero HLO impact
+        with _obs.mp_comm_scope() as mp_cell:
+            return _local_step(mp_cell, params, opt_state, tokens, labels,
+                               lr)
+
+    def _local_step(mp_cell, params, opt_state, tokens, labels, lr):
         ef = fmeta = tbuf = None
         if wrap_specs:
             ef = opt_state.get("comm_ef")
@@ -606,8 +643,13 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 vals["loss"] = loss
                 vals["grad_norm"] = jnp.sqrt(tele["grad_sq"])
                 vals["nonfinite_count"] = tele["nonfinite"]
+                # mp bytes are per loss CALL — the overlap scan calls the
+                # loss once per comm microbatch on the split batch
+                mp_calls = ocfg.microbatches if ocfg is not None else 1
                 vals["comms_bytes"] = ((tele_comms["reduce"] or 0.0)
-                                       + (tele_comms["zero1"] or 0.0))
+                                       + (tele_comms["zero1"] or 0.0)
+                                       + mp_calls
+                                       * mp_cell.get("wire_bytes", 0.0))
                 if fp8_plan is not None and amax is not None:
                     vals["fp8_amax_max"] = jnp.stack(
                         [jnp.max(a) for a in jax.tree.leaves(amax)]).max()

@@ -281,42 +281,92 @@ def _rms(x, g, eps):
 
 
 def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp",
-              fp8=None):
+              fp8=None, sp=None):
     """One decoder layer with explicit Megatron TP (inside shard_map).
     Column shards hold complete heads: q_w's out dim is head-major [hq·D],
     k_w/v_w's is [hkv·D] — contiguous mp shards keep q-head↔kv-head groups
     rank-local (see module docstring). fp8: this layer's {site: {x, w, g}}
     delayed scales routing the seven GEMMs (LLAMA_FP8_SITES) through
-    quantization.fp8.fp8_dot."""
+    quantization.fp8.fp8_dot.
+
+    sp: None (plain TP, bitwise-unchanged) or comm_overlap.MpOverlapConfig
+    — x arrives sequence-sharded [B, S/mp, H] (see gpt._block_fn). The
+    three attention column GEMMs (and gate/up) share ONE sequence
+    all-gather: fused mode gathers h once and feeds the site GEMMs; ring
+    mode concatenates the local weight shards so one collective matmul
+    produces q|k|v (resp. gate|up) — otherwise each ring would move the
+    same chunks again, tripling the wire."""
     mp = lax.axis_size(mp_axis)
     hq, hkv = cfg.num_heads // mp, cfg.num_kv_heads // mp
-    B, S, H = x.shape
+    B = x.shape[0]
+    H = cfg.hidden_size
     cd = cfg.dtype
     from ..distributed.fleet.layers.mpu import mp_ops
+    if sp is not None:
+        from ..distributed.comm_overlap import collective_matmul as _cm
+        S = x.shape[1] * mp
+        # replicated-but-sequence-parallel params: RMSNorm gains see only
+        # this rank's seq shard — identity-fwd/psum-bwd restores the
+        # full-sequence gradient (see gpt._block_fn)
+        p = dict(p)
+        for k in ("ln1_g", "ln2_g"):
+            p[k] = mp_ops.c_identity(p[k], mp_axis)
+    else:
+        S = x.shape[1]
 
     h = _rms(x, p["ln1_g"], cfg.rms_eps)
-    hi = mp_ops.c_identity(h, mp_axis).astype(cd)
-    q = _fp8_mm(fp8, "q")(hi, p["q_w"].astype(cd)).reshape(
-        B, S, hq, cfg.head_dim)
-    kk = _fp8_mm(fp8, "k")(hi, p["k_w"].astype(cd)).reshape(
-        B, S, hkv, cfg.head_dim)
-    vv = _fp8_mm(fp8, "v")(hi, p["v_w"].astype(cd)).reshape(
-        B, S, hkv, cfg.head_dim)
+    if sp is None:
+        hi = mp_ops.c_identity(h, mp_axis).astype(cd)
+    elif sp.ring:
+        wqkv = jnp.concatenate(
+            [p["q_w"], p["k_w"], p["v_w"]], axis=-1).astype(cd)
+        qkv = mp_ops.ag_matmul(h.astype(cd), wqkv, mp_axis, ring=True)
+        q, kk, vv = jnp.split(
+            qkv, [hq * cfg.head_dim, (hq + hkv) * cfg.head_dim], axis=-1)
+    else:
+        # cast BEFORE the gather: _rms promotes to param dtype, and an
+        # fp32 wire would double the AG/RS bytes vs the compute dtype
+        hi = _cm.ag_seq(h.astype(cd), mp_axis, dim=1)  # one AG, 3 GEMMs
+    if sp is None or not sp.ring:
+        q = _fp8_mm(fp8, "q")(hi, p["q_w"].astype(cd))
+        kk = _fp8_mm(fp8, "k")(hi, p["k_w"].astype(cd))
+        vv = _fp8_mm(fp8, "v")(hi, p["v_w"].astype(cd))
+    q = q.reshape(B, S, hq, cfg.head_dim)
+    kk = kk.reshape(B, S, hkv, cfg.head_dim)
+    vv = vv.reshape(B, S, hkv, cfg.head_dim)
     q, kk = _rope(q, cos, sin), _rope(kk, cos, sin)
     # registry attention (Pallas flash with native GQA on TPU — the
     # engine's shard_map runs check_vma=False so the kernel traces inside
-    # it; composed fallback elsewhere). Heads are rank-local under TP.
+    # it; composed fallback elsewhere). Heads are rank-local under TP and
+    # always see the FULL sequence; only the residual stream is sharded.
     attn = _flash_gqa(q, kk, vv).reshape(B, S, H // mp)
-    out = _fp8_mm(fp8, "o")(attn, p["o_w"].astype(cd))  # row-parallel
-    x = x + mp_ops.mp_allreduce(out, mp_axis)
+    if sp is None:
+        out = _fp8_mm(fp8, "o")(attn, p["o_w"].astype(cd))  # row-parallel
+        x = x + mp_ops.mp_allreduce(out, mp_axis)
+    else:
+        x = x + mp_ops.matmul_rs(
+            attn, p["o_w"].astype(cd), mp_axis, ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "o"))
 
     h = _rms(x, p["ln2_g"], cfg.rms_eps)
-    hi = mp_ops.c_identity(h, mp_axis).astype(cd)
-    m = jax.nn.silu(_fp8_mm(fp8, "gate")(hi, p["gate_w"].astype(cd))
-                    .astype(jnp.float32)).astype(cd) \
-        * _fp8_mm(fp8, "up")(hi, p["up_w"].astype(cd))
-    m = _fp8_mm(fp8, "down")(m, p["down_w"].astype(cd))  # row-parallel
-    return x + mp_ops.mp_allreduce(m, mp_axis)
+    if sp is None:
+        hi = mp_ops.c_identity(h, mp_axis).astype(cd)
+    elif sp.ring:
+        wgu = jnp.concatenate([p["gate_w"], p["up_w"]], axis=-1).astype(cd)
+        gu = mp_ops.ag_matmul(h.astype(cd), wgu, mp_axis, ring=True)
+        g_, u_ = jnp.split(gu, 2, axis=-1)
+    else:
+        hi = _cm.ag_seq(h.astype(cd), mp_axis, dim=1)  # cast pre-gather
+    if sp is None or not sp.ring:
+        g_ = _fp8_mm(fp8, "gate")(hi, p["gate_w"].astype(cd))
+        u_ = _fp8_mm(fp8, "up")(hi, p["up_w"].astype(cd))
+    m = jax.nn.silu(g_.astype(jnp.float32)).astype(cd) * u_
+    if sp is None:
+        m = _fp8_mm(fp8, "down")(m, p["down_w"].astype(cd))  # row-parallel
+        return x + mp_ops.mp_allreduce(m, mp_axis)
+    return x + mp_ops.matmul_rs(
+        m, p["down_w"].astype(cd), mp_axis, ring=sp.ring,
+        mm=None if fp8 is None else _fp8_mm(fp8, "down"))
 
 
 def dense_embed(params, tokens, cfg: LlamaConfig):
@@ -452,10 +502,13 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True,
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp", virtual_pp: int = 1, fp8=None):
+                   mp_axis="mp", virtual_pp: int = 1, fp8=None, sp=None):
     """Per-device loss of the full hybrid Llama (inside shard_map). fp8:
     this pp rank's stacked [L/pp] delayed scales (1F1B only — see
-    gpt.hybrid_loss_fn)."""
+    gpt.hybrid_loss_fn). sp: None or comm_overlap.MpOverlapConfig —
+    sequence-parallel TP over mp (see gpt.hybrid_loss_fn); RoPE tables
+    stay full-sequence (attention always runs on the gathered sequence),
+    requires S % mp == 0."""
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
@@ -464,10 +517,18 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     enforce(fp8 is None or virtual_pp == 1,
             "fp8 delayed scaling supports the 1F1B schedule only",
             op="llama.hybrid_loss_fn", virtual_pp=virtual_pp)
+    from ..distributed.comm_overlap import collective_matmul as _cm
+    from ..distributed.fleet.layers.mpu import mp_ops
     cos, sin = rope_tables(cfg, S)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x.astype(cfg.dtype)
-    x_mb = x.reshape(M, b_local // M, S, cfg.hidden_size)
+    if sp is not None:
+        enforce(S % lax.axis_size(mp_axis) == 0,
+                "sequence parallelism needs S divisible by the mp degree",
+                op="llama.hybrid_loss_fn", seq=S,
+                mp=lax.axis_size(mp_axis))
+        x = _cm.scatter_seq(x, mp_axis, dim=1)  # [b_local, S/mp, H]
+    x_mb = x.reshape(M, b_local // M, x.shape[1], cfg.hidden_size)
 
     def stage_fn(block_params, h):
         if fp8 is not None:
@@ -476,12 +537,12 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
             def body(carry, pf):
                 p, f = pf
                 return _block_fn(p, carry, cos, sin, cfg, mp_axis,
-                                 fp8=f), None
+                                 fp8=f, sp=sp), None
             out, _ = lax.scan(body, h, (blocks, scales))
             return out
 
         def body(carry, p):
-            return _block_fn(p, carry, cos, sin, cfg, mp_axis), None
+            return _block_fn(p, carry, cos, sin, cfg, mp_axis, sp=sp), None
         out, _ = lax.scan(body, h, block_params)
         return out
 
@@ -493,11 +554,25 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
             axis=pp_axis)
     else:
         out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
-    out = out.reshape(b_local, S, cfg.hidden_size)
-    out = _rms(out, params["lnf_g"], cfg.rms_eps)
-    from ..distributed.fleet.layers.mpu import mp_ops
-    out = mp_ops.c_identity(out, mp_axis)  # column-parallel head
-    logits_local = out.astype(cfg.dtype) @ params["head_w"].astype(cfg.dtype)
+    out = out.reshape(b_local, x.shape[1], cfg.hidden_size)
+    lnf_g = params["lnf_g"]
+    if sp is not None:
+        # final RMSNorm runs on the seq shard — its gain grad is partial
+        # over mp (see gpt.hybrid_loss_fn)
+        lnf_g = mp_ops.c_identity(lnf_g, mp_axis)
+    out = _rms(out, lnf_g, cfg.rms_eps)
+    if sp is None:
+        out = mp_ops.c_identity(out, mp_axis)  # column-parallel head
+        logits_local = (out.astype(cfg.dtype)
+                        @ params["head_w"].astype(cfg.dtype))
+    else:
+        logits_local = mp_ops.ag_matmul(
+            out.astype(cfg.dtype), params["head_w"].astype(cfg.dtype),
+            mp_axis, ring=sp.ring)
+    from .gpt import _note_mp_wire
+    _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
+                  jax.tree.leaves(params["blocks"])[0].shape[0],
+                  virtual_pp=virtual_pp)
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
     return lax.pmean(total, dp_axis)
@@ -508,13 +583,23 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, grad_reduce_dtype="auto",
                             zero1_dp: bool = False, fp8="auto",
-                            telemetry="auto"):
+                            telemetry="auto", mp_overlap="auto"):
+    """mp_overlap: "auto" (FLAGS_mp_seq_parallel / FLAGS_mp_collective_
+    matmul) / None / mode string / MpOverlapConfig — sequence-parallel TP
+    with optional ring collective matmul; see gpt.build_hybrid_train_step
+    (off: the allreduce path is bitwise unchanged; collective_matmul
+    refuses fp8)."""
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
+    from ..distributed.comm_overlap.collective_matmul import \
+        resolve_mp_overlap
 
+    sp = resolve_mp_overlap(mp_overlap)
     fp8_plan = _f8.resolve_fp8_plan(
         fp8, LLAMA_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
         amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
+    # fp8 x ring-collective-matmul is refused by the engine (the ONE copy
+    # of that compose rule — hybrid_engine.build_train_step)
     if fp8_plan is not None:
         enforce(virtual_pp == 1,
                 "fp8 delayed scaling supports the 1F1B schedule only",
@@ -523,12 +608,12 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
-                                  virtual_pp=virtual_pp, fp8=scales)
+                                  virtual_pp=virtual_pp, fp8=scales, sp=sp)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
-                                  virtual_pp=virtual_pp)
+                                  virtual_pp=virtual_pp, sp=sp)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
@@ -536,7 +621,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        fp8=fp8_plan, telemetry=telemetry)
+        fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

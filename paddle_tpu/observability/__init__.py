@@ -32,7 +32,8 @@ from .flops import (collective_seconds, gpt_flops_per_token,
                     llama_flops_per_token, mfu, param_count, peak_flops,
                     plan_wire_bytes, transformer_flops_per_token)
 from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
-                      buffer_specs, collecting, init_buffer, observe,
+                      buffer_specs, collecting, init_buffer, mp_comm_scope,
+                      mp_wire_bytes, note_mp_comm, observe,
                       telemetry_from_flags, update_buffer)
 from .prom import MetricsServer, PromRegistry, serve_registry
 from .step_timer import StepTimer
@@ -41,7 +42,7 @@ from .trace import capture_spans, span, write_chrome_trace
 __all__ = [
     "TelemetryConfig", "TelemetryHost", "telemetry_from_flags", "observe",
     "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
-    "update_buffer",
+    "update_buffer", "mp_wire_bytes", "note_mp_comm", "mp_comm_scope",
     "StepTimer",
     "gpt_flops_per_token", "llama_flops_per_token",
     "transformer_flops_per_token", "param_count", "mfu", "peak_flops",

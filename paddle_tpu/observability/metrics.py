@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["TelemetryConfig", "telemetry_from_flags", "observe",
            "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
-           "update_buffer", "TelemetryHost"]
+           "update_buffer", "TelemetryHost", "mp_wire_bytes",
+           "note_mp_comm", "mp_comm_scope"]
 
 # always-present builtin slots (fp8 slots stay 0.0 when fp8 is off) — a
 # FIXED tuple so host decode needs only the config, never the engine
@@ -82,6 +83,86 @@ def obs_dict(sink: List[Tuple[str, Any]]) -> Dict[str, Any]:
     for name, v in sink:
         out[name] = v if name not in out else out[name] + v
     return out
+
+
+# ---------------------------------------------------------------------------
+# mp-axis (tensor-parallel) wire accounting.
+#
+# The dp-path comms_bytes come from the engine's own sync trace; the mp
+# collectives live inside the MODEL's loss function where the activation
+# shapes are only known at trace time — so the model computes the analytic
+# per-step bytes while tracing and deposits them through a trace-time cell
+# (note_mp_comm) that the engine opens around the step body (mp_comm_scope)
+# and folds into the comms_bytes builtin. Pure Python bookkeeping: zero HLO
+# impact, bitwise-identical programs whether or not a scope is active.
+# ---------------------------------------------------------------------------
+def mp_wire_bytes(mode: Optional[str], mp: int, *,
+                  gemm_pair_bytes: float = 0.0,
+                  allreduce_bytes: float = 0.0,
+                  scatter_bytes: float = 0.0) -> float:
+    """Analytic per-rank mp-axis wire bytes of ONE train step (ring
+    accounting, forward + backward), shared by the engines' telemetry and
+    the tests' expected values.
+
+    mode: None/"allreduce" (plain TP), "seq_parallel", or
+        "collective_matmul". The per-pair cost is IDENTICAL across modes
+        — an all-reduce is a reduce-scatter plus an all-gather, and the
+        ppermute ring moves the same (mp-1)/mp of every activation — the
+        seq-parallel win is activation memory and overlap, not bytes.
+    gemm_pair_bytes: sum over EXECUTED column/row GEMM pairs (attention +
+        MLP per block x pipeline-executed blocks, i.e. (M + pp - 1) x
+        L/pp per rank for the 1F1B schedule — bubble iterations move real
+        bytes too) of the full-sequence activation bytes. Each pair costs
+        4f x bytes, f = (mp-1)/mp: allreduce mode pays a 2f forward
+        all-reduce (row output) + 2f backward all-reduce (column input);
+        sp modes pay f on each of AG-fwd/RS-bwd/RS-fwd/AG-bwd.
+    allreduce_bytes: sum over the collectives that cost one all-reduce
+        equivalent (2f) in EVERY mode: the vocab-parallel embedding psum,
+        the LM-head boundary (backward all-reduce in allreduce mode; AG
+        forward + RS backward in sp modes — same wire), the CE
+        reductions.
+    scatter_bytes: the embed->sequence scatter's backward all-gather
+        (f x bytes), paid by the sp modes only.
+
+    Remat replay of forward collectives inside checkpointed pipeline
+    stages is NOT counted (it multiplies every mode's forward terms
+    equally); this is the useful-work wire model.
+    """
+    if mp <= 1:
+        return 0.0
+    f = (mp - 1) / mp
+    total = 4.0 * f * gemm_pair_bytes + 2.0 * f * allreduce_bytes
+    if mode in ("seq_parallel", "collective_matmul"):
+        total += f * scatter_bytes
+    return total
+
+
+_MP_COMM = threading.local()
+
+
+def note_mp_comm(mode: Optional[str], wire_bytes: float) -> None:
+    """Deposit a model's analytic mp wire bytes from inside its loss
+    trace. Inert unless an engine has a scope open. Last write wins (a
+    scan body may trace more than once; every trace derives the same
+    value). The engine multiplies by its own comm-overlap microbatch
+    count — the loss sees the per-call batch."""
+    cell = getattr(_MP_COMM, "cell", None)
+    if cell is not None:
+        cell["mode"] = mode
+        cell["wire_bytes"] = float(wire_bytes)
+
+
+@contextlib.contextmanager
+def mp_comm_scope():
+    """Trace-time collection scope for note_mp_comm (the engine opens one
+    around the step body). Yields the cell dict — read it AFTER the loss
+    has traced."""
+    prev = getattr(_MP_COMM, "cell", None)
+    _MP_COMM.cell = cell = {}
+    try:
+        yield cell
+    finally:
+        _MP_COMM.cell = prev
 
 
 @dataclasses.dataclass

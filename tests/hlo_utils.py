@@ -1,0 +1,43 @@
+"""HLO/StableHLO inspection helpers for collective-mix assertions.
+
+The mp-overlap modes are distinguished by WHICH collectives the lowered
+program contains (all-reduce pairs vs AG+RS vs ppermute rings), so tests
+assert the expected mix per mode instead of trusting the flag plumbing —
+a silent fallback to the replicated path would keep loss parity while
+quietly re-exposing the blocking all-reduces. Counting happens on lowered
+text (``jit(...).lower(...).as_text()``, StableHLO) and also understands
+compiled-HLO spellings (``all-reduce`` / ``all-reduce-start``) so callers
+can pass either form.
+"""
+
+import re
+
+# op -> regexes across the dialects jax emits (StableHLO dots, HLO dashes;
+# the \b/lookahead guards keep all_reduce from matching all_reduce_scatter
+# and the -start/-done async forms from double-counting)
+_COLLECTIVE_PATTERNS = {
+    "all_reduce": (r"stablehlo\.all_reduce\b", r"mhlo\.all_reduce\b",
+                   r"\ball-reduce(?:-start)?\("),
+    "all_gather": (r"stablehlo\.all_gather\b", r"mhlo\.all_gather\b",
+                   r"\ball-gather(?:-start)?\("),
+    "reduce_scatter": (r"stablehlo\.reduce_scatter\b",
+                       r"mhlo\.reduce_scatter\b",
+                       r"\breduce-scatter(?:-start)?\("),
+    "collective_permute": (r"stablehlo\.collective_permute\b",
+                           r"mhlo\.collective_permute\b",
+                           r"\bcollective-permute(?:-start)?\("),
+}
+
+
+def collective_counts(hlo_text: str) -> dict:
+    """Count collective ops in lowered (StableHLO) or compiled (HLO) module
+    text: {op_name: count} for all-reduce / all-gather / reduce-scatter /
+    collective-permute. Ops inside scan/while bodies appear once (static
+    program text), which is what mode assertions want."""
+    return {name: sum(len(re.findall(p, hlo_text)) for p in pats)
+            for name, pats in _COLLECTIVE_PATTERNS.items()}
+
+
+def lowered_collective_counts(jitted, *args, **kwargs) -> dict:
+    """collective_counts of ``jitted.lower(*args, **kwargs).as_text()``."""
+    return collective_counts(jitted.lower(*args, **kwargs).as_text())

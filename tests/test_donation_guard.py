@@ -116,6 +116,42 @@ def test_fp8_train_step_donates_params_state_and_meta():
         "donated fp8 step inputs still alive after the step"
 
 
+def test_hybrid_mp_overlap_steps_donate():
+    """ISSUE 5 satellite: the seq-parallel and ring-collective-matmul
+    step variants must keep donating params + optimizer state — the mp
+    overlap exists to SHRINK activation memory, so silently losing
+    donation (doubling params/moments) would more than cancel it."""
+    from paddle_tpu.models import gpt as G
+    mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 16)))
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, 64, (8, 16)))
+    for mode in ("seq_parallel", "collective_matmul"):
+        opt = paddle.optimizer.AdamW(1e-3)
+        from paddle_tpu.models.hybrid_engine import build_train_step
+        from paddle_tpu.models.gpt import (hybrid_loss_fn,
+                                           hybrid_param_specs,
+                                           init_hybrid_params)
+        from paddle_tpu.distributed.comm_overlap import MpOverlapConfig
+        sp = MpOverlapConfig(mode)
+
+        def lf(p, t, l, sp=sp):
+            return hybrid_loss_fn(p, t, l, cfg, num_microbatches=2, sp=sp)
+
+        step, shard, init = build_train_step(
+            lf, hybrid_param_specs(cfg), mesh, opt,
+            example_params=jax.eval_shape(
+                lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0))),
+            mp_overlap=sp, donate=True)
+        p = shard(init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+        st = init(p)
+        compiled = step.lower(p, st, tokens, labels,
+                              jnp.float32(1e-3)).compile()
+        assert _aliased_bytes(compiled) > 0, \
+            f"{mode} step does NOT donate params/opt state"
+
+
 def test_hybrid_overlap_step_memory_sane():
     """hybrid engine + EF residuals: compiled peak stays within a small
     multiple of params+state+grads (no silent HBM doubling from the
