@@ -1,0 +1,3 @@
+"""Benchmark package: importable so bench.py and tier-1 smoke tests can
+reuse the bench harnesses (serving_bench exposes its comparison as a
+function; the scripts stay runnable as `python benchmarks/<name>.py`)."""

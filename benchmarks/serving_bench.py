@@ -1,14 +1,21 @@
 """Serving bench: continuous batching + chunked prefill vs static batching
 (VERDICT r2 #4, widened per r3 #8: >=64 requests, MIXED prompt lengths,
-adaptive decode bursts that free slots at the earliest finisher).
+adaptive decode bursts that free slots at the earliest finisher), plus —
+ISSUE 6 — the single-dispatch ragged engine vs the two-program baseline:
+per-request latency percentiles (p50/p95/p99), dispatches per engine
+step, and an analytic HBM bytes-per-decoded-token model (weights + KV
+pages read) that shows where the int8 KV pool halves the decode traffic.
 
 Workload: 64 requests, prompt lengths drawn from {32, 48, 64, 96}, ragged
 output lengths U[8, 96] — the variance that makes static batches idle at
 the barrier. The static baseline is the STRONGEST version: requests
 bucketed by prompt length, each batch padded only to its own max.
-Model: GPT ~125M-shape (bf16 on TPU).
+Model: GPT ~125M-shape (bf16 on TPU); `--shape gpt1p3b` runs the
+flagship 1.3B shape on-chip (VERDICT weak #2 — the regime where decode
+is genuinely weight-bound and int8 W8A8 shows its worth).
 
-Run: `python benchmarks/serving_bench.py` — one JSON line.
+Run: `python benchmarks/serving_bench.py` — one JSON line. bench.py and
+the tier-1 smoke import `run_single_dispatch_comparison` directly.
 """
 
 import json
@@ -21,15 +28,152 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(big: bool = False):
+def _pct(v, q):
+    return round(float(np.percentile(v, q)), 3)
+
+
+def _lat_stats(lat):
+    return {"mean": round(float(np.mean(lat)), 3), "p50": _pct(lat, 50),
+            "p95": _pct(lat, 95), "p99": _pct(lat, 99)}
+
+
+def _run_engine(make_engine, prompts, news, waves: int = 3):
+    """Steady-state timing: run the whole workload once on the engine to
+    compile every program shape the scheduler will ask for, then submit
+    the same workload `waves` more times and keep the BEST wave (compile
+    amortized — the regime a long-lived server lives in; each engine
+    owns fresh jit programs, so a fresh-engine timing would re-pay
+    compilation, and best-of-N damps host scheduling noise).
+    Returns (wall_s, per-request latency list, outputs, dispatches/step)."""
+    eng = make_engine()
+    for p, n in zip(prompts, news):
+        eng.add_request(p, n)
+    eng.run()  # warmup wave: compiles amortized before the timed waves
+    best = None
+    for _ in range(waves):
+        d0, s0 = eng.dispatches, eng.engine_steps
+        rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+        done_at, outs = {}, {}
+        t0 = time.perf_counter()
+        while eng.has_work():
+            for r in eng.step():
+                done_at[r.rid] = time.perf_counter() - t0
+                outs[r.rid] = r.output
+        dt = time.perf_counter() - t0
+        wave = (dt, [done_at[rid] for rid in rids],
+                [outs[rid] for rid in rids],
+                (eng.dispatches - d0) / max(eng.engine_steps - s0, 1))
+        if best is None or dt < best[0]:
+            best = wave
+    return best
+
+
+def hbm_bytes_per_decoded_token(cfg, kv_itemsize, mean_ctx, decode_batch,
+                                block_size, param_bytes,
+                                kv_scales: bool = False):
+    """Analytic HBM traffic per decoded token: every decode microstep
+    streams the full weight set once (amortized over the co-scheduled
+    decode rows) plus each row's referenced KV pages — ceil(ctx/bs)
+    pages x bs rows x D x H_kv x 2 (k+v) x L at the pool itemsize (+4
+    bytes/page/head/side for the f32 scales of a quantized pool). This
+    is the model the int8 KV pool attacks: KV bytes halve vs bf16, and
+    capacity per pool byte doubles."""
+    D = cfg.head_dim
+    pages = -(-int(mean_ctx) // block_size)
+    kv = 2 * cfg.num_layers * cfg.num_heads * pages * block_size * D \
+        * kv_itemsize
+    if kv_scales:
+        kv += 2 * cfg.num_layers * cfg.num_heads * pages * 4
+    return {"weights": int(param_bytes // decode_batch),
+            "kv_read": int(kv),
+            "total": int(param_bytes // decode_batch + kv)}
+
+
+def run_single_dispatch_comparison(params, cfg, prompts, news, mk,
+                                   batch, int8_weights: bool = False):
+    """Ragged single-dispatch engine vs the frozen two-program baseline
+    on the SAME workload: tokens/s, dispatches/step, latency percentiles,
+    greedy-output parity, the int8-KV variant, and the bytes/token model
+    evaluated at this shape. Returns a JSON-ready dict."""
     import jax
+    from paddle_tpu.inference.serving import ServingEngine
+
+    total_tokens = sum(news)
+    param_bytes = sum(np.dtype(v.dtype).itemsize * v.size
+                      for v in jax.tree.leaves(params))
+    if int8_weights:  # W8A8 storage ~1 byte/weight (+f32 per-out scales)
+        param_bytes = sum(v.size for v in jax.tree.leaves(params))
+
+    def mk_eng(**kw):
+        # fixed prefill/decode mix for an apples-to-apples dispatch
+        # comparison (the adaptive policy is exercised by tests); the
+        # token budget grants every slot a decode token PLUS a full
+        # prefill chunk — the same per-step work ceiling the two-program
+        # path's batched-prefill program has
+        def make():
+            return ServingEngine(params, cfg, max_batch=batch,
+                                 int8=int8_weights, adaptive_mix=False,
+                                 token_budget=batch * (1 + mk["chunk"]),
+                                 **mk, **kw)
+        return make
+
+    dt_two, lat_two, out_two, dps_two = _run_engine(
+        mk_eng(ragged=False), prompts, news)
+    dt_rag, lat_rag, out_rag, dps_rag = _run_engine(
+        mk_eng(ragged=True), prompts, news)
+    dt_q, lat_q, out_q, dps_q = _run_engine(
+        mk_eng(ragged=True, kv_cache_dtype="int8"), prompts, news)
+
+    mean_ctx = float(np.mean([len(p) + n for p, n in zip(prompts, news)]))
+    kv_item = np.dtype(cfg.dtype).itemsize
+    bytes_kv = hbm_bytes_per_decoded_token(
+        cfg, kv_item, mean_ctx, batch, mk["block_size"], param_bytes)
+    bytes_q = hbm_bytes_per_decoded_token(
+        cfg, 1, mean_ctx, batch, mk["block_size"], param_bytes,
+        kv_scales=True)
+    return {
+        "tokens_per_sec": {
+            "ragged": round(total_tokens / dt_rag, 1),
+            "two_program": round(total_tokens / dt_two, 1),
+            "ragged_int8_kv": round(total_tokens / dt_q, 1)},
+        "speedup_vs_two_program": round(dt_two / dt_rag, 2),
+        "dispatches_per_step": {
+            "ragged": round(dps_rag, 3), "two_program": round(dps_two, 3),
+            "ragged_int8_kv": round(dps_q, 3)},
+        "latency_s": {"ragged": _lat_stats(lat_rag),
+                      "two_program": _lat_stats(lat_two),
+                      "ragged_int8_kv": _lat_stats(lat_q)},
+        # greedy decode: the ragged program must reproduce the baseline
+        "outputs_match_two_program": out_rag == out_two,
+        "hbm_bytes_per_decoded_token": {
+            "model": f"weights/batch + 2*L*Hkv*ceil(ctx/bs)*bs*D*itemsize "
+                     f"@ mean_ctx {mean_ctx:.0f}, decode batch {batch}",
+            "kv_" + ("bf16" if kv_item == 2 else
+                     np.dtype(cfg.dtype).name): bytes_kv,
+            "kv_int8": bytes_q,
+            "kv_bytes_ratio_int8_vs_float":
+                round(bytes_q["kv_read"] / max(bytes_kv["kv_read"], 1), 3)},
+    }
+
+
+def scenario(on_tpu: bool, big: bool = False, shape: str = "auto"):
+    """Workload + engine geometry per platform/shape. Returns
+    (cfg, n_req, plens, out_hi, mk) — shared by main() and bench.py's
+    serving section so BENCH_r0N rows and the standalone bench agree."""
     import jax.numpy as jnp
-    from paddle_tpu.inference.serving import (ServingEngine,
-                                              generate_static_batch)
     from paddle_tpu.models import gpt as G
 
-    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
-    if on_tpu and big:
+    if shape == "gpt1p3b":
+        # flagship 1.3B serving shape (VERDICT weak #2): decode is
+        # weight-bound here — 2.6 GB of bf16 weights stream per decode
+        # microstep vs ~25 MB of KV pages at ctx 512
+        cfg = G.GPTConfig(vocab_size=32768, hidden_size=2048,
+                          num_layers=24, num_heads=16, max_seq_len=1024,
+                          dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                          param_dtype=(jnp.bfloat16 if on_tpu
+                                       else jnp.float32))
+        n_req, plens, out_hi = 32, (128, 256, 512), 128
+    elif on_tpu and big:
         # high-raggedness scenario (VERDICT r4 ask-10): 128 requests with
         # LONG mixed prompts — the regime where the paged kernel streams
         # only the blocks a sequence references while a dense baseline
@@ -48,15 +192,10 @@ def main(big: bool = False):
                           num_heads=4, max_seq_len=128, dtype=jnp.float32)
         n_req, plens, out_hi = 8, (8, 16), 16
 
-    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
-    rng = np.random.RandomState(0)
-    prompts = [rng.randint(0, cfg.vocab_size, (int(rng.choice(plens)),))
-               for _ in range(n_req)]
-    news = rng.randint(8, out_hi + 1, (n_req,)).tolist()
-    total_tokens = sum(news)
-    batch = 8
-
-    if big:
+    if shape == "gpt1p3b":
+        mk = dict(block_size=32, num_blocks=200, max_blocks_per_seq=20,
+                  chunk=128, decode_burst=32)
+    elif big:
         # bigger pool for 512-token prompts; blocks sized so the pool
         # still fits comfortably next to the 125M params. Through the
         # ~105 ms tunnel every engine step costs one RTT, so the big
@@ -64,9 +203,33 @@ def main(big: bool = False):
         # 32-token decode bursts)
         mk = dict(block_size=32, num_blocks=320, max_blocks_per_seq=24,
                   chunk=128, decode_burst=32)
-    else:
+    elif on_tpu:
         mk = dict(block_size=16, num_blocks=192, max_blocks_per_seq=16,
                   chunk=32, decode_burst=16)
+    else:
+        # CPU smoke: shorter chunk — the interpreter-mode ragged kernel's
+        # pass-1 tile is c_att=chunk rows, and the 8-16-token smoke
+        # prompts never fill a 32 chunk anyway
+        mk = dict(block_size=16, num_blocks=192, max_blocks_per_seq=16,
+                  chunk=16, decode_burst=16)
+    return cfg, n_req, plens, out_hi, mk
+
+
+def main(big: bool = False, shape: str = "auto"):
+    import jax
+    from paddle_tpu.inference.serving import (ServingEngine,
+                                              generate_static_batch)
+    from paddle_tpu.models import gpt as G
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    cfg, n_req, plens, out_hi, mk = scenario(on_tpu, big=big, shape=shape)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(rng.choice(plens)),))
+               for _ in range(n_req)]
+    news = rng.randint(8, out_hi + 1, (n_req,)).tolist()
+    total_tokens = sum(news)
+    batch = 8
 
     def make_engine():
         return ServingEngine(params, cfg, max_batch=batch, **mk)
@@ -106,9 +269,6 @@ def main(big: bool = False):
     dt_s, lat_s = run_static()
     dt_c, lat_c = run_continuous()
 
-    def pct(v, q):
-        return round(float(np.percentile(v, q)), 2)
-
     # per-decoded-token KV bytes: the paged kernel streams only the blocks
     # a sequence references (ceil(len/bs) rounded up to block_size); a
     # dense padded cache reads max_seq_len rows for every slot every step
@@ -126,10 +286,8 @@ def main(big: bool = False):
         "speedup": round(dt_s / dt_c, 2),
         "kv_read_rows_paged_vs_dense": round(paged_rows / dense_rows, 3),
         "latency_s": {
-            "continuous": {"mean": round(float(np.mean(lat_c)), 2),
-                           "p50": pct(lat_c, 50), "p95": pct(lat_c, 95)},
-            "static": {"mean": round(float(np.mean(lat_s)), 2),
-                       "p50": pct(lat_s, 50), "p95": pct(lat_s, 95)},
+            "continuous": _lat_stats(lat_c),
+            "static": _lat_stats(lat_s),
         },
         "config": f"{n_req} reqs, prompts {plens} mixed, outputs "
                   f"U[8,{out_hi}], batch {batch}, BATCHED chunked "
@@ -139,7 +297,14 @@ def main(big: bool = False):
                   "adaptive='auto' (off through the tunnel); static "
                   "baseline bucketed by prompt length; latency = "
                   "submit-all-at-t0 to request completion",
+        # ISSUE 6: the single-dispatch ragged engine vs the two-program
+        # baseline on the same workload (+ the int8 KV pool variant)
+        "single_dispatch": run_single_dispatch_comparison(
+            params, cfg, prompts, news, mk, batch,
+            int8_weights=(shape == "gpt1p3b" and on_tpu)),
     }
+    if shape == "gpt1p3b":
+        out["metric"] = "serving_single_dispatch_gpt1p3b"
     print(json.dumps(out))
 
 
@@ -149,4 +314,9 @@ if __name__ == "__main__":
     ap.add_argument("--big", action="store_true",
                     help="128 requests, prompts up to 512 (high-"
                          "raggedness profile)")
-    main(big=ap.parse_args().big)
+    ap.add_argument("--shape", default="auto",
+                    choices=("auto", "gpt1p3b"),
+                    help="gpt1p3b: flagship 1.3B serving shape "
+                         "(weight-bound decode; VERDICT weak #2)")
+    args = ap.parse_args()
+    main(big=args.big, shape=args.shape)

@@ -500,6 +500,25 @@ define_flag("serving_decode_burst", 8,
             "(one host round trip per burst).")
 define_flag("serving_prefill_chunk", 32,
             "Chunked-prefill slice length in the serving engine.")
+define_flag("serving_ragged", False,
+            "Single-dispatch ragged serving: ServingEngine.step() packs "
+            "decode rows + prefill chunks into ONE ragged token batch "
+            "and runs ONE compiled program per step (unified Pallas "
+            "ragged-paged-attention kernel, in-program sampling + KV "
+            "append, fused decode burst). Off = the frozen two-program "
+            "baseline (bitwise-unchanged HLO).")
+define_flag("serving_kv_cache_dtype", "auto",
+            "KV-pool storage dtype for the serving engine: auto (model "
+            "compute dtype), bf16, f32, int8 or fp8_e4m3. Quantized "
+            "pools (int8/fp8_e4m3) quantize on append with per-page "
+            "scales and dequantize in-kernel — half the decode HBM "
+            "bytes, ~2x the sequences per pool byte budget; requires "
+            "the ragged path (serving_ragged).")
+define_flag("serving_adaptive_mix", True,
+            "Adapt the per-step prefill/decode mix on the ragged path "
+            "from the queue-depth and TTFT telemetry series: admission "
+            "pressure shortens the fused decode burst so prefill slices "
+            "come around sooner; an idle queue runs full bursts.")
 define_flag("flash_attn_version", 2,
             "Compat (reference FLAGS_flash_attn_version): the Pallas "
             "kernel implements the FA-2 recurrence; recorded only.")

@@ -1,0 +1,167 @@
+"""Single-dispatch ragged serving step (ISSUE 6 tentpole).
+
+The two-program engine path costs up to TWO compiled dispatches per step
+(a batched prefill chunk + a decode burst) plus a host fetch; through a
+remote-dispatch tunnel the per-step RTT is the scheduler's real budget
+(serving.py module doc). This module is the fused alternative: ONE
+compiled program advances EVERY slot — decode rows and chunked-prefill
+rows ride one PACKED ragged token buffer with per-row ``(slot, q_len,
+kv_len)`` descriptors, so
+
+  * the QKV/projection/FFN GEMMs batch over ``sum(q_lens)`` real tokens
+    (a decode row contributes 1 row of GEMM work, not a padded chunk);
+  * attention is the unified Pallas ragged-paged kernel
+    (`kernels.pallas.ragged_paged_attention`) over the shared block pool,
+    descriptors riding scalar prefetch;
+  * prefill KV is appended to the pool from INSIDE the program (int8
+    pools quantize on append with per-page running-absmax scales,
+    `quantization.kv_cache`);
+  * sampling happens in-program at each row's last valid position, and a
+    K-1-step decode-burst `lax.scan` continues freshly-sampled rows —
+    K tokens per dispatch, same amortization the two-program burst had,
+    now including the token that completes a prefill (better TTFT).
+
+Layout contract (host side, `ServingEngine._step_ragged`): the packed
+buffer holds each active row's tokens contiguously at ``starts[r]``;
+``row_of/off_of`` map packed positions back to (row, chunk offset) and
+tail padding points past every row's ``q_len`` (masked everywhere).
+Attention tiles are gathered per row to a static ``[R, c_att]`` window —
+the GEMM stages, where the FLOPs live, stay unpadded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import gpt as G
+from ..kernels.pallas.ragged_paged_attention import ragged_paged_attention
+from ..quantization.kv_cache import (append_tokens_quantized,
+                                     reset_page_scales)
+from .serving import _embed, _qkv, _block_math, _head_logits
+
+__all__ = ["ragged_pass", "unified_step"]
+
+
+def ragged_pass(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                tables, temps, key, kp, vp, ks, vs, *, cfg, bs, c_att,
+                mp_axis=None):
+    """One transformer forward over the packed ragged batch + per-row
+    sampling. tokens/row_of/off_of: [T] packed (off_of >= q_len marks
+    padding); starts/pos0/q_lens/temps: [R]; tables: [R, nb]; pools:
+    [L, H_kv, NB, bs, D] (+ [L, H_kv, NB] scales when quantized).
+    Returns (tok [R], (kp, vp[, ks, vs]) updated)."""
+    T = tokens.shape[0]
+    quantized = ks is not None
+    pos_t = jnp.minimum(pos0[row_of] + off_of, cfg.max_seq_len - 1)
+    x = _embed(params, tokens[None], pos_t[None], cfg)       # [1, T, H]
+    kv_lens = pos0 + q_lens
+    valid_t = off_of < q_lens[row_of]
+    # packed-token scatter targets (unquantized pools); invalid tokens
+    # land in the reserved scratch block 0, same as the two-program path
+    posb = jnp.clip(pos_t // bs, 0, tables.shape[1] - 1)
+    blk_t = jnp.where(valid_t, tables[row_of, posb], 0)
+    off_t = jnp.where(valid_t, pos_t % bs, 0)
+    # per-row attention tile gather (clamped duplicates are masked by the
+    # kernel's c < q_len predicate)
+    tile_idx = jnp.clip(
+        starts[:, None] + jnp.minimum(jnp.arange(c_att)[None, :],
+                                      jnp.maximum(q_lens - 1, 0)[:, None]),
+        0, T - 1)                                            # [R, c_att]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    def body(x, layer):
+        if quantized:
+            p, kpl, vpl, ksl, vsl = layer
+        else:
+            (p, kpl, vpl), ksl, vsl = layer, None, None
+        q, k, v = _qkv(p, x, cfg, mp_axis)                   # [1, T, h, D]
+        if quantized:
+            kpl, ksl = append_tokens_quantized(
+                kpl, ksl, k[0][tile_idx], pos0, q_lens, tables, bs)
+            vpl, vsl = append_tokens_quantized(
+                vpl, vsl, v[0][tile_idx], pos0, q_lens, tables, bs)
+        else:
+            kpl = kpl.at[:, blk_t, off_t].set(
+                jnp.moveaxis(k[0], 1, 0).astype(kpl.dtype))  # [h, T, D]
+            vpl = vpl.at[:, blk_t, off_t].set(
+                jnp.moveaxis(v[0], 1, 0).astype(vpl.dtype))
+        attn_t = ragged_paged_attention(
+            q[0][tile_idx], kpl, vpl, tables, q_lens, kv_lens, scale,
+            ksl, vsl)                                        # [R,c_att,h,D]
+        attn_p = attn_t[row_of, jnp.minimum(off_of, c_att - 1)]
+        x = _block_math(p, x, attn_p[None], cfg, mp_axis)
+        return x, (kpl, vpl) + ((ksl, vsl) if quantized else ())
+
+    xs = (params["blocks"], kp, vp) + ((ks, vs) if quantized else ())
+    x, pools = lax.scan(body, x, xs)
+    x = G._ln(x, params["lnf_g"], params["lnf_b"])
+    last_idx = jnp.clip(starts + jnp.maximum(q_lens, 1) - 1, 0, T - 1)
+    logits = _head_logits(params, x[0][last_idx], cfg, mp_axis)  # [R, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy), pools
+
+
+def unified_step(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                 tables, fresh, sample0, remaining, eos_ids, temps, key,
+                 kp, vp, ks, vs, *, cfg, bs, c_att, K, mp_axis=None):
+    """ONE compiled program per engine step: the ragged pass (prefill
+    chunks + first decode token for every row) followed by K-1 decode
+    micro-steps for every sampling row. fresh: [R] bool — slots admitted
+    this step (their tables' page scales reset in-program, so recycled
+    blocks never inherit a stale quantization range); sample0: [R] bool —
+    rows whose pass-1 token counts (decode rows + prefills completing
+    this step); remaining: [R] tokens each row may still emit INCLUDING
+    pass-1's (0 for mid-prefill rows); eos_ids: [R] (-1 = none);
+    temps: [R] (0 = greedy).
+    Returns (toks [K, R], kp, vp, ks, vs, lens [R])."""
+    R = pos0.shape[0]
+    quantized = ks is not None
+    if quantized:
+        ks = reset_page_scales(ks, tables, fresh)
+        vs = reset_page_scales(vs, tables, fresh)
+    key, sub = jax.random.split(key)
+    tok0, pools = ragged_pass(params, tokens, row_of, off_of, starts,
+                              pos0, q_lens, tables, temps, sub,
+                              kp, vp, ks, vs, cfg=cfg, bs=bs,
+                              c_att=c_att, mp_axis=mp_axis)
+    if quantized:
+        kp, vp, ks, vs = pools
+    else:
+        kp, vp = pools
+    tok0 = jnp.where(sample0, tok0, 0)
+    lens = pos0 + q_lens
+    rem = remaining - sample0.astype(remaining.dtype)
+    alive = sample0 & ~(tok0 == eos_ids)
+    ar = jnp.arange(R, dtype=jnp.int32)
+    zero = jnp.zeros((R,), jnp.int32)
+
+    def micro(carry, _):
+        tok, kp, vp, ks, vs, lens, rem, alive, key = carry
+        active = alive & (rem > 0)
+        ql = active.astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        tok2, pools = ragged_pass(params, tok, ar, zero, ar, lens, ql,
+                                  tables, temps, sub, kp, vp, ks, vs,
+                                  cfg=cfg, bs=bs, c_att=1, mp_axis=mp_axis)
+        if quantized:
+            kp, vp, ks, vs = pools
+        else:
+            kp, vp = pools
+        tok2 = jnp.where(active, tok2, 0)
+        lens = lens + ql
+        rem = rem - ql
+        alive = alive & ~(active & (tok2 == eos_ids))
+        return (tok2, kp, vp, ks, vs, lens, rem, alive, key), tok2
+
+    if K > 1:
+        carry = (tok0, kp, vp, ks, vs, lens, rem, alive, key)
+        (_, kp, vp, ks, vs, lens, _, _, _), toks = lax.scan(
+            micro, carry, jnp.arange(K - 1))
+        all_toks = jnp.concatenate([tok0[None], toks], axis=0)
+    else:
+        all_toks = tok0[None]
+    return all_toks, kp, vp, ks, vs, lens

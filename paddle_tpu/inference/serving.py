@@ -19,19 +19,36 @@ first-class (VERDICT r2 #4):
 * **Streaming** — each sampled token fires the request's callback
   immediately (detokenize hook).
 
-TPU shape discipline: TWO compiled program shapes (a decode burst and a
-BATCHED prefill chunk covering every prefilling slot at once), both
-static-shaped; all cache state is functional jax arrays threaded through
-them, sampling happens in-program, and each engine step costs at most two
-dispatches + one host fetch (through a remote tunnel the per-step RTT is
-the scheduler's real budget). The decode attention is the Pallas paged
-kernel (scalar-prefetch block tables — streams only referenced blocks).
+TPU shape discipline, two engine modes:
+
+* **two-program path** (FLAGS_serving_ragged off — the frozen parity
+  baseline): a decode burst and a BATCHED prefill chunk covering every
+  prefilling slot at once, both static-shaped; each engine step costs at
+  most two dispatches + one host fetch (through a remote tunnel the
+  per-step RTT is the scheduler's real budget). Decode attention is the
+  Pallas paged kernel (scalar-prefetch block tables).
+
+* **single-dispatch ragged path** (FLAGS_serving_ragged / ragged=True —
+  ISSUE 6): every step builds ONE packed ragged token batch (decode rows
+  q_len=1, prefill chunks q_len≤chunk sharing a fixed token budget) and
+  runs ONE compiled program — GEMMs batched over the real tokens, the
+  unified ragged-paged-attention kernel, in-program sampling, prefill KV
+  appended in-program, plus a K-1-step decode-burst scan
+  (inference/ragged_step.py). Supports an int8 (or fp8-e4m3) KV pool —
+  quantize-on-append per-page scales, dequantize in-kernel — so a fixed
+  HBM budget admits ~2x the sequences (kv_cache_dtype / `kv_pool_bytes`),
+  and an adaptive prefill/decode mix driven by the queue-depth and TTFT
+  series the Prometheus registry already exports.
+
+All cache state is functional jax arrays threaded through the programs;
+sampling happens in-program on both paths.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+from collections import deque
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -74,18 +91,21 @@ def _embed(params, tokens, pos, cfg):
             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
 
-def _mm(x, p, name, cfg, out_dtype=None):
+def _mm(x, p, name, cfg, out_dtype=None, psum_axis=None):
     """x @ p[name], riding the int8 MXU when the engine quantized this
     weight (W8A8 dynamic with PER-ROW activation scales — a per-tensor
     absmax would couple a request's quantization grid to its co-scheduled
-    batchmates; reference: fused_multi_transformer_int8)."""
+    batchmates; reference: fused_multi_transformer_int8). psum_axis: set
+    by row-parallel TP call sites — shares the activation scale (pmax)
+    and psums the int32 accumulator so sharded int8 == dense int8."""
     wq = p.get(name + "@q")
     if wq is None:
         x = x @ p[name].astype(cfg.dtype)
         return x.astype(out_dtype) if out_dtype is not None else x
     from ..quantization import qlinear
     return qlinear(x, wq, p[name + "@s"],
-                   out_dtype=out_dtype or cfg.dtype, per_row=True)
+                   out_dtype=out_dtype or cfg.dtype, per_row=True,
+                   psum_axis=psum_axis)
 
 
 def quantize_serving_params(params):
@@ -113,17 +133,20 @@ def quantize_serving_params(params):
 def _block_math(p, x, attn, cfg, mp_axis=None):
     """Post-attention half of the GPT block (shared by both programs).
     mp_axis: Megatron TP inside shard_map — proj/fc2 are row-parallel
-    (partial matmul + psum), fc1 column-parallel."""
+    (partial matmul + psum), fc1 column-parallel. Quantized row-parallel
+    weights psum INSIDE qlinear (int32 accumulator — exact vs dense)."""
     B, S, _ = x.shape
-    out = _mm(attn.reshape(B, S, -1), p, "proj_w", cfg)
-    if mp_axis is not None:
+    q_axis = mp_axis if "proj_w@q" in p else None
+    out = _mm(attn.reshape(B, S, -1), p, "proj_w", cfg, psum_axis=q_axis)
+    if mp_axis is not None and q_axis is None:
         out = lax.psum(out, mp_axis)
     x = x + out + p["proj_b"].astype(cfg.dtype)
     h = G._ln(x, p["ln2_g"], p["ln2_b"])
     m = _mm(h.astype(cfg.dtype), p, "fc1_w", cfg) + p["fc1_b"].astype(cfg.dtype)
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(cfg.dtype)
-    m = _mm(m, p, "fc2_w", cfg)
-    if mp_axis is not None:
+    q_axis = mp_axis if "fc2_w@q" in p else None
+    m = _mm(m, p, "fc2_w", cfg, psum_axis=q_axis)
+    if mp_axis is not None and q_axis is None:
         m = lax.psum(m, mp_axis)
     return x + m + p["fc2_b"].astype(cfg.dtype)
 
@@ -288,30 +311,60 @@ class ServingEngine:
                  max_blocks_per_seq: int = 32, chunk: int = None,
                  decode_burst: int = None, seed: int = 0, mesh=None,
                  mp_axis: str = "mp", adaptive_burst="auto",
-                 int8: bool = False):
+                 int8: bool = False, ragged=None, kv_cache_dtype=None,
+                 kv_pool_bytes: Optional[int] = None,
+                 token_budget: Optional[int] = None, adaptive_mix=None,
+                 ttft_slo_s: Optional[float] = None):
         from ..flags import flag
+        from ..enforce import enforce
         block_size = (int(flag("paged_block_size")) if block_size is None
                       else block_size)
         chunk = (int(flag("serving_prefill_chunk")) if chunk is None
                  else chunk)
         decode_burst = (int(flag("serving_decode_burst"))
                         if decode_burst is None else decode_burst)
+        if ragged is None or ragged == "auto":
+            ragged = bool(flag("serving_ragged"))
+        if kv_cache_dtype is None:
+            kv_cache_dtype = str(flag("serving_kv_cache_dtype"))
+        if adaptive_mix is None or adaptive_mix == "auto":
+            adaptive_mix = bool(flag("serving_adaptive_mix"))
+        from ..quantization.kv_cache import (kv_cache_dtype as _kv_dtype,
+                                             kv_pool_blocks_for_budget)
+        if kv_cache_dtype == "auto":
+            pool_dtype, kv_quantized = cfg.dtype, False
+        else:
+            pool_dtype, kv_quantized = _kv_dtype(kv_cache_dtype)
+        enforce(not kv_quantized or ragged,
+                "quantized KV pools (kv_cache_dtype="
+                f"{kv_cache_dtype!r}) need the single-dispatch ragged "
+                "path (ragged=True / FLAGS_serving_ragged) — the "
+                "two-program baseline kernels read float pools",
+                op="ServingEngine")
+        L, Hkv, D = cfg.num_layers, cfg.num_heads, cfg.head_dim
+        if kv_pool_bytes is not None:
+            # capacity from a fixed HBM byte budget: the int8-pool mode
+            # admits ~2x the blocks of bf16 at the same budget
+            num_blocks = max(2, kv_pool_blocks_for_budget(
+                kv_pool_bytes, L, Hkv, block_size, D, pool_dtype))
         if int8:
-            from ..enforce import UnimplementedError, enforce
-            enforce(mesh is None, "int8 + TP serving not wired yet — "
-                    "quantized trees need sharded-scale specs",
-                    error=UnimplementedError, op="ServingEngine")
             # W8A8 decode: weights stored int8 with per-output-channel
             # scales; decode reads every weight per token, so halving the
-            # bytes attacks its memory-bound cost directly
+            # bytes attacks its memory-bound cost directly. Under TP the
+            # scales shard with their weight's output channels (_init_tp).
             params = quantize_serving_params(params)
         self.params, self.cfg = params, cfg
         self.bs, self.chunk = block_size, chunk
         self.max_batch = max_batch
-        L, Hkv, D = cfg.num_layers, cfg.num_heads, cfg.head_dim
+        self.ragged = ragged
+        self.kv_quantized = kv_quantized
         self.k_pools = jnp.zeros((L, Hkv, num_blocks, block_size, D),
-                                 cfg.dtype)
+                                 pool_dtype)
         self.v_pools = jnp.zeros_like(self.k_pools)
+        self.k_scales = self.v_scales = None
+        if kv_quantized:
+            self.k_scales = jnp.zeros((L, Hkv, num_blocks), jnp.float32)
+            self.v_scales = jnp.zeros_like(self.k_scales)
         self.tables = np.zeros((max_batch, max_blocks_per_seq), np.int32)
         self.lens = np.zeros((max_batch,), np.int32)
         # block 0 is the scratch block idle slots write into
@@ -321,6 +374,27 @@ class ServingEngine:
         self._next_rid = 0
         self._key = jax.random.PRNGKey(seed)
         self.decode_burst = decode_burst
+        # ragged path: fixed per-step token budget shared by decode rows
+        # (1 each, always granted) and prefill chunks (the leftover)
+        self.token_budget = (int(token_budget) if token_budget
+                             else max_batch + chunk)
+        enforce(self.token_budget >= max_batch,
+                f"token_budget {self.token_budget} must cover one decode "
+                f"token per slot (max_batch {max_batch})",
+                op="ServingEngine")
+        self._c_att = max(1, min(chunk, self.token_budget))
+        self.adaptive_mix = adaptive_mix
+        self.ttft_slo_s = ttft_slo_s
+        # SLO pressure reads a recent window, not the exported summary's
+        # lifetime mean — one compile-heavy startup wave must not pin the
+        # adaptive mix at shortened bursts for the engine's whole life
+        self._recent_ttft: deque = deque(maxlen=16)
+        # dispatch accounting (the ragged path's contract is ONE compiled
+        # dispatch per engine step; the bench reports dispatches/step)
+        self.dispatches = 0
+        self.engine_steps = 0
+        self._dispatches_reported = 0
+        self._jit_programs: List = []
         # adaptive bursts shorten to the earliest finisher so its slot
         # re-admits sooner — a win ONLY when dispatch overhead is below a
         # few decode steps. Through a remote tunnel (~105 ms per fetch)
@@ -345,7 +419,15 @@ class ServingEngine:
 
         # params ride as ARGUMENTS (a closure would bake 4 bytes/param
         # into the serialized HLO — megabytes that also defeat donation)
-        if mesh is None:
+        self._mesh = mesh
+        self._mp_axis = mp_axis if mesh is not None else None
+        if mesh is not None:
+            self._tp_shard(mesh, mp_axis)
+        if ragged:
+            # unified single-dispatch programs compile lazily per burst
+            # length K (only the sizes the scheduler asks for)
+            self._unified_cache = {}
+        elif mesh is None:
             # decode programs per burst length (powers of two up to
             # decode_burst; only the sizes the scheduler uses compile)
             self._decode_k = {
@@ -357,6 +439,7 @@ class ServingEngine:
                                                       cfg=cfg,
                                                       bs=block_size),
                                     donate_argnums=(7, 8))
+            self._jit_programs += [self._prefill, *self._decode_k.values()]
         else:
             self._init_tp(mesh, mp_axis, block_size, decode_burst)
 
@@ -367,14 +450,15 @@ class ServingEngine:
             ks.append(min(ks[-1] * 2, k_max))
         return ks
 
-    def _init_tp(self, mesh, mp_axis, block_size, decode_burst):
-        """Megatron-TP serving over a mesh axis (VERDICT r3 #8): params
-        and KV pools sharded over heads/columns, decode+prefill wrapped in
-        shard_map — qkv column-parallel (complete local heads), proj/fc2
-        row-parallel with psum, vocab-parallel head with an all-gather of
-        the tiny [B, V] logits."""
+    def _tp_shard(self, mesh, mp_axis):
+        """Shard params + KV pools over the mp mesh axis (Megatron TP):
+        qkv/fc1 column-parallel (complete local heads), proj/fc2
+        row-parallel, vocab-parallel head when the vocab divides the
+        axis. int8 weight storage shards exactly like the weight it
+        replaces, and the per-output-channel scales FOLLOW the output
+        channels: column-parallel scales shard on out, row-parallel
+        scales stay replicated (out dim unsharded)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..utils import shard_map
         cfg = self.cfg
         ax = mp_axis
         n = mesh.shape[mp_axis]
@@ -385,18 +469,29 @@ class ServingEngine:
                 f"({n})", op="ServingEngine")
         # vocab-parallel head only when the vocab divides the axis
         head_spec = P(None, ax) if cfg.vocab_size % n == 0 else P()
+        block_specs = {
+            "ln1_g": P(), "ln1_b": P(),
+            "qkv_w": P(None, None, ax), "qkv_b": P(None, ax),
+            "proj_w": P(None, ax, None), "proj_b": P(),
+            "ln2_g": P(), "ln2_b": P(),
+            "fc1_w": P(None, None, ax), "fc1_b": P(None, ax),
+            "fc2_w": P(None, ax, None), "fc2_b": P(),
+            "qkv_w@q": P(None, None, ax), "qkv_w@s": P(None, ax),
+            "fc1_w@q": P(None, None, ax), "fc1_w@s": P(None, ax),
+            "proj_w@q": P(None, ax, None), "proj_w@s": P(),
+            "fc2_w@q": P(None, ax, None), "fc2_w@s": P(),
+        }
         pspec = {
             "wte": P(), "wpe": P(),
-            "blocks": {
-                "ln1_g": P(), "ln1_b": P(),
-                "qkv_w": P(None, None, ax), "qkv_b": P(None, ax),
-                "proj_w": P(None, ax, None), "proj_b": P(),
-                "ln2_g": P(), "ln2_b": P(),
-                "fc1_w": P(None, None, ax), "fc1_b": P(None, ax),
-                "fc2_w": P(None, ax, None), "fc2_b": P(),
-            },
-            "lnf_g": P(), "lnf_b": P(), "head_w": head_spec,
+            "blocks": {k: block_specs[k] for k in self.params["blocks"]},
+            "lnf_g": P(), "lnf_b": P(),
         }
+        if "head_w" in self.params:
+            pspec["head_w"] = head_spec
+        else:
+            pspec["head_w@q"] = head_spec
+            pspec["head_w@s"] = (P(ax) if cfg.vocab_size % n == 0
+                                 else P())
         pool_spec = P(None, ax)
         self.params = jax.tree.map(
             lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
@@ -405,6 +500,23 @@ class ServingEngine:
                                       NamedSharding(mesh, pool_spec))
         self.v_pools = jax.device_put(self.v_pools,
                                       NamedSharding(mesh, pool_spec))
+        if self.kv_quantized:
+            self.k_scales = jax.device_put(self.k_scales,
+                                           NamedSharding(mesh, pool_spec))
+            self.v_scales = jax.device_put(self.v_scales,
+                                           NamedSharding(mesh, pool_spec))
+        self._tp_pspec, self._tp_pool_spec = pspec, pool_spec
+
+    def _init_tp(self, mesh, mp_axis, block_size, decode_burst):
+        """Megatron-TP two-program path (VERDICT r3 #8): decode+prefill
+        wrapped in shard_map over the shardings _tp_shard placed — qkv
+        column-parallel (complete local heads), proj/fc2 row-parallel
+        with psum, vocab-parallel head with an all-gather of the tiny
+        [B, V] logits."""
+        from jax.sharding import PartitionSpec as P
+        from ..utils import shard_map
+        cfg = self.cfg
+        pspec, pool_spec = self._tp_pspec, self._tp_pool_spec
         rep = P()
 
         def mk_decode(k):
@@ -420,6 +532,7 @@ class ServingEngine:
                           rep, rep, rep),
                 out_specs=(rep, pool_spec, pool_spec, rep))
             jfn = jax.jit(sm, donate_argnums=(2, 3))
+            self._jit_programs.append(jfn)
             return (lambda params, tokens, kp, vp, tables, lens, remaining,
                     eos_ids, temps, key: jfn(
                         params, tokens, kp, vp, tables, lens, remaining,
@@ -442,10 +555,139 @@ class ServingEngine:
                                 pool_spec, pool_spec),
                       out_specs=(rep, pool_spec, pool_spec)),
             donate_argnums=(7, 8))
+        self._jit_programs.append(jpre)
         self._prefill = (lambda params, buf, pos0, tables, last_idx, temps,
                          key, kp, vp: jpre(
                              params, buf, pos0, tables, last_idx, temps,
                              jax.random.key_data(key), kp, vp))
+
+    # -- single-dispatch ragged path (ISSUE 6) -------------------------------
+    def _unified(self, K):
+        """The ONE compiled program for a ragged step with a K-token
+        decode burst (lazily built per K — only scheduler-chosen sizes
+        compile). Calling convention matches ragged_step.unified_step
+        with the pools (and scales, when quantized) donated."""
+        fn = self._unified_cache.get(K)
+        if fn is None:
+            fn = self._build_unified(K)
+            self._unified_cache[K] = fn
+        return fn
+
+    def _build_unified(self, K):
+        from . import ragged_step as RS
+        cfg, bsz, c_att = self.cfg, self.bs, self._c_att
+        quant = self.kv_quantized
+        mesh, ax = self._mesh, self._mp_axis
+        if mesh is None:
+            if quant:
+                jfn = jax.jit(functools.partial(
+                    RS.unified_step, cfg=cfg, bs=bsz, c_att=c_att, K=K),
+                    donate_argnums=(14, 15, 16, 17))
+                self._jit_programs.append(jfn)
+                return jfn
+
+            def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                   tables, fresh, sample0, remaining, eos_ids, temps,
+                   key, kp, vp):
+                return RS.unified_step(
+                    params, tokens, row_of, off_of, starts, pos0, q_lens,
+                    tables, fresh, sample0, remaining, eos_ids, temps,
+                    key, kp, vp, None, None, cfg=cfg, bs=bsz,
+                    c_att=c_att, K=K)
+
+            jfn = jax.jit(fn, donate_argnums=(14, 15))
+            self._jit_programs.append(jfn)
+            return jfn
+
+        # TP: the unified program runs inside shard_map over the same
+        # shardings as the two-program path (pools/scales head-sharded,
+        # descriptors replicated)
+        from jax.sharding import PartitionSpec as P
+        from ..utils import shard_map
+        pspec, pool_spec = self._tp_pspec, self._tp_pool_spec
+        rep = P()
+
+        if quant:
+            def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                   tables, fresh, sample0, remaining, eos_ids, temps,
+                   key_data, kp, vp, ks, vs):
+                return RS.unified_step(
+                    params, tokens, row_of, off_of, starts, pos0, q_lens,
+                    tables, fresh, sample0, remaining, eos_ids, temps,
+                    jax.random.wrap_key_data(key_data), kp, vp, ks, vs,
+                    cfg=cfg, bs=bsz, c_att=c_att, K=K, mp_axis=ax)
+            in_specs = (pspec,) + (rep,) * 13 + (pool_spec,) * 4
+            out_specs = (rep, pool_spec, pool_spec, pool_spec, pool_spec,
+                         rep)
+            donate = (14, 15, 16, 17)
+        else:
+            def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                   tables, fresh, sample0, remaining, eos_ids, temps,
+                   key_data, kp, vp):
+                toks, kp, vp, _, _, lens = RS.unified_step(
+                    params, tokens, row_of, off_of, starts, pos0, q_lens,
+                    tables, fresh, sample0, remaining, eos_ids, temps,
+                    jax.random.wrap_key_data(key_data), kp, vp, None,
+                    None, cfg=cfg, bs=bsz, c_att=c_att, K=K, mp_axis=ax)
+                return toks, kp, vp, lens
+            in_specs = (pspec,) + (rep,) * 13 + (pool_spec, pool_spec)
+            out_specs = (rep, pool_spec, pool_spec, rep)
+            donate = (14, 15)
+
+        jfn = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs),
+                      donate_argnums=donate)
+        self._jit_programs.append(jfn)
+
+        if quant:
+            def call(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                     tables, fresh, sample0, remaining, eos_ids, temps,
+                     key, kp, vp, ks, vs):
+                return jfn(params, tokens, row_of, off_of, starts, pos0,
+                           q_lens, tables, fresh, sample0, remaining,
+                           eos_ids, temps, jax.random.key_data(key),
+                           kp, vp, ks, vs)
+        else:
+            def call(params, tokens, row_of, off_of, starts, pos0, q_lens,
+                     tables, fresh, sample0, remaining, eos_ids, temps,
+                     key, kp, vp):
+                toks, kp, vp, lens = jfn(
+                    params, tokens, row_of, off_of, starts, pos0, q_lens,
+                    tables, fresh, sample0, remaining, eos_ids, temps,
+                    jax.random.key_data(key), kp, vp)
+                return toks, kp, vp, None, None, lens
+        return call
+
+    def compiled_cache_entries(self) -> int:
+        """Total traced-program cache entries across every jit program
+        this engine built — the ragged path's one-dispatch-per-step
+        contract is asserted against this in tests (and reported by the
+        serving bench)."""
+        return sum(f._cache_size() for f in self._jit_programs)
+
+    def _pick_burst(self, n_prefilling: int) -> int:
+        """Adaptive prefill/decode mix, driven by the queue-depth and
+        TTFT series the Prometheus registry exports: under admission
+        pressure (waiting queue / active prefills / TTFT above the SLO)
+        the decode burst shortens so prefill slices come around more
+        often per wall-clock; with no pressure the burst runs long to
+        amortize dispatch. Fixed `decode_burst` when adaptive_mix off."""
+        if not self.adaptive_mix:
+            return self.decode_burst
+        q_depth = int(self._prom.get("queue_depth") or 0)
+        pressure = q_depth + n_prefilling
+        # recent window, NOT the summary's lifetime mean: the mean never
+        # decays, so one slow startup wave would halve bursts forever
+        ttft = (sum(self._recent_ttft) / len(self._recent_ttft)
+                if self._recent_ttft else None)
+        if (self.ttft_slo_s is not None and ttft is not None
+                and ttft > self.ttft_slo_s):
+            pressure = max(pressure * 2, 1)
+        if pressure <= 0:
+            return self.decode_burst
+        k = max(1, self.decode_burst // (pressure + 1))
+        return max(s for s in self._burst_sizes(self.decode_burst)
+                   if s <= k)
 
     # -- public --------------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int, temperature=0.0,
@@ -485,18 +727,28 @@ class ServingEngine:
     def _blocks_needed(self, r: Request) -> int:
         return -(-(len(r.prompt) + r.max_new_tokens) // self.bs)
 
-    def _admit(self):
+    def _admit(self) -> List[int]:
+        """Admit queued requests into free slots while the pool has
+        pages; returns the freshly-admitted slot ids (the ragged path
+        resets those slots' page scales in-program). When free pages run
+        out the head of the queue WAITS (no starvation); a request that
+        could never fit even in an empty pool is rejected loudly."""
+        fresh: List[int] = []
+        usable = self._num_blocks - 1  # block 0 is reserved scratch
         for i in range(self.max_batch):
             if self.slots[i] is not None or not self.queue:
                 continue
             r = self.queue[0]
             need = self._blocks_needed(r)
-            if need > self.tables.shape[1]:
+            if need > self.tables.shape[1] or need > usable:
                 self.queue.pop(0)
                 r.done = True  # cannot ever fit; reject loudly
+                cap = (f"max_blocks_per_seq {self.tables.shape[1]}"
+                       if need > self.tables.shape[1]
+                       else f"pool capacity {usable}")
                 raise InvalidArgumentError(
-                    f"request {r.rid} needs {need} blocks > "
-                    f"max_blocks_per_seq {self.tables.shape[1]}")
+                    f"request {r.rid} needs {need} blocks > {cap} — it "
+                    "can never be admitted")
             if need > len(self.free_blocks):
                 break  # head-of-line waits for evictions (no starvation)
             self.queue.pop(0)
@@ -507,6 +759,8 @@ class ServingEngine:
             r.slot = i
             r.prefill_done = 0
             self.slots[i] = r
+            fresh.append(i)
+        return fresh
 
     def _finish(self, r: Request):
         i = r.slot
@@ -524,6 +778,7 @@ class ServingEngine:
         self._tokens_total += 1
         if len(r.output) == 1:
             r.ttft_s = time.perf_counter() - r.submit_time
+            self._recent_ttft.append(r.ttft_s)
             self._prom.summary_observe(
                 "ttft_seconds", r.ttft_s,
                 help="submit-to-first-token latency")
@@ -533,24 +788,26 @@ class ServingEngine:
                 or (r.eos_id is not None and tok == r.eos_id))
 
     def step(self) -> List[Request]:
-        """One engine iteration: admit -> one prefill chunk -> one decode
-        step for all decoding slots. Returns requests finished this step."""
+        """One engine iteration. Ragged path: admit -> ONE compiled
+        program (prefill chunks + decode burst fused over a packed
+        ragged batch). Two-program path: admit -> one prefill chunk ->
+        one decode burst. Returns requests finished this step."""
+        self.engine_steps += 1
+        if self.ragged:
+            return self._step_ragged()
+        return self._step_two_program()
+
+    def _step_two_program(self) -> List[Request]:
+        """The frozen parity baseline: one batched prefill-chunk dispatch
+        plus one decode-burst dispatch per step (flags-off compiles this
+        path unchanged — asserted bitwise in tests)."""
         t_step0 = time.perf_counter()
         if self._t_first_step is None:
             self._t_first_step = t_step0
         tokens_before = self._tokens_total
         finished: List[Request] = []
         self._admit()
-        # sample pool pressure while this step's admissions HOLD their
-        # blocks — end-of-step sampling would miss requests that allocate
-        # and complete within one engine step (block 0 is the reserved
-        # scratch block, never allocatable)
-        total_blocks = self._num_blocks - 1
-        if total_blocks:
-            self._prom.gauge_max(
-                "kv_pool_utilization_peak",
-                1.0 - len(self.free_blocks) / total_blocks,
-                help="high-water allocated fraction of the KV pool")
+        self._note_pool_peak()
 
         # ---- one chunked-prefill slice for EVERY prefilling slot (one
         # program, one dispatch — not one engine step per request)
@@ -575,6 +832,7 @@ class ServingEngine:
                 temps[i] = r.temperature
                 his[i] = hi
             self._key, sub = jax.random.split(self._key)
+            self.dispatches += 1
             tok_dev, self.k_pools, self.v_pools = self._prefill(
                 self.params, jnp.asarray(buf), jnp.asarray(pos0),
                 jnp.asarray(tables_pre), jnp.asarray(last_idx),
@@ -617,6 +875,7 @@ class ServingEngine:
                         K = k
                         break
             self.decode_microsteps += K
+            self.dispatches += 1
             toks, self.k_pools, self.v_pools, lens = self._decode_k[K](
                 self.params, jnp.asarray(self._pending_tok), self.k_pools,
                 self.v_pools, jnp.asarray(self.tables),
@@ -639,7 +898,132 @@ class ServingEngine:
                            finished)
         return finished
 
+    def _step_ragged(self) -> List[Request]:
+        """The single-dispatch step: admit, pack ONE ragged token batch
+        (decode rows first — one token each, always granted — then
+        prefill chunks sharing the leftover token budget), run the ONE
+        unified program (K-token decode burst fused in), walk the [K, R]
+        token matrix on the host. One compiled dispatch, one fetch."""
+        t_step0 = time.perf_counter()
+        if self._t_first_step is None:
+            self._t_first_step = t_step0
+        tokens_before = self._tokens_total
+        finished: List[Request] = []
+        fresh_slots = self._admit()
+        self._note_pool_peak()
+
+        R, T = self.max_batch, self.token_budget
+        dec = [r for r in self.slots
+               if r is not None and r.prefill_done >= len(r.prompt)]
+        pre = [r for r in self.slots
+               if r is not None and r.prefill_done < len(r.prompt)]
+        if not dec and not pre:
+            self._step_metrics(t_step0, tokens_before, 0, 0, finished)
+            return finished
+
+        tokens = np.zeros((T,), np.int32)
+        row_of = np.zeros((T,), np.int32)
+        off_of = np.full((T,), T, np.int32)  # > any q_len -> padding
+        starts = np.zeros((R,), np.int32)
+        pos0 = np.zeros((R,), np.int32)
+        q_lens = np.zeros((R,), np.int32)
+        fresh = np.zeros((R,), bool)
+        sample0 = np.zeros((R,), bool)
+        remaining = np.zeros((R,), np.int32)
+        eos_ids = np.full((R,), -1, np.int32)
+        temps = np.zeros((R,), np.float32)
+        for i in fresh_slots:
+            fresh[i] = True
+        cursor = 0
+        for r in dec:  # decode rows: 1 token each, always granted
+            i = r.slot
+            q_lens[i] = 1
+            pos0[i] = self.lens[i]
+            sample0[i] = True
+            remaining[i] = r.max_new_tokens - len(r.output)
+            if r.eos_id is not None:
+                eos_ids[i] = r.eos_id
+            temps[i] = r.temperature
+            tokens[cursor] = self._pending_tok[i]
+            row_of[cursor] = i
+            off_of[cursor] = 0
+            starts[i] = cursor
+            cursor += 1
+        grants: Dict[int, int] = {}
+        for r in pre:  # prefill chunks share the leftover budget
+            i = r.slot
+            lo = r.prefill_done
+            pos0[i] = lo  # keeps device lens honest even at zero grant
+            todo = len(r.prompt) - lo
+            grant = min(self.chunk, todo, T - cursor)
+            if grant <= 0:
+                continue
+            grants[i] = grant
+            q_lens[i] = grant
+            completing = lo + grant >= len(r.prompt)
+            sample0[i] = completing
+            remaining[i] = r.max_new_tokens if completing else 0
+            if r.eos_id is not None:
+                eos_ids[i] = r.eos_id
+            temps[i] = r.temperature
+            tokens[cursor:cursor + grant] = r.prompt[lo:lo + grant]
+            row_of[cursor:cursor + grant] = i
+            off_of[cursor:cursor + grant] = np.arange(grant)
+            starts[i] = cursor
+            cursor += grant
+
+        K = self._pick_burst(len(pre))
+        if not sample0.any():
+            # every slot is mid-prefill: no row can sample this step, so
+            # the K-1 decode micro-steps would run full forward passes
+            # over all-zero q_lens. K=1 is an already-compiled size.
+            K = 1
+        self.decode_microsteps += K
+        self._key, sub = jax.random.split(self._key)
+        args = (self.params, jnp.asarray(tokens), jnp.asarray(row_of),
+                jnp.asarray(off_of), jnp.asarray(starts),
+                jnp.asarray(pos0), jnp.asarray(q_lens),
+                jnp.asarray(self.tables), jnp.asarray(fresh),
+                jnp.asarray(sample0), jnp.asarray(remaining),
+                jnp.asarray(eos_ids), jnp.asarray(temps), sub,
+                self.k_pools, self.v_pools)
+        if self.kv_quantized:
+            args = args + (self.k_scales, self.v_scales)
+        self.dispatches += 1
+        (toks, self.k_pools, self.v_pools, self.k_scales, self.v_scales,
+         lens) = self._unified(K)(*args)
+        toks = np.asarray(toks)              # [K, R] — ONE host fetch
+        self.lens = np.array(lens)
+        for r in pre:
+            r.prefill_done += grants.get(r.slot, 0)
+        for r in dec + [r for r in pre
+                        if r.prefill_done >= len(r.prompt)]:
+            for t in range(toks.shape[0]):
+                if r.done:
+                    break
+                tok = int(toks[t, r.slot])
+                self._pending_tok[r.slot] = tok
+                if self._emit(r, tok):
+                    finished.append(r)
+                    self._finish(r)
+                    break
+        self._step_metrics(t_step0, tokens_before, len(pre), len(dec),
+                           finished)
+        return finished
+
     # -- observability -------------------------------------------------------
+    def _note_pool_peak(self):
+        """Sample pool pressure while this step's admissions HOLD their
+        blocks — end-of-step sampling would miss requests that allocate
+        and complete within one engine step (block 0 is the reserved
+        scratch block, never allocatable)."""
+        total_blocks = self._num_blocks - 1
+        if total_blocks:
+            self._prom.gauge_max(
+                "kv_pool_utilization_peak",
+                1.0 - len(self.free_blocks) / total_blocks,
+                help="high-water allocated fraction of the KV pool")
+
     def _step_metrics(self, t_step0, tokens_before, n_pre, n_dec, finished):
         prom = self._prom
         dt = max(time.perf_counter() - t_step0, 1e-9)
@@ -656,6 +1040,14 @@ class ServingEngine:
                        sum(s is not None for s in self.slots),
                        help="slots occupied this step")
         prom.counter_inc("engine_steps_total", help="engine iterations")
+        prom.counter_inc("dispatches_total",
+                         self.dispatches - self._dispatches_reported,
+                         help="compiled-program dispatches issued (the "
+                              "ragged path's contract: one per step)")
+        self._dispatches_reported = self.dispatches
+        prom.gauge_set("dispatches_per_step",
+                       self.dispatches / max(self.engine_steps, 1),
+                       help="mean compiled dispatches per engine step")
         prom.counter_inc("tokens_total", emitted,
                          help="sampled tokens emitted")
         prom.counter_inc("prefill_slots_total", n_pre,

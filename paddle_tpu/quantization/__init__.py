@@ -302,35 +302,54 @@ def quantize_to_int8(x, scale=None, axis=None):
     return q, scale
 
 
-def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32):
+def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32,
+                psum_axis=None):
     """int8 @ int8 with int32 accumulation on the MXU, dequantized by the
     product of scales. x_scale: scalar (per-tensor); w_scale: scalar or
-    per-output-channel (broadcasts on the last dim)."""
+    per-output-channel (broadcasts on the last dim). psum_axis: for
+    row-parallel (contraction-sharded) TP matmuls — psum the INT32
+    accumulator across the axis before dequantizing, so the sharded
+    product is bit-identical to the dense one (int32 partial sums are
+    exact; a float psum of dequantized partials would reassociate the
+    rounding)."""
     acc = jax.lax.dot_general(
         x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
+    if psum_axis is not None:
+        acc = jax.lax.psum(acc, psum_axis)
     ws = jnp.reshape(jnp.asarray(w_scale), (-1,))  # [out] or [1]
     return (acc.astype(jnp.float32)
             * (jnp.asarray(x_scale) / 127.0) * (ws / 127.0)
             ).astype(out_dtype)
 
 
-def qlinear(x, w_q, w_scale, bias=None, out_dtype=None, per_row=False):
+def qlinear(x, w_q, w_scale, bias=None, out_dtype=None, per_row=False,
+            psum_axis=None):
     """Dynamic-activation-quant linear: quantize x per call, run the int8
     MXU matmul, dequantize (W8A8 dynamic — the llm.int8-style serving
     path). per_row=True scales each row (reduce only the contraction
     dim) instead of the whole tensor — REQUIRED when x batches
     independent requests (continuous batching): a per-tensor absmax would
     make one request's quantization grid depend on its co-scheduled
-    batchmates' outliers."""
+    batchmates' outliers. psum_axis: row-parallel TP — the per-row
+    activation absmax is SHARED across the axis (pmax), each shard
+    quantizes its slice on the common grid, and the int32 accumulator is
+    psum'd before dequantization (see int8_matmul) — the sharded linear
+    reproduces the dense int8 linear exactly."""
     out_dtype = out_dtype or x.dtype
     if per_row:
         x_scale = jnp.maximum(
             jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-8)
+        if psum_axis is not None:
+            x_scale = jax.lax.pmax(x_scale, psum_axis)
+        x_q, _ = quantize_to_int8(x, scale=x_scale)
+    elif psum_axis is not None:
+        x_scale = jax.lax.pmax(absmax_scale(x), psum_axis)
         x_q, _ = quantize_to_int8(x, scale=x_scale)
     else:
         x_q, x_scale = quantize_to_int8(x)
-    out = int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32)
+    out = int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32,
+                      psum_axis=psum_axis)
     if bias is not None:
         out = out + bias.astype(jnp.float32)
     return out.astype(out_dtype)

@@ -1,0 +1,134 @@
+"""Quantized paged KV-cache pool (int8, stretch fp8-e4m3 storage).
+
+Decode attention is bandwidth-bound: per generated token the kernel
+streams every referenced KV page. Storing the pool int8 halves those
+bytes AND doubles the sequences a fixed HBM budget admits — the two wins
+ISSUE 6 targets. The machinery reuses the module-wide quantization
+convention (``__init__.quantize_to_int8``: scale = absmax, dequant =
+q·scale/127); scales live per (layer, kv_head, page) so one SMEM scalar
+dequantizes a whole ``[bs, D]`` page tile inside the ragged kernel.
+
+Append semantics (deterministic, functional — runs INSIDE the serving
+program): pages accept tokens incrementally, so a page's scale is a
+running absmax. When a new token raises it, the page's existing int8
+contents are REQUANTIZED to the grown scale (q' = round(q·s_old/s_new))
+in the same scatter that writes the new tokens — a one-page
+read-modify-write riding next to an attention read of ceil(len/bs)
+pages, i.e. amortized noise. Freed pages get their scales reset to zero
+in-program when their blocks are re-admitted (`reset_page_scales`), so a
+recycled block never inherits a stale (precision-crushing) range.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["kv_cache_dtype", "kv_pool_blocks_for_budget",
+           "append_tokens_quantized", "reset_page_scales",
+           "KV_CACHE_DTYPES"]
+
+# storage dtypes the pool supports; "auto" in the engine resolves to the
+# model compute dtype (unquantized)
+KV_CACHE_DTYPES = ("auto", "bf16", "f32", "int8", "fp8_e4m3")
+
+_EPS = 1e-8
+
+
+def kv_cache_dtype(name):
+    """Resolve a `kv_cache_dtype` flag/arg value to (jnp dtype, quantized:
+    bool). `auto` is resolved by the caller (engine) to the model dtype."""
+    from ..enforce import enforce_in
+    enforce_in(name, set(KV_CACHE_DTYPES) - {"auto"}, op="kv_cache_dtype",
+               kv_cache_dtype=name)
+    if name == "int8":
+        return jnp.int8, True
+    if name == "fp8_e4m3":
+        # fp8 storage keeps the same per-page absmax scales (e4m3 has no
+        # shared exponent window wide enough for raw activations)
+        return jnp.float8_e4m3fn, True
+    return {"bf16": jnp.bfloat16, "f32": jnp.float32}[name], False
+
+
+def _qmax(dtype):
+    return 127.0 if dtype == jnp.int8 else 448.0  # e4m3 finite max
+
+
+def kv_pool_blocks_for_budget(budget_bytes: int, num_layers: int,
+                              num_kv_heads: int, block_size: int,
+                              head_dim: int, dtype) -> int:
+    """How many pool blocks a fixed HBM byte budget admits (k + v pools
+    plus, for quantized dtypes, their f32 per-page scales). This is the
+    capacity half of the int8-KV win: itemsize 1 vs 2 ≈ 2x the blocks."""
+    item = jnp.dtype(dtype).itemsize
+    per_block = 2 * num_layers * num_kv_heads * block_size * head_dim * item
+    if jnp.dtype(dtype) in (jnp.dtype(jnp.int8),
+                            jnp.dtype(jnp.float8_e4m3fn)):
+        per_block += 2 * num_layers * num_kv_heads * 4  # k+v scale entries
+    return int(budget_bytes // per_block)
+
+
+def reset_page_scales(scales, tables, fresh):
+    """Zero the per-page scales of every block in a freshly-admitted
+    row's table, in-program (one scatter, no extra dispatch). scales:
+    [L, H, NB]; tables: [R, nb] int32; fresh: [R] bool — rows admitted
+    this step. Non-fresh rows route their scatter at block 0 (the
+    reserved scratch block), whose scale is meaningless by construction."""
+    idx = jnp.where(fresh[:, None], tables, 0).reshape(-1)
+    return scales.at[:, :, idx].set(0.0)
+
+
+def append_tokens_quantized(pool, scales, val, pos0, q_lens, tables, bs):
+    """Quantize-on-append into the paged pool with per-(head, page)
+    running-absmax scales.
+
+    pool: [H, NB, bs, D] int8/fp8; scales: [H, NB] f32; val: [R, C, H, D]
+    float chunk tiles (row r's tokens occupy columns [0, q_lens[r]) and
+    land at positions pos0[r]..pos0[r]+q_lens[r]-1); tables: [R, nb].
+    Returns (pool', scales'). Rows with q_len = 0 are exact no-ops on
+    their own pages (ratio-1 requantize); idle rows' writes land in the
+    scratch block 0 like the unquantized scatter path.
+    """
+    R, C, H, D = val.shape
+    nb = tables.shape[1]
+    qmax = _qmax(pool.dtype)
+    # a C-token span starting anywhere touches at most this many pages
+    PT = min(nb, (C + bs - 2) // bs + 1)
+    p0b = pos0 // bs
+    slot = p0b[:, None] + jnp.arange(PT)[None, :]              # [R, PT]
+    # slots past the table's end (a chunk landing in the last page) route
+    # to the reserved scratch block 0 like the unquantized path — clipping
+    # to nb-1 would alias the row's REAL last block and the duplicate
+    # scatter entry (whose winner XLA leaves unspecified) could overwrite
+    # the freshly appended tokens with requantized stale contents
+    blk = jnp.where(slot < nb,
+                    jnp.take_along_axis(tables, jnp.clip(slot, 0, nb - 1),
+                                        axis=1), 0)
+    # which chunk token (if any) lands in each page cell
+    gpos = slot[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+    tok = gpos - pos0[:, None, None]                           # [R, PT, bs]
+    valid = (tok >= 0) & (tok < q_lens[:, None, None])
+    tok_c = jnp.clip(tok, 0, C - 1)
+    # vals_sel[r, u, o] = val[r, tok_c[r, u, o]] — [R, PT, bs, H, D]
+    vals_sel = jnp.take_along_axis(
+        val[:, None], tok_c[:, :, :, None, None], axis=2)
+    av = jnp.where(valid[..., None, None],
+                   jnp.abs(vals_sel.astype(jnp.float32)), 0.0)
+    vmax = av.max(axis=(2, 4))                                 # [R, PT, H]
+    # grow the touched pages' scales (scatter-max: associative, so pages
+    # hit by several tokens — or several idle rows at scratch — are safe)
+    new_scales = scales.at[:, blk].max(jnp.moveaxis(vmax, 2, 0))
+    s_new = new_scales[:, blk]                                 # [H, R, PT]
+    s_old = scales[:, blk]
+    ratio = jnp.where(s_new > 0, s_old / jnp.maximum(s_new, _EPS), 1.0)
+    pages = pool[:, blk]                                       # [H,R,PT,bs,D]
+    is_int = pool.dtype == jnp.dtype(jnp.int8)
+    requant = pages.astype(jnp.float32) * ratio[..., None, None]
+    vt = jnp.moveaxis(vals_sel, 3, 0).astype(jnp.float32)      # [H,R,PT,bs,D]
+    q_new = vt * qmax / jnp.maximum(s_new, _EPS)[..., None, None]
+    if is_int:  # fp8 storage keeps fractions; int8 rounds to the grid
+        requant = jnp.round(requant)
+        q_new = jnp.round(q_new)
+    q_new = jnp.clip(q_new, -qmax, qmax)
+    merged = jnp.where(valid[None, :, :, :, None], q_new, requant)
+    pool = pool.at[:, blk].set(merged.astype(pool.dtype))
+    return pool, new_scales
