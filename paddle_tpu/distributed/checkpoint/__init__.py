@@ -3,16 +3,19 @@
 
 from .load_state_dict import (load_full_state_dict, load_metadata,
                               load_state_dict)
-from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .metadata import (LocalTensorIndex, LocalTensorMetadata, Metadata,
+                       SavedLayout)
 from .pp_adaptor import pp_relayout_state_dict
-from .save_state_dict import save_state_dict, wait_async_save
+from .reshard import layout_mismatch, load_resharded
+from .save_state_dict import build_layout, save_state_dict, wait_async_save
 from .utils import flatten_state_dict, unflatten_state_dict
 from . import pp_adaptor
 
 __all__ = [
     "save_state_dict", "load_state_dict", "load_full_state_dict",
     "wait_async_save", "load_metadata",
-    "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
+    "Metadata", "LocalTensorMetadata", "LocalTensorIndex", "SavedLayout",
+    "build_layout", "layout_mismatch", "load_resharded",
     "flatten_state_dict", "unflatten_state_dict",
     "pp_adaptor", "pp_relayout_state_dict",
 ]

@@ -1,0 +1,137 @@
+"""Checkpoint inspection CLI.
+
+``python -m paddle_tpu.distributed.checkpoint inspect <path> [--json]
+[--chunks]`` — prints the metadata schema version, the saved mesh/layout
+(schema v2), every tensor's global logical shape, and the per-file chunk
+map, WITHOUT loading any tensor data (only the pickled 0.metadata is
+read). `<path>` may be a checkpoint directory or a resilient-commit root
+(the newest COMMITTED step is picked, stragglers untouched).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict
+
+
+def _resolve(path: str) -> str:
+    """Accept either a checkpoint dir (holds 0.metadata) or a commit root
+    (holds step_* dirs)."""
+    if os.path.isfile(os.path.join(path, "0.metadata")):
+        return path
+    from ..resilience.commit import latest_checkpoint
+    latest = latest_checkpoint(path, gc=False)
+    if latest is None:
+        raise SystemExit(f"error: {path!r} holds neither a 0.metadata nor "
+                         f"any committed step_* checkpoint")
+    return latest
+
+
+def describe(path: str) -> Dict[str, Any]:
+    """Structured description of one checkpoint directory (the CLI's
+    --json payload; also used by tests)."""
+    from .load_state_dict import load_metadata
+    from .metadata import LocalTensorIndex
+    md = load_metadata(path)
+    layout = getattr(md, "layout", None)
+    tensors: Dict[str, Any] = {}
+    files: Dict[str, list] = {}
+    for key, chunks in sorted(md.state_dict_metadata.items()):
+        rank = len(chunks[0].global_offset)
+        gshape = tuple(
+            max(c.global_offset[d] + c.local_shape[d] for c in chunks)
+            for d in range(rank))
+        if layout is not None and key in layout.global_shapes:
+            gshape = tuple(layout.global_shapes[key])
+        tensors[key] = {
+            "global_shape": list(gshape),
+            "dtype": chunks[0].dtype,
+            "n_chunks": len(chunks),
+        }
+        if layout is not None and key in layout.specs:
+            tensors[key]["spec"] = [
+                list(e) if isinstance(e, tuple) else e
+                for e in layout.specs[key]]
+            tensors[key]["replication"] = layout.replication.get(key)
+        for c in chunks:
+            fname = md.storage_metadata[LocalTensorIndex(key,
+                                                         c.global_offset)]
+            files.setdefault(fname, []).append(
+                {"key": key, "offset": list(c.global_offset),
+                 "shape": list(c.local_shape)})
+    out: Dict[str, Any] = {
+        "path": path,
+        "schema_version": int(getattr(md, "schema_version", 1)),
+        "n_tensors": len(tensors),
+        "n_chunks": sum(t["n_chunks"] for t in tensors.values()),
+        "n_files": len(files),
+        "misc_keys": sorted(md.misc),
+        "tensors": tensors,
+        "files": files,
+    }
+    if layout is not None:
+        out["layout"] = {
+            "mesh": dict(layout.mesh),
+            "process_count": layout.process_count,
+            "extra": layout.extra,
+        }
+    return out
+
+
+def _print_human(d: Dict[str, Any], chunks: bool) -> None:
+    print(f"checkpoint: {d['path']}")
+    print(f"schema version: {d['schema_version']}"
+          + ("" if d["schema_version"] >= 2 else
+             " (v1: no layout metadata — resumable on any mesh via the "
+             "chunk index, but mesh-mismatch detection and carry remap "
+             "need a FLAGS_ckpt_reshard save)"))
+    lay = d.get("layout")
+    if lay is not None:
+        mesh = " x ".join(f"{a}{n}" for a, n in lay["mesh"].items()) or "-"
+        print(f"saved mesh: {mesh}  (processes: {lay['process_count']})")
+        for k, v in sorted(lay["extra"].items()):
+            print(f"  extra.{k}: {v}")
+    print(f"tensors: {d['n_tensors']}  chunks: {d['n_chunks']}  "
+          f"data files: {d['n_files']}  misc: {d['misc_keys']}")
+    for key, t in d["tensors"].items():
+        spec = ""
+        if "spec" in t:
+            spec = "  spec=" + str(tuple(
+                tuple(e) if isinstance(e, list) else e for e in t["spec"]))
+            spec += f"  repl={t['replication']}"
+        print(f"  {key}: {tuple(t['global_shape'])} {t['dtype']} "
+              f"[{t['n_chunks']} chunk(s)]{spec}")
+    if chunks:
+        for fname, entries in sorted(d["files"].items()):
+            print(f"  file {fname}: {len(entries)} chunk(s)")
+            for e in entries:
+                print(f"    {e['key']} @ {tuple(e['offset'])} "
+                      f"shape {tuple(e['shape'])}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.checkpoint",
+        description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    ins = sub.add_parser("inspect", help="describe a checkpoint's metadata")
+    ins.add_argument("path", help="checkpoint dir or resilient-commit root")
+    ins.add_argument("--json", action="store_true",
+                     help="emit the description as JSON")
+    ins.add_argument("--chunks", action="store_true",
+                     help="also print the per-file chunk map")
+    args = parser.parse_args(argv)
+    d = describe(_resolve(args.path))
+    if args.json:
+        json.dump(d, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        _print_human(d, args.chunks)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

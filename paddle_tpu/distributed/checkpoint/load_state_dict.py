@@ -32,21 +32,37 @@ def load_metadata(path: str) -> Metadata:
 
 
 class _FileCache:
-    """Lazy npz reads; each data file is opened at most once."""
+    """Lazy npz reads; each data file is opened at most once. A tiny LRU of
+    decoded chunk arrays backs repeated reads of the SAME chunk — the
+    reshard path's per-row stacked-block assembly would otherwise decode a
+    multi-MB npz member once per layer row (NpzFile re-decompresses on
+    every __getitem__)."""
+
+    _CACHE_N = 8
 
     def __init__(self, path: str):
         self.path = path
         self._open: Dict[str, np.lib.npyio.NpzFile] = {}
+        self._chunks: "Dict[Tuple[str, str], np.ndarray]" = {}
 
     def chunk(self, fname: str, key: str, offset) -> np.ndarray:
+        name = chunk_name(key, offset)
+        got = self._chunks.get((fname, name))
+        if got is not None:
+            return got
         if fname not in self._open:
             self._open[fname] = np.load(os.path.join(self.path, fname))
-        return self._open[fname][chunk_name(key, offset)]
+        arr = self._open[fname][name]
+        if len(self._chunks) >= self._CACHE_N:
+            self._chunks.pop(next(iter(self._chunks)))
+        self._chunks[(fname, name)] = arr
+        return arr
 
     def close(self):
         for f in self._open.values():
             f.close()
         self._open.clear()
+        self._chunks.clear()
 
 
 def _assemble_region(key: str, offset, shape, dtype, md: Metadata,
@@ -99,18 +115,49 @@ def load_full_state_dict(path: str) -> Dict:
 
 def load_state_dict(state_dict: Dict, path: str,
                     process_mesh=None,
-                    coordinator_rank: int = 0) -> Dict:
+                    coordinator_rank: int = 0,
+                    metadata: Optional[Metadata] = None) -> Dict:
     """Load into the shapes/shardings described by `state_dict` (its values
     are template arrays — their shardings define the target placement).
     Returns the loaded (nested) state dict; dict entries are also replaced
     in place so callers using the reference's mutate-in-place idiom work.
+    `metadata`: pass an already-loaded Metadata to skip re-unpickling it
+    (the resilient driver reads it first for mesh-mismatch detection).
     """
-    md = load_metadata(path)
+    md = metadata if metadata is not None else load_metadata(path)
     files = _FileCache(path)
     try:
         return _load_impl(state_dict, md, files)
     finally:
         files.close()
+
+
+def _assemble_target(key, target, md, files, region_fn=None):
+    """Fill ONE template leaf from the chunk index: jax.Array targets get
+    per-shard regions device_put into the target sharding (replicas share
+    the host buffer); anything else assembles a full-shape numpy array.
+    `region_fn(offset, shape, dtype) -> np.ndarray` overrides the plain
+    region assembler (the reshard path's permuted stacked-block reader)."""
+    if region_fn is None:
+        def region_fn(offset, shape, dtype):
+            return _assemble_region(key, offset, shape, dtype, md, files)
+    if isinstance(target, jax.Array) and hasattr(target, "sharding"):
+        gshape = tuple(target.shape)
+        sharding = target.sharding
+        bufs = []
+        regions = {}  # (offset, shape) -> host buffer; replicas share it
+        for shard in target.addressable_shards:
+            offset, shape = index_to_offset_shape(shard.index, gshape)
+            host = regions.get((offset, shape))
+            if host is None:
+                host = region_fn(offset, shape, np.dtype(target.dtype)
+                                 ).astype(target.dtype)
+                regions[(offset, shape)] = host
+            bufs.append(jax.device_put(host, shard.device))
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, bufs)
+    tgt = np.asarray(target)
+    return region_fn((0,) * tgt.ndim, tuple(tgt.shape), tgt.dtype)
 
 
 def _load_impl(state_dict, md, files):
@@ -124,40 +171,24 @@ def _load_impl(state_dict, md, files):
                 out_flat[key] = md.misc[key]
                 continue
             raise KeyError(f"'{key}' not present in checkpoint {path}")
-        if isinstance(target, jax.Array) and hasattr(target, "sharding"):
-            gshape = tuple(target.shape)
-            sharding = target.sharding
-            bufs = []
-            regions = {}  # (offset, shape) -> host buffer; replicas share it
-            for shard in target.addressable_shards:
-                offset, shape = index_to_offset_shape(shard.index, gshape)
-                host = regions.get((offset, shape))
-                if host is None:
-                    host = _assemble_region(key, offset, shape,
-                                            np.dtype(target.dtype), md, files
-                                            ).astype(target.dtype)
-                    regions[(offset, shape)] = host
-                bufs.append(jax.device_put(host, shard.device))
-            out_flat[key] = jax.make_array_from_single_device_arrays(
-                gshape, sharding, bufs)
-        else:
-            tgt = np.asarray(target)
-            host = _assemble_region(key, (0,) * tgt.ndim, tuple(tgt.shape),
-                                    tgt.dtype, md, files)
-            out_flat[key] = host
+        out_flat[key] = _assemble_target(key, target, md, files)
 
     nested = unflatten_state_dict(out_flat, mapping)
-
-    from ...nn.layer.layers import Parameter
-
-    def _inplace(dst, src):
-        for k, v in src.items():
-            if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                _inplace(dst[k], v)
-            elif isinstance(dst.get(k), Parameter):
-                dst[k].value = v  # keep the Parameter object live
-            else:
-                dst[k] = v
     if isinstance(state_dict, dict):
-        _inplace(state_dict, nested)
+        _inplace_update(state_dict, nested)
     return nested
+
+
+def _inplace_update(dst, src):
+    """Replace template entries in place (shared by load_state_dict and
+    reshard.load_resharded): callers using the reference's
+    mutate-in-place idiom keep their dict — and live Parameter objects
+    keep their identity."""
+    from ...nn.layer.layers import Parameter
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _inplace_update(dst[k], v)
+        elif isinstance(dst.get(k), Parameter):
+            dst[k].value = v  # keep the Parameter object live
+        else:
+            dst[k] = v

@@ -7,12 +7,32 @@ plus one metadata file describing, for every tensor key, which global-offset
 chunks exist and which file holds each chunk. Loading reshards by computing
 chunk↔target-shard overlaps, so the saving and loading parallelism configs
 are independent.
+
+Schema versions:
+
+* **v1** — chunk index only (state_dict_metadata / storage_metadata /
+  flat_mapping / misc). Enough to reshard-on-load when the TARGET
+  template fully describes the destination layout.
+* **v2** — v1 plus a ``SavedLayout``: the SOURCE topology (mesh axis
+  sizes, per-leaf partition specs, global logical shapes, replication
+  factors, zero1 shard dims) and caller-supplied ``extra`` hints (pp/vpp
+  block layout, comm_ef bucket-plan fingerprint, carry policies). This
+  is what lets an elastic restart DETECT a mesh change and remap the
+  non-parameter carries instead of failing (``checkpoint.reshard``).
+
+Compat discipline: v2 fields are dataclass attributes WITH class-level
+defaults, and the writer drops them from the instance ``__dict__`` when no
+layout is recorded — so flags-off pickles are byte-identical to the v1
+format, and v1 pickles load into this class with attribute access falling
+back to the class defaults (``schema_version == 1``, ``layout is None``).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 2  # written when a layout is recorded; plain saves stay v1
 
 
 @dataclass(frozen=True)
@@ -34,6 +54,31 @@ class LocalTensorIndex:
 
 
 @dataclass
+class SavedLayout:
+    """Source-topology description recorded alongside the chunk index
+    (schema v2). Everything here is derived from the arrays' shardings at
+    save time except ``extra``, which carries model-level hints the arrays
+    cannot express (pp/vpp stacked-block layout, comm_ef bucket plan,
+    carry remap policies — see ``models.hybrid_engine`` and
+    ``checkpoint.reshard``)."""
+
+    # mesh axis name -> size (dp/pp/mp/... of the SAVING job)
+    mesh: Dict[str, int] = field(default_factory=dict)
+    # flat key -> partition-spec entries as plain tuples (axis name, None,
+    # or a tuple of axis names per dim) — picklable, no jax objects
+    specs: Dict[str, Tuple] = field(default_factory=dict)
+    # flat key -> global logical shape
+    global_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    # flat key -> how many ranks held a full copy of this leaf
+    replication: Dict[str, int] = field(default_factory=dict)
+    # number of saving processes (files 0_0.distcp .. n-1_0.distcp)
+    process_count: int = 1
+    # caller hints: {"pp": {...}, "comm_plan": {...}, "carries": {...},
+    # "zero1": bool, ...}
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class Metadata:
     # tensor key -> every chunk that exists for it (across all files)
     state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
@@ -44,3 +89,6 @@ class Metadata:
     flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     # non-tensor leaves (python scalars etc.) stored inline
     misc: Dict[str, Any] = field(default_factory=dict)
+    # -- schema v2 (class-attr defaults double as the v1 compat path) -------
+    schema_version: int = 1
+    layout: Optional[SavedLayout] = None

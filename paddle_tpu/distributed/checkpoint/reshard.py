@@ -1,0 +1,304 @@
+"""Elastic reshard-on-load: resume a checkpoint onto a DIFFERENT mesh.
+
+The chunk index (metadata.py) already decouples the saving and loading
+shardings: ``load_state_dict`` assembles any target placement from global
+offsets. What it cannot do alone:
+
+* **detect** that the topology changed — a v2 checkpoint records the
+  saving mesh/specs (``SavedLayout``), so the resilient driver can choose
+  the reshard path instead of tripping over a shape error mid-restart;
+* **permute** stacked-block leaves across (pp, vpp) layouts — vpp > 1
+  stores the ``[L, ...]`` leaves in chunk-major order, so the same disk
+  row is a different global layer under a different layout (the in-memory
+  half of ``pp_adaptor``); the permuted read is done region-by-region
+  while streaming chunks, never materializing a whole leaf;
+* **remap the non-parameter carries** with their owning leaves
+  (``models.hybrid_engine`` threads them as ``opt_state["comm_ef"] /
+  "fp8_meta" / "telemetry"``):
+
+  - ``fp8_meta`` per-layer scale stacks follow the new pp layer
+    assignment exactly like the stacked block params (policy "follow"),
+    and when both sides record ``fp8_amax_ticks`` (the pipelined path
+    sums amax observations over T = M + P - 1 time steps) the carried
+    histories/scales rescale by T_new/T_old so the delayed scales keep
+    their magnitude across a pp-degree change;
+  - ``comm_ef`` error-feedback residuals are LOCAL rounding errors laid
+    out by the bucket plan over local grad shapes — they only transfer
+    when the mesh AND plan are unchanged; otherwise they reset to the
+    template's zeros with an explicit JSONL event (policy
+    "reset_on_mismatch");
+  - ``telemetry`` ring buffers reinitialize (policy "reinit") — they are
+    diagnostics, and their comms-bytes series are defined per topology.
+
+Policies match by path COMPONENT name, so they find the carries wherever
+the train script nests the engine state. The defaults cover the hybrid
+engine; ``SavedLayout.extra["carries"]`` / the loader's ``layout_extra``
+override per component (target side wins).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .load_state_dict import (_assemble_region, _assemble_target, _FileCache,
+                              load_metadata)
+from .metadata import Metadata, SavedLayout
+from .save_state_dict import build_layout
+from .utils import flatten_state_dict, unflatten_state_dict
+
+__all__ = ["layout_mismatch", "load_resharded", "DEFAULT_CARRY_POLICIES"]
+
+logger = logging.getLogger("paddle_tpu")
+
+# path-component name -> remap policy (see module docstring)
+DEFAULT_CARRY_POLICIES: Dict[str, str] = {
+    "comm_ef": "reset_on_mismatch",
+    "telemetry": "reinit",
+    "fp8_meta": "follow",
+}
+
+
+def _emit(event: str, **fields) -> None:
+    from ...observability import emit_event
+    emit_event(event, **fields)
+
+
+_KNOWN_POLICIES = ("follow", "reinit", "reset_on_mismatch")
+
+
+def _carry_policies(saved: Optional[SavedLayout],
+                    layout_extra: Optional[Dict]) -> Dict[str, str]:
+    pol = dict(DEFAULT_CARRY_POLICIES)
+    if saved is not None:
+        pol.update(saved.extra.get("carries", {}))
+    if layout_extra:
+        pol.update(layout_extra.get("carries", {}))
+    for comp, p in pol.items():
+        if p not in _KNOWN_POLICIES:
+            # a typo'd policy must not silently degrade to "transfer
+            # verbatim" — that is exactly the stale-carry corruption the
+            # policies exist to prevent
+            raise ValueError(
+                f"unknown carry policy {p!r} for component {comp!r}; "
+                f"expected one of {_KNOWN_POLICIES}")
+    return pol
+
+
+def _policy_for(mapping_path, policies: Dict[str, str]) -> Optional[str]:
+    for comp in mapping_path:
+        p = policies.get(comp)
+        if p is not None:
+            return p
+    return None
+
+
+def _pp_permutation(saved: Optional[SavedLayout],
+                    layout_extra: Optional[Dict]):
+    """(num_layers, perm, components) — perm maps DST storage row -> SRC
+    storage row, or None when the storage orders coincide (vpp <= 1 both
+    sides, or pp info missing on either side)."""
+    src = (saved.extra.get("pp") if saved is not None else None) or {}
+    dst = (layout_extra or {}).get("pp") or {}
+    if not src or not dst:
+        return None
+    L = int(src.get("num_layers", 0))
+    if L <= 0 or int(dst.get("num_layers", -1)) != L:
+        return None
+    from .pp_adaptor import _relayout_indices
+    idx = _relayout_indices(L, int(src.get("pp", 1)), int(src.get("vpp", 1)),
+                            int(dst.get("pp", 1)), int(dst.get("vpp", 1)))
+    if np.array_equal(idx, np.arange(L)):
+        return None
+    comps = set(src.get("stacked_components", ("blocks",))) | \
+        set(dst.get("stacked_components", ()))
+    return L, idx, comps
+
+
+def layout_mismatch(md: Metadata, state_dict: Dict,
+                    layout_extra: Optional[Dict] = None) -> Optional[Dict]:
+    """Compare a v2 checkpoint's SavedLayout against a target template.
+    Returns a dict of mismatch reasons, or None when a plain
+    ``load_state_dict`` reproduces today's exact semantics (v1 checkpoints
+    always return None — there is nothing recorded to compare)."""
+    saved = getattr(md, "layout", None)
+    if saved is None:
+        return None
+    flat, _ = flatten_state_dict(state_dict)
+    target = build_layout(flat, layout_extra)
+    reasons: Dict[str, Any] = {}
+    if saved.mesh != target.mesh:
+        reasons["mesh"] = {"saved": dict(saved.mesh),
+                           "target": dict(target.mesh)}
+    spec_diff = [k for k, s in target.specs.items()
+                 if k in saved.specs and saved.specs[k] != s]
+    if spec_diff:
+        reasons["specs"] = len(spec_diff)
+    shape_diff = [k for k, s in target.global_shapes.items()
+                  if k in saved.global_shapes and saved.global_shapes[k] != s]
+    if shape_diff:
+        reasons["shapes"] = sorted(shape_diff)[:8]
+    missing = [k for k in target.specs
+               if k not in md.state_dict_metadata and k not in md.misc]
+    if missing:
+        reasons["missing_keys"] = sorted(missing)[:8]
+    src_plan = saved.extra.get("comm_plan")
+    dst_plan = (layout_extra or {}).get("comm_plan")
+    if src_plan != dst_plan and (src_plan or dst_plan):
+        reasons["comm_plan"] = True
+    src_ticks = saved.extra.get("fp8_amax_ticks")
+    dst_ticks = (layout_extra or {}).get("fp8_amax_ticks")
+    if src_ticks and dst_ticks and src_ticks != dst_ticks:
+        # a ticks-only change (e.g. num_microbatches at a fixed mesh)
+        # still needs the reshard path for the amax/scale rescale
+        reasons["fp8_amax_ticks"] = {"saved": src_ticks,
+                                     "target": dst_ticks}
+    if _pp_permutation(saved, layout_extra) is not None:
+        reasons["pp_relayout"] = True
+    if saved.extra.get("zero1") != (layout_extra or {}).get("zero1") and (
+            layout_extra is not None and "zero1" in layout_extra):
+        reasons["zero1"] = {"saved": saved.extra.get("zero1"),
+                            "target": layout_extra.get("zero1")}
+    return reasons or None
+
+
+def _mesh_of_flat(flat: Dict[str, Any]) -> Dict[str, int]:
+    """Mesh axis sizes of the first NamedSharding leaf — the cheap event
+    payload (a full build_layout pass per load just to log a dict would
+    be waste)."""
+    for v in flat.values():
+        mesh = getattr(getattr(v, "sharding", None), "mesh", None)
+        if mesh is not None:
+            return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+    return {}
+
+
+def _permuted_region_fn(key, md, files, perm):
+    """Region assembler reading stacked-block rows through the (pp, vpp)
+    storage permutation: DST row j comes from SRC storage row perm[j].
+    Streams row-by-row so a relayout never materializes a whole leaf."""
+
+    def region_fn(offset, shape, dtype):
+        if not shape:
+            return _assemble_region(key, offset, shape, dtype, md, files)
+        out = np.empty(shape, dtype)
+        for r in range(shape[0]):
+            src_row = int(perm[offset[0] + r])
+            out[r:r + 1] = _assemble_region(
+                key, (src_row,) + tuple(offset[1:]),
+                (1,) + tuple(shape[1:]), dtype, md, files)
+        return out
+    return region_fn
+
+
+def load_resharded(state_dict: Dict, path: str, *,
+                   metadata: Optional[Metadata] = None,
+                   layout_extra: Optional[Dict] = None) -> Dict:
+    """Load a checkpoint into `state_dict`'s shapes/shardings ACROSS a
+    topology change: params and optimizer state reshard from the chunk
+    index (zero1 on↔off included — global offsets make the dp-sharded and
+    replicated forms interchangeable), stacked-block leaves are permuted
+    across (pp, vpp) layouts, and the non-param carries follow their remap
+    policies (module docstring). Mutates `state_dict` in place like
+    ``load_state_dict`` and returns the loaded nested dict.
+
+    `layout_extra` describes the TARGET side (pp layout, comm_plan,
+    zero1, carries) — the hybrid engine attaches it to the init_state it
+    returns (``init_state.layout_extra``)."""
+    md = metadata if metadata is not None else load_metadata(path)
+    saved = getattr(md, "layout", None)
+    policies = _carry_policies(saved, layout_extra)
+    pp_perm = _pp_permutation(saved, layout_extra)
+    src_ticks = (saved.extra.get("fp8_amax_ticks")
+                 if saved is not None else None)
+    dst_ticks = (layout_extra or {}).get("fp8_amax_ticks")
+    amax_ratio = None
+    if src_ticks and dst_ticks and src_ticks != dst_ticks:
+        amax_ratio = float(dst_ticks) / float(src_ticks)
+    flat, mapping = flatten_state_dict(state_dict)
+    tgt_mesh = _mesh_of_flat(flat)
+    # reset_on_mismatch contract: residuals are LOCAL rounding errors —
+    # they only transfer when the mesh AND the plan are unchanged. A mesh
+    # regroup (same device count, different axes) or a (pp, vpp) relayout
+    # reassigns layers/shards to ranks without necessarily changing the
+    # plan fingerprint or any global shape, so check them explicitly.
+    mesh_changed = saved is None or dict(saved.mesh) != tgt_mesh
+    _emit("ckpt_reshard_begin", path=path,
+          saved_mesh=dict(saved.mesh) if saved is not None else None,
+          target_mesh=tgt_mesh,
+          pp_relayout=pp_perm is not None)
+
+    files = _FileCache(path)
+    out_flat: Dict[str, object] = {}
+    try:
+        for key, target in flat.items():
+            policy = _policy_for(mapping[key], policies)
+            in_ckpt = key in md.state_dict_metadata
+            if policy == "reinit":
+                # diagnostics buffers restart fresh on the new topology
+                out_flat[key] = target
+                _emit("ckpt_carry_reinit", key=key)
+                continue
+            if policy == "reset_on_mismatch":
+                saved_shape = (saved.global_shapes.get(key)
+                               if saved is not None else None)
+                tgt_shape = tuple(getattr(target, "shape", ()))
+                plan_changed = (saved is None or saved.extra.get("comm_plan")
+                                != (layout_extra or {}).get("comm_plan"))
+                if (not in_ckpt or plan_changed or mesh_changed
+                        or pp_perm is not None
+                        or (saved_shape is not None
+                            and saved_shape != tgt_shape)):
+                    reason = ("missing" if not in_ckpt else
+                              "plan_changed" if plan_changed else
+                              "mesh_changed" if mesh_changed else
+                              "pp_relayout" if pp_perm is not None else
+                              "shape_mismatch")
+                    logger.warning(
+                        "elastic reshard: resetting carry %r (%s)", key,
+                        reason)
+                    _emit("ckpt_carry_reset", key=key, reason=reason)
+                    out_flat[key] = target
+                    continue
+            if not in_ckpt:
+                if key in md.misc:
+                    out_flat[key] = md.misc[key]
+                    continue
+                if policy is not None:
+                    # a carry the checkpoint never had (e.g. fp8 enabled
+                    # at resume): keep the template's fresh state
+                    _emit("ckpt_carry_reset", key=key, reason="missing")
+                    out_flat[key] = target
+                    continue
+                raise KeyError(
+                    f"'{key}' not present in checkpoint {path} and no "
+                    f"carry policy covers it")
+            region_fn = None
+            if pp_perm is not None:
+                L, perm, comps = pp_perm
+                if (any(c in mapping[key] for c in comps)
+                        and getattr(target, "ndim", 0) >= 1
+                        and target.shape[0] == L):
+                    region_fn = _permuted_region_fn(key, md, files, perm)
+            if amax_ratio is not None and "fp8_meta" in mapping[key]:
+                # amax observations sum over the pipeline's time steps:
+                # rescale histories AND the scales derived from them so a
+                # pp-degree change keeps the quantization grids aligned
+                inner = region_fn or (
+                    lambda off, shp, dt: _assemble_region(key, off, shp,
+                                                          dt, md, files))
+                region_fn = (lambda off, shp, dt, _f=inner:
+                             (_f(off, shp, dt) * amax_ratio).astype(dt))
+                _emit("ckpt_fp8_amax_rescale", key=key, ratio=amax_ratio)
+            out_flat[key] = _assemble_target(key, target, md, files,
+                                             region_fn=region_fn)
+    finally:
+        files.close()
+
+    nested = unflatten_state_dict(out_flat, mapping)
+    from .load_state_dict import _inplace_update
+    if isinstance(state_dict, dict):
+        _inplace_update(state_dict, nested)
+    return nested

@@ -22,11 +22,12 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .metadata import (SCHEMA_VERSION, LocalTensorIndex, LocalTensorMetadata,
+                       Metadata, SavedLayout)
 from .utils import (atomic_write, chunk_name, flatten_state_dict,
                     shard_chunks, to_host)
 
-__all__ = ["save_state_dict", "wait_async_save"]
+__all__ = ["save_state_dict", "wait_async_save", "build_layout"]
 
 _PENDING: List[threading.Thread] = []
 _SEM: list = [None, 0]
@@ -116,6 +117,63 @@ def _store_gather_commit(meta_store, tag, proc, nproc, coordinator_rank,
         meta_store.get(f"{tag}/commit")  # blocks until committed
 
 
+def _spec_entries(spec) -> tuple:
+    """PartitionSpec -> plain picklable tuple (str | None | tuple[str])."""
+    out = []
+    for e in spec:
+        out.append(tuple(e) if isinstance(e, (tuple, list)) else e)
+    return tuple(out)
+
+
+def _leaf_layout(value):
+    """(mesh_dict, spec_entries, replication) for one array leaf, derived
+    from its sharding. NamedSharding gives the exact mesh/spec; anything
+    else records a replicated spec with a best-effort replica count."""
+    sharding = getattr(value, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is not None and hasattr(sharding, "spec"):
+        axes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+        spec = _spec_entries(sharding.spec)
+        used = set()
+        for e in spec:
+            for a in (e if isinstance(e, tuple) else (e,)):
+                if a is not None:
+                    used.add(a)
+        repl = 1
+        for a, n in axes.items():
+            if a not in used:
+                repl *= n
+        return axes, spec, repl
+    ndim = getattr(value, "ndim", 0)
+    if isinstance(value, jax.Array):
+        repl = 1 + max((s.replica_id for s in value.addressable_shards),
+                       default=0)
+    else:
+        repl = 1
+    return {}, (None,) * ndim, repl
+
+
+def build_layout(flat: Dict[str, Any], extra: Optional[Dict] = None
+                 ) -> SavedLayout:
+    """Derive the SavedLayout of a FLATTENED state dict from its arrays'
+    shardings. `extra` carries the model-level hints shardings cannot
+    express (pp/vpp stacked-block layout, comm_ef plan, carry policies —
+    producers: models.hybrid_engine init_state.layout_extra)."""
+    lay = SavedLayout(process_count=int(jax.process_count()),
+                      extra=dict(extra or {}))
+    for key, value in flat.items():
+        if not isinstance(value, (jax.Array, np.ndarray)) and not hasattr(
+                value, "addressable_shards"):
+            continue
+        axes, spec, repl = _leaf_layout(value)
+        for a, n in axes.items():
+            lay.mesh.setdefault(a, n)
+        lay.specs[key] = spec
+        lay.global_shapes[key] = tuple(int(d) for d in value.shape)
+        lay.replication[key] = int(repl)
+    return lay
+
+
 _SAVE_SEQ = [0]  # per-process save counter; equal across processes because
 #                  every process calls save_state_dict the same number of
 #                  times — used to namespace store keys per save
@@ -143,7 +201,9 @@ def save_state_dict(state_dict: Dict, path: str,
                                         # shardings are carried by the arrays
                     coordinator_rank: int = 0,
                     async_save: bool = False,
-                    store=None) -> None:
+                    store=None,
+                    layout: object = "auto",
+                    layout_extra: Optional[Dict] = None) -> None:
     """Save a (possibly nested) state dict of sharded jax.Arrays.
 
     Every process writes only the shards it owns (replica 0), so the on-disk
@@ -157,9 +217,19 @@ def save_state_dict(state_dict: Dict, path: str,
     barrier stay OFF the jax device runtime (reference:
     save_state_dict.py:291 async via side process) — with no store it falls
     back to a synchronous save with a warning, never silently.
+
+    layout: "auto" (record the SavedLayout topology metadata — schema v2 —
+    iff FLAGS_ckpt_reshard is on) / True / False-or-None. With no layout
+    the metadata pickle stays byte-identical to the v1 format.
+    layout_extra: model-level hints stored in SavedLayout.extra (pp/vpp
+    block layout, comm_ef plan fingerprint, carry policies).
     """
+    if layout == "auto":
+        from ...flags import flag
+        layout = bool(flag("ckpt_reshard"))
     os.makedirs(path, exist_ok=True)
     flat, mapping = flatten_state_dict(state_dict)
+    saved_layout = build_layout(flat, layout_extra) if layout else None
 
     proc = jax.process_index()
     data_file = f"{proc}_0.distcp"
@@ -190,6 +260,15 @@ def save_state_dict(state_dict: Dict, path: str,
     def _write_metadata(all_meta):
         from ..resilience import faults
         md = Metadata(flat_mapping=mapping, misc=misc)
+        if saved_layout is not None:
+            md.schema_version = SCHEMA_VERSION
+            md.layout = saved_layout
+        else:
+            # v1 byte-compat: drop the v2 fields from the instance dict so
+            # the pickle is byte-identical to pre-layout checkpoints
+            # (attribute access falls back to the class defaults)
+            md.__dict__.pop("schema_version", None)
+            md.__dict__.pop("layout", None)
         for rank_meta in all_meta:
             for key, entries in rank_meta.items():
                 lst = md.state_dict_metadata.setdefault(key, [])
@@ -210,6 +289,10 @@ def save_state_dict(state_dict: Dict, path: str,
         from ..resilience import faults
         with atomic_write(os.path.join(path, data_file)) as f:
             np.savez(f, **chunks)  # file handle keeps our .distcp name
+        # torn-chunk site: simulates a storage-layer lie (fsync acked,
+        # bytes not durable) by truncating the landed file before dying
+        faults.maybe_corrupt_file("ckpt/torn_chunk",
+                                  os.path.join(path, data_file))
         faults.maybe_fail("ckpt/after_chunk_write")
         if meta_store is not None:
             _store_gather_commit(meta_store, tag, proc, jax.process_count(),

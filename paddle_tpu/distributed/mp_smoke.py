@@ -23,7 +23,8 @@ import sys
 
 import numpy as np
 
-__all__ = ["run_training", "spawn_cluster", "spawn_and_check", "main",
+__all__ = ["run_training", "run_training_resilient", "spawn_cluster",
+           "spawn_and_check", "elastic_restart_check", "main",
            "ClusterUnsupported"]
 
 
@@ -57,12 +58,18 @@ def _repo_root():
 
 
 def spawn_cluster(argv, nproc: int, devices_per_proc: int,
-                  sentinel: str, extra_env=None, timeout: float = 300.0):
+                  sentinel: str, extra_env=None, timeout: float = 300.0,
+                  ok_returncodes=(0,)):
     """Spawn `nproc` jax worker processes of `argv` (2-process rendezvous on
     a fresh port, `devices_per_proc` virtual CPU devices each), wait, and
     return the JSON payload following `sentinel` on each worker's stdout —
     the shared launcher half of the reference subprocess-spawn pattern
-    (test_dist_base.py:1206 _run_cluster)."""
+    (test_dist_base.py:1206 _run_cluster).
+
+    ok_returncodes: exit codes that count as success — the elastic leg
+    EXPECTS its workers to die with faults.FAULT_EXIT_CODE mid-run.
+    Workers that died on purpose print no sentinel; their slot in the
+    returned list is None."""
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -96,7 +103,7 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
             if p.poll() is None:
                 p.kill()
     for p, out in zip(procs, outs):
-        if p.returncode != 0:
+        if p.returncode not in ok_returncodes:
             for marker in _UNSUPPORTED_MARKERS:
                 if marker in out:
                     raise ClusterUnsupported(
@@ -106,8 +113,10 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
                                f"{out[-4000:]}")
     results = []
     for out in outs:
-        line = next(l for l in out.splitlines() if l.startswith(sentinel))
-        results.append(json.loads(line[len(sentinel):]))
+        line = next((l for l in out.splitlines()
+                     if l.startswith(sentinel)), None)
+        results.append(None if line is None
+                       else json.loads(line[len(sentinel):]))
     return results
 
 
@@ -146,6 +155,107 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
                                    jnp.float32(1e-2))
         losses.append(float(jax.device_get(loss)))
     return (losses, params) if return_params else losses
+
+
+def run_training_resilient(mesh, steps: int, ckpt_dir: str):
+    """The SAME seed-deterministic tiny-GPT workload as `run_training`,
+    driven through the resilient runner with a per-step crash-safe commit
+    and elastic layout metadata (FLAGS_ckpt_reshard) — the one copy of the
+    elastic-restart workload shared by the 2-process workers, the
+    single-process resume and the golden run. Returns (losses, info):
+    losses keyed by global step (a resumed run only reports the steps it
+    actually executed)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as G
+    from .resilience import run_resilient
+
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step, shard_params, init_state = G.build_hybrid_train_step(
+        cfg, mesh, opt)
+    params = shard_params(params)
+    state = {"params": params, "opt": init_state(params)}
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+
+    def step_fn(st, i):
+        del i
+        p, s, loss = step(st["params"], st["opt"], tokens, labels,
+                          jnp.float32(1e-2))
+        return {"params": p, "opt": s}, loss
+
+    losses = {}
+    _, info = run_resilient(step_fn, state, steps=steps, ckpt_dir=ckpt_dir,
+                            ckpt_every=1,
+                            layout_extra=init_state.layout_extra,
+                            on_step=lambda i, l: losses.__setitem__(i, l))
+    return losses, info
+
+
+def elastic_restart_check(n_devices: int, ckpt_dir: str, devices=None,
+                          timeout: float = 300.0, steps: int = 6,
+                          kill_at: int = 3) -> dict:
+    """Elastic-restart leg: a 2-process dp2 x mp(n/2) cluster trains with
+    per-step commits and is HARD-KILLED (fault injection, exit 41) before
+    step `kill_at`+1; the launcher then resumes the run on a 1-process
+    dp1 x mp(n/2) mesh — half the chips, the preemption-shrink shape —
+    where the resilient driver detects the recorded mesh mismatch and
+    reshards on load. The full trajectory must match an uninterrupted
+    single-process mesh-B golden (same 5e-5 budget as the other
+    cross-process parity legs). Returns a summary dict for the dryrun
+    record."""
+    import jax
+    import paddle_tpu as paddle
+    from .topology import build_mesh
+    from .resilience import faults
+    from .resilience.commit import checkpoint_step, latest_checkpoint
+
+    assert n_devices % 2 == 0 and n_devices >= 4, n_devices
+    devices = devices if devices is not None else jax.devices()
+    mesh_b = build_mesh({"dp": 1, "pp": 1, "mp": n_devices // 2},
+                        devices=devices[:n_devices // 2])
+    golden_dir = ckpt_dir + ".golden"
+    old = paddle.get_flags(["FLAGS_ckpt_reshard"])
+    paddle.set_flags({"FLAGS_ckpt_reshard": True})
+    try:
+        golden, _ = run_training_resilient(mesh_b, steps, golden_dir)
+        # phase 1: 2-process mesh A (dp across the process boundary),
+        # killed by injection entering step kill_at (commits 1..kill_at)
+        spawn_cluster(
+            [sys.executable, "-m", "paddle_tpu.distributed.mp_smoke"],
+            nproc=2, devices_per_proc=n_devices // 2, sentinel="MPSMOKE ",
+            timeout=timeout,
+            ok_returncodes=(faults.FAULT_EXIT_CODE,),
+            extra_env={"MPSMOKE_MODE": "elastic",
+                       "MPSMOKE_CKPT": ckpt_dir,
+                       "FLAGS_ckpt_reshard": "1",
+                       "FLAGS_fault_inject":
+                           f"loop/before_step:{kill_at + 1}:kill"})
+        ck = latest_checkpoint(ckpt_dir, gc=False)
+        assert ck is not None and checkpoint_step(ck) == kill_at, ck
+        # phase 2: resume on the shrunken 1-process mesh B
+        resumed, info = run_training_resilient(mesh_b, steps, ckpt_dir)
+        assert info["resumed_from"] == ck, info
+        assert info.get("resharded") is True, info
+        assert sorted(resumed) == list(range(kill_at, steps)), resumed
+        for i, l in resumed.items():
+            if abs(l - golden[i]) > 5e-5:
+                raise AssertionError(
+                    f"elastic resume loss diverged at step {i}: {l} vs "
+                    f"golden {golden[i]}")
+        return {"killed_at_step": kill_at, "resumed_steps": len(resumed),
+                "resharded": True,
+                "max_loss_diff": max(abs(resumed[i] - golden[i])
+                                     for i in resumed)}
+    finally:
+        paddle.set_flags(old)
+        import shutil
+        shutil.rmtree(golden_dir, ignore_errors=True)
 
 
 # "dpmp" is the hybrid
@@ -212,8 +322,22 @@ def main():
     import jax
 
     mode = os.environ.get("MPSMOKE_MODE", "dpmp")
-    dims_of, M, schedule, zero1 = _MODES[mode]
     n = len(jax.devices())
+    if mode == "elastic":
+        # elastic-restart worker: dp spans the two processes, per-step
+        # crash-safe commits with layout metadata; the launcher arms
+        # loop/before_step:N:kill so both ranks die mid-run and later
+        # resumes the checkpoint on a 1-process mesh
+        mesh = build_mesh({"dp": 2, "pp": 1, "mp": n // 2})
+        losses, info = run_training_resilient(
+            mesh, steps=int(os.environ.get("MPSMOKE_STEPS", "6")),
+            ckpt_dir=os.environ["MPSMOKE_CKPT"])
+        print("MPSMOKE " + json.dumps(
+            {"rank": jax.process_index(), "mode": mode,
+             "losses": {str(k): v for k, v in losses.items()},
+             "resumed_from": info["resumed_from"]}), flush=True)
+        return
+    dims_of, M, schedule, zero1 = _MODES[mode]
     mesh = build_mesh(dims_of(n))
     if mode == "sepring":
         # the sep ring must CROSS the process boundary somewhere: count

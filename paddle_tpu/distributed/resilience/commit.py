@@ -28,6 +28,7 @@ half-checkpoint with a plausible-looking layout.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import shutil
@@ -37,6 +38,13 @@ import jax
 
 __all__ = ["commit_checkpoint", "latest_checkpoint", "checkpoint_step",
            "is_committed", "COMMIT_MARKER"]
+
+logger = logging.getLogger("paddle_tpu")
+
+
+def _emit(event: str, **fields) -> None:
+    from ...observability import emit_event
+    emit_event(event, **fields)
 
 COMMIT_MARKER = "COMMITTED"
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -117,7 +125,8 @@ def commit_checkpoint(state_dict: Dict, root: str, step: int, *,
                       store=None, coordinator_rank: int = 0,
                       async_save: bool = False,
                       keep_n: Optional[int] = None,
-                      barrier_timeout: Optional[float] = None) -> str:
+                      barrier_timeout: Optional[float] = None,
+                      layout_extra: Optional[Dict] = None) -> str:
     """Atomically commit `state_dict` as checkpoint `step` under `root`.
 
     Returns the final committed directory. Idempotent: recommitting an
@@ -125,6 +134,10 @@ def commit_checkpoint(state_dict: Dict, root: str, step: int, *,
     path may race a cadence checkpoint at the same boundary). Synchronous
     at the commit point even with async_save=True — the rename only happens
     once every byte is on disk.
+
+    layout_extra: model-level topology hints (pp/vpp layout, comm plan,
+    carry policies) recorded into the schema-v2 SavedLayout when
+    FLAGS_ckpt_reshard is on — what elastic resume reshards by.
     """
     from ..checkpoint import save_state_dict, wait_async_save
     from . import faults
@@ -152,7 +165,8 @@ def commit_checkpoint(state_dict: Dict, root: str, step: int, *,
     _barrier(store, nproc, coordinator_rank, f"{tag}/clean", barrier_timeout)
 
     save_state_dict(state_dict, staging, coordinator_rank=coordinator_rank,
-                    async_save=async_save, store=store)
+                    async_save=async_save, store=store,
+                    layout_extra=layout_extra)
     if async_save:
         wait_async_save()
     faults.maybe_fail("ckpt/before_commit")
@@ -179,35 +193,92 @@ def commit_checkpoint(state_dict: Dict, root: str, step: int, *,
     return final
 
 
-def latest_checkpoint(root: str, *, gc: bool = True) -> Optional[str]:
-    """Newest COMMITTED checkpoint directory under `root`, or None.
+def _loadable(path: str):
+    """Cheap integrity check of a COMMITTED checkpoint dir before handing
+    it to a resuming job: the metadata must unpickle and every data file
+    it references must exist non-empty. Returns ``(failure_reason, md)``
+    — reason None when the directory looks loadable, with the decoded
+    Metadata riding along so the caller never pays the (MB-scale for 1B
+    checkpoints) unpickle twice. Deliberately does NOT read tensor bytes
+    — discovery must stay O(metadata)."""
+    try:
+        from ..checkpoint import load_metadata
+        md = load_metadata(path)
+    except FileNotFoundError:
+        return "missing 0.metadata", None
+    except Exception as e:  # truncated/corrupt pickle, foreign bytes, ...
+        return f"unreadable metadata ({type(e).__name__}: {e})", None
+    for fname in set(md.storage_metadata.values()):
+        f = os.path.join(path, fname)
+        if not os.path.isfile(f):
+            return f"missing data file {fname}", None
+        if os.path.getsize(f) == 0:
+            return f"empty data file {fname}", None
+    return None, md
+
+
+def latest_checkpoint(root: str, *, gc: bool = True,
+                      validate: bool = True,
+                      with_metadata: bool = False):
+    """Newest loadable COMMITTED checkpoint directory under `root`, or None.
 
     With gc=True (the restart path — any in-flight writer is dead by
     definition), uncommitted stragglers are deleted: ``*.tmp`` staging dirs
     and ``step_*`` dirs missing the COMMITTED marker. Pass gc=False to
     inspect a directory a live job may still be writing to.
-    """
+
+    Hardened against a dirty checkpoint root: FOREIGN entries (dirs/files
+    whose names are not step_N or step_N.tmp) are skipped with a warning —
+    never deleted, they are not ours; a committed dir whose metadata is
+    unreadable or whose referenced data files are missing is skipped with
+    a warning event and discovery FALLS BACK to the previous committed
+    step instead of handing a resuming job an unloadable directory
+    (validate=False restores the pure marker check).
+
+    with_metadata=True returns ``(path, md)`` instead — the Metadata the
+    validation already decoded (None when validate=False or nothing is
+    committed), so the resume path never unpickles it a second time."""
     if not os.path.isdir(root):
-        return None
+        return (None, None) if with_metadata else None
     committed = []
     stragglers = []
+    foreign = []
     for name in os.listdir(root):
         path = os.path.join(root, name)
         if not os.path.isdir(path):
+            foreign.append(name)
             continue
-        if name.endswith(".tmp"):
+        if name.endswith(".tmp") and _STEP_RE.match(name[:-len(".tmp")]):
             stragglers.append(path)
             continue
         m = _STEP_RE.match(name)
         if not m:
+            foreign.append(name)
             continue
         if is_committed(path):
             committed.append((int(m.group(1)), path))
         else:
             stragglers.append(path)
+    if foreign:
+        logger.warning(
+            "checkpoint root %s holds %d foreign entrie(s) %s — skipped, "
+            "not garbage-collected (only step_N/step_N.tmp dirs are ours)",
+            root, len(foreign), sorted(foreign)[:8])
+        _emit("ckpt_root_foreign_entries", root=root,
+              entries=sorted(foreign)[:8], count=len(foreign))
     if gc and jax.process_index() == 0:
         for path in stragglers:
             shutil.rmtree(path, ignore_errors=True)
-    if not committed:
-        return None
-    return max(committed)[1]
+    for _step, path in sorted(committed, reverse=True):
+        md = None
+        if validate:
+            reason, md = _loadable(path)
+            if reason is not None:
+                logger.warning(
+                    "committed checkpoint %s is not loadable (%s) — "
+                    "falling back to the previous committed step",
+                    path, reason)
+                _emit("ckpt_unloadable_skipped", path=path, reason=reason)
+                continue
+        return (path, md) if with_metadata else path
+    return (None, None) if with_metadata else None

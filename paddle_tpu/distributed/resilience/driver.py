@@ -126,6 +126,7 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                   grace_s: Optional[float] = None,
                   keep_n: Optional[int] = None,
                   resume: bool = True,
+                  layout_extra: Optional[Dict[str, Any]] = None,
                   on_step: Optional[Callable[[int, Optional[float]], None]]
                   = None) -> Tuple[Dict, Dict[str, Any]]:
     """Drive ``step_fn(state, step) -> (new_state, loss)`` for ``steps``
@@ -133,6 +134,19 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
     ``(final_state, info)``; info records resume/preemption/watchdog
     details. `state` must be a (nested) dict of arrays/scalars — the same
     contract as ``save_state_dict``.
+
+    Elastic resume (FLAGS_ckpt_reshard): commits record the topology
+    layout (schema v2), and resume compares it against THIS run's `state`
+    template — whose arrays' shardings describe the new mesh. On a
+    mismatch (mesh shape, partition specs, zero1 on<->off, pp/vpp
+    relayout, changed comm plan) the checkpoint is RESHARDED onto the new
+    topology instead of failing: params/optimizer state reassemble from
+    the chunk index, stacked-block leaves permute across (pp, vpp)
+    layouts, and the engine carries follow their remap policies
+    (fp8_meta follows its layers, comm_ef resets with a JSONL event when
+    the plan changed, telemetry reinitializes). `layout_extra` carries
+    the model-level hints both ends need (the hybrid engine attaches the
+    dict to the init_state it returns: ``init_state.layout_extra``).
     """
     from ...flags import flag
     from . import faults
@@ -146,10 +160,8 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
         """Crash-forensics JSONL (observability.events): every lifecycle
         decision the loop takes — resume/skip/commit/SIGTERM/abort — lands
         as one flushed line when FLAGS_telemetry_jsonl is set."""
-        from ...observability import get_event_log
-        log = get_event_log()
-        if log is not None:
-            log.emit(event, **fields)
+        from ...observability import emit_event
+        emit_event(event, **fields)
 
     wd = watchdog or CommWatchdog(poll_interval=0.2)
     own_wd = watchdog is None
@@ -174,24 +186,44 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                             "final_checkpoint": None}
     start_step = 0
     if resume:
-        ckpt = latest_checkpoint(ckpt_dir)
+        # with_metadata: discovery's integrity validation already decoded
+        # the metadata — reuse it instead of unpickling a second time
+        ckpt, md = latest_checkpoint(ckpt_dir, with_metadata=True)
         if ckpt is not None:
-            from ..checkpoint import load_state_dict
+            from ..checkpoint import (layout_mismatch, load_metadata,
+                                      load_resharded, load_state_dict)
             # the template is mutated in place, which keeps structure-only
             # subtrees (empty dicts) that the returned nested dict drops
             template = {"step": 0, "state": state}
-            loaded = load_state_dict(template, ckpt)
+            mismatch = None
+            if flag("ckpt_reshard"):
+                if md is None:
+                    md = load_metadata(ckpt)
+                mismatch = layout_mismatch(md, template,
+                                           layout_extra=layout_extra)
+                if mismatch:
+                    # topology changed since the commit: reshard instead
+                    # of tripping over a carry shape error mid-restart
+                    _emit("resilience_reshard_resume", checkpoint=ckpt,
+                          mismatch={k: v for k, v in mismatch.items()})
+                    loaded = load_resharded(template, ckpt, metadata=md,
+                                            layout_extra=layout_extra)
+            if not mismatch:
+                loaded = load_state_dict(template, ckpt, metadata=md)
             state, start_step = template["state"], int(loaded["step"])
             info["resumed_from"] = ckpt
+            info["resharded"] = bool(mismatch)
             assert start_step == checkpoint_step(ckpt)
-            _emit("resilience_resume", checkpoint=ckpt, step=start_step)
+            _emit("resilience_resume", checkpoint=ckpt, step=start_step,
+                  resharded=bool(mismatch))
     _emit("resilience_run_start", steps=steps, start_step=start_step,
           ckpt_dir=ckpt_dir, ckpt_every=ckpt_every)
 
     def _commit(next_step, **kw):
         path = commit_checkpoint({"step": next_step, "state": state},
                                  ckpt_dir, next_step, store=store,
-                                 keep_n=keep_n, **kw)
+                                 keep_n=keep_n, layout_extra=layout_extra,
+                                 **kw)
         info["final_checkpoint"] = path
         _emit("resilience_commit", step=next_step, path=path)
         return path

@@ -18,8 +18,13 @@ Spec grammar (``FLAGS_fault_inject``, comma-separated clauses)::
                         replays the identical failure schedule
     site:p0.25:kill     probabilistic hard-exit
 
-Sites currently planted (grep for ``maybe_fail`` to enumerate):
+Sites currently planted (grep for ``maybe_fail`` /
+``maybe_corrupt_file`` to enumerate):
 
+* ``ckpt/torn_chunk``         — TEARS the just-landed .distcp file
+  (truncates it to half) before dying: simulates a storage layer that
+  acked the fsync but lost the tail — the mid-save case atomic_write
+  alone cannot model (``maybe_corrupt_file``)
 * ``ckpt/after_chunk_write``  — data file durable, metadata not yet written
 * ``ckpt/before_metadata_write`` — before the atomic 0.metadata replace
 * ``ckpt/before_commit``      — staging dir complete, not yet renamed
@@ -37,8 +42,8 @@ import threading
 import zlib
 from typing import Dict, Optional
 
-__all__ = ["FaultInjected", "maybe_fail", "configure", "reset", "hits",
-           "FAULT_EXIT_CODE"]
+__all__ = ["FaultInjected", "maybe_fail", "maybe_corrupt_file", "configure",
+           "reset", "hits", "FAULT_EXIT_CODE"]
 
 FAULT_EXIT_CODE = 41  # distinguishable from python crashes (1) / signals
 
@@ -126,6 +131,25 @@ def _site_rng(site: str) -> random.Random:
     return random.Random((zlib.crc32(site.encode()) << 32) ^ seed)
 
 
+def maybe_corrupt_file(site: str, path: str, exc=FaultInjected) -> None:
+    """Torn-write injection point: like ``maybe_fail`` but, on the
+    scheduled hit, first TRUNCATES `path` to half its bytes — the file is
+    left torn on disk exactly as a lying storage layer would, then the
+    clause's failure mode (raise / hard-exit) fires. Disarmed: one
+    comparison, the file is never touched."""
+    if _ENABLED is None:
+        from ...flags import flag
+        configure(flag("fault_inject"))
+    if not _ENABLED:
+        return
+
+    def tear():
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size // 2)
+    _fire(site, exc, before=tear)
+
+
 def maybe_fail(site: str, exc=FaultInjected) -> None:
     """Injection point. No-op (one comparison) unless FLAGS_fault_inject
     arms `site`; then raises `exc` or hard-exits on the scheduled hit."""
@@ -134,6 +158,10 @@ def maybe_fail(site: str, exc=FaultInjected) -> None:
         configure(flag("fault_inject"))
     if not _ENABLED:
         return
+    _fire(site, exc)
+
+
+def _fire(site: str, exc, before=None) -> None:
     with _LOCK:
         n = _COUNTS.get(site, 0) + 1
         _COUNTS[site] = n
@@ -150,6 +178,8 @@ def maybe_fail(site: str, exc=FaultInjected) -> None:
         kill = cl.kill
     if not fire:
         return
+    if before is not None:
+        before()  # e.g. tear the file THEN die, like real torn storage
     if kill:
         os._exit(FAULT_EXIT_CODE)  # crash without cleanup: no atexit drain,
         #                            no buffered IO flush — a real SIGKILL

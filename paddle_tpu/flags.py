@@ -322,6 +322,18 @@ define_flag("store_retry_base_s", 0.05,
 define_flag("store_retry_max_s", 2.0,
             "Ceiling on the store retry backoff delay (consumed by "
             "distributed.store).")
+define_flag("ckpt_reshard", False,
+            "Elastic-scale resilience: record topology layout metadata "
+            "(schema v2 — saving mesh, per-leaf partition specs, global "
+            "shapes, zero1/pp/carry hints) with every distributed "
+            "checkpoint, and let the resilient driver detect a mesh "
+            "mismatch on resume and reshard-on-load onto the new mesh "
+            "(params/optimizer state reassembled from the chunk index, "
+            "stacked blocks permuted across (pp, vpp) layouts, comm_ef/"
+            "telemetry carries remapped per policy). Off (default): the "
+            "save/load path and the on-disk metadata bytes are identical "
+            "to the pre-elastic format (consumed by "
+            "checkpoint.save_state_dict and resilience.run_resilient).")
 define_flag("fault_inject_seed", 0,
             "Seed for probabilistic fault-injection clauses ('site:p0.25'):"
             " identical seed + spec replays the identical failure schedule "

@@ -774,6 +774,22 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
         comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry,
         mp_overlap=sp)
+    # elastic-checkpoint hint (checkpoint.reshard): the stacked-[L] block
+    # leaves' STORAGE order is (pp, vpp)-dependent under the interleaved
+    # schedule; resume onto a different layout permutes them (fp8_meta's
+    # per-layer scale stacks follow the same assignment)
+    init_state.layout_extra["pp"] = {
+        "num_layers": int(cfg.num_layers), "pp": int(mesh.shape[pp_axis]),
+        "vpp": int(virtual_pp),
+        "stacked_components": ["blocks", "fp8_meta"],
+    }
+    if fp8_plan is not None:
+        # pipelined amax observations sum over T = M + P - 1 time steps
+        # (test_fp8 asserts exact T x dense); a resume onto a different pp
+        # degree rescales the carried histories by T_new/T_old so the
+        # delayed scales keep their magnitude (checkpoint.reshard)
+        init_state.layout_extra["fp8_amax_ticks"] = (
+            num_microbatches + int(mesh.shape[pp_axis]) - 1)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

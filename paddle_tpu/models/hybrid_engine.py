@@ -395,6 +395,26 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
             params, specs)
 
+    # Elastic-checkpoint hints (checkpoint.reshard): everything about this
+    # build's topology that the saved arrays' shardings cannot express —
+    # which carries ride the state and how to remap them on a mesh change,
+    # the comm_ef bucket-plan fingerprint (residuals are LOCAL rounding
+    # errors; a changed plan resets them with a JSONL event), zero1
+    # on/off. Models add the "pp" stacked-block layout on top. Thread it
+    # to run_resilient(layout_extra=init_state.layout_extra) /
+    # commit_checkpoint so both the save and the resumed template agree.
+    layout_extra: Dict[str, Any] = {"zero1": bool(zero1_dp), "carries": {}}
+    if ef_plan is not None:
+        layout_extra["carries"]["comm_ef"] = "reset_on_mismatch"
+        layout_extra["comm_plan"] = {
+            "n_dev": int(mesh.devices.size),
+            "buckets": [int(b.size) for b in ef_plan.buckets],
+        }
+    if fp8_plan is not None:
+        layout_extra["carries"]["fp8_meta"] = "follow"
+    if tcfg is not None:
+        layout_extra["carries"]["telemetry"] = "reinit"
+
     def init_state(params):
         # zeros_like under jit preserves input shardings; zero1 pins the
         # state to its dp-sharded specs instead (1/dp per-chip moments)
@@ -416,6 +436,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         if extras:
             return {"opt": inner, **extras}
         return inner
+    init_state.layout_extra = layout_extra
 
     def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
         """Per-leaf ZeRO-1 update inside shard_map: reduce-scatter the

@@ -622,6 +622,15 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
         fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp)
+    # elastic-checkpoint hint: see gpt.build_hybrid_train_step
+    init_state.layout_extra["pp"] = {
+        "num_layers": int(cfg.num_layers), "pp": int(mesh.shape[pp_axis]),
+        "vpp": int(virtual_pp),
+        "stacked_components": ["blocks", "fp8_meta"],
+    }
+    if fp8_plan is not None:
+        init_state.layout_extra["fp8_amax_ticks"] = (
+            num_microbatches + int(mesh.shape[pp_axis]) - 1)
 
     if virtual_pp > 1:
         shard_params = vpp_wrap_shard_params(

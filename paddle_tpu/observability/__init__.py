@@ -27,7 +27,7 @@ Entry points: ``models.hybrid_engine.build_train_step(telemetry=)``,
 ``inference.ServingEngine`` and ``bench.py``. See README "Observability".
 """
 
-from .events import EventLog, get_event_log, set_event_log
+from .events import EventLog, emit_event, get_event_log, set_event_log
 from .flops import (collective_seconds, gpt_flops_per_token,
                     llama_flops_per_token, mfu, param_count, peak_flops,
                     plan_wire_bytes, transformer_flops_per_token)
@@ -47,7 +47,7 @@ __all__ = [
     "gpt_flops_per_token", "llama_flops_per_token",
     "transformer_flops_per_token", "param_count", "mfu", "peak_flops",
     "collective_seconds", "plan_wire_bytes",
-    "EventLog", "get_event_log", "set_event_log",
+    "EventLog", "emit_event", "get_event_log", "set_event_log",
     "PromRegistry", "MetricsServer", "serve_registry",
     "span", "capture_spans", "write_chrome_trace",
 ]

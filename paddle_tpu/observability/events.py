@@ -119,6 +119,15 @@ def get_event_log() -> Optional[EventLog]:
     return _GLOBAL
 
 
+def emit_event(event: str, **fields) -> None:
+    """Emit one event iff a process log is configured — the one copy of
+    the get_event_log-guarded emit the resilience/checkpoint layers use
+    for lifecycle forensics (resume/commit/reshard/carry decisions)."""
+    log = get_event_log()
+    if log is not None:
+        log.emit(event, **fields)
+
+
 def set_event_log(log: Optional[EventLog]) -> Optional[EventLog]:
     """Install an explicit process log (tests/drivers) that shadows the
     flag binding; set_event_log(None) restores flag-driven behavior.
