@@ -365,6 +365,115 @@ def _run_mp_overlap_config(jax, paddle, G, conf, iters):
     }
 
 
+def _run_moe_config(jax, paddle, G, conf, iters):
+    """GPT-MoE through the hybrid engine on a dp x ep x mp mesh
+    (FLAGS_moe_index_dispatch / FLAGS_moe_quantize_a2a / FLAGS_moe_overlap):
+    dense-dispatch baseline vs zero-flop index dispatch vs the
+    int8-EF quantized + chunk-overlapped all-to-all, with the analytic
+    dispatch-flop delta and per-rank a2a wire bytes stated alongside.
+    On the CPU smoke the step times measure scheduling overhead only —
+    the a2a overlap win needs ICI; the analytic columns are
+    platform-independent."""
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.comm_overlap import MoeDispatchConfig
+    from paddle_tpu.incubate.distributed.models.moe.gate import \
+        compute_capacity
+    from paddle_tpu.observability import ep_a2a_wire_bytes
+    from paddle_tpu.observability import flops as _flops
+
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 4 != 0:
+        return {"skipped": f"needs a device count divisible by 4 for a "
+                           f"dp x ep2 x mp2 mesh, have {n_dev}"}
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    ep, mp = 2, 2
+    dp = n_dev // (ep * mp)
+    mesh = dist.build_mesh({"dp": dp, "ep": ep, "pp": 1, "mp": mp})
+    batch, seq = conf["batch"], conf["seq"]
+    batch = dp * ep * max(1, batch // (dp * ep))
+    E = 8
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=conf["max_seq_len"],
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        moe_num_experts=E, moe_capacity_factor=2.0)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    lr = jnp.float32(1e-4)
+    b_rank = batch // (dp * ep)
+    T = b_rank * seq
+    C = compute_capacity(T, E, 1, cfg.moe_capacity_factor)
+    H, FF, L2 = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers // 2
+    dt = 2 if on_tpu else 4
+
+    def timed(dispatch, **kw):
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-4,
+            moment_dtype=jnp.bfloat16 if on_tpu else None)
+        step, shard, init = G.build_hybrid_train_step(
+            cfg, mesh, opt, num_microbatches=1, moe_dispatch=dispatch,
+            **kw)
+        p = shard(params)
+        st = init(p)
+        tc0 = time.perf_counter()
+        p, st, loss = step(p, st, tokens, labels, lr)
+        float(loss)
+        compile_s = time.perf_counter() - tc0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, st, loss = step(p, st, tokens, labels, lr)
+        float(loss)
+        return (time.perf_counter() - t0) / iters, compile_s
+
+    t_dense, c_dense = timed(None)
+    t_index, c_index = timed(MoeDispatchConfig(index=True))
+    t_qovl, c_qovl = timed(
+        MoeDispatchConfig(index=True, quantize=True, overlap=True,
+                          chunks=2),
+        moe_ef_tokens=(b_rank, seq))
+
+    # per-rank expert-GEMM flops/step: each rank's local expert shard
+    # processes all E*C capacity slots of its ep group after the a2a
+    # (padding slots do real MXU work), 2 GEMMs of H x FF/mp each,
+    # fwd + 2x bwd, L2 MoE layers
+    expert_flops = 12.0 * E * C * H * (FF // mp) * L2
+    peak = _flops.peak_flops(jax.devices())
+    payload = float(E * C * H)
+    return {
+        "config_hash": _config_hash(conf),
+        "mesh": {"dp": dp, "ep": ep, "pp": 1, "mp": mp},
+        "experts": E, "capacity_per_rank": C,
+        "step_ms": {"dense_dispatch": round(t_dense * 1e3, 2),
+                    "index_dispatch": round(t_index * 1e3, 2),
+                    "int8_ef_overlapped_a2a": round(t_qovl * 1e3, 2)},
+        "compile_s": {"dense_dispatch": round(c_dense, 2),
+                      "index_dispatch": round(c_index, 2),
+                      "int8_ef_overlapped_a2a": round(c_qovl, 2)},
+        "expert_gemm_mfu_pct": {
+            "index_dispatch": round(
+                100.0 * expert_flops / (t_index * peak), 2),
+            "int8_ef_overlapped_a2a": round(
+                100.0 * expert_flops / (t_qovl * peak), 2)},
+        # the 2*T*E*C*D one-hot einsum the index dispatch deletes —
+        # PER dispatch AND combine, fwd (backward re-runs both)
+        "dense_dispatch_flops_per_moe_layer": 2.0 * 2 * T * E * C * H,
+        "a2a_bytes_per_step_per_rank": {
+            "wire_dtype": "bf16" if on_tpu else "fp32",
+            "unquantized_wire": ep_a2a_wire_bytes(
+                ep, payload_elems=payload, n_layer_executions=float(L2),
+                itemsize=dt),
+            "int8_wire": ep_a2a_wire_bytes(
+                ep, payload_elems=payload, n_layer_executions=float(L2),
+                itemsize=dt, quantize=True)},
+        "cpu_smoke": not on_tpu,
+    }
+
+
 def _run_telemetry_config(jax, paddle, G, conf, iters,
                           comms_fraction=None):
     """Step accounting through the observability StepTimer: compile vs
@@ -523,6 +632,11 @@ def main():
         fp8_conf["batch"] = 2
     out["fp8"] = _run_fp8_config(jax, paddle, G, fp8_conf,
                                  iters if on_tpu else 3)
+    # GPT-MoE in the hybrid engine (FLAGS_moe_*): dense vs index
+    # dispatch vs the int8-EF quantized + overlapped all-to-all, with
+    # the analytic dispatch-flop delta and a2a wire bytes
+    moe_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
+    out["moe"] = _run_moe_config(jax, paddle, G, moe_conf, overlap_iters)
     # step accounting (observability.StepTimer): compile/steady split,
     # data-vs-step phase breakdown, analytic-FLOPs MFU and the measured
     # comms_fraction — where the step time goes, round over round

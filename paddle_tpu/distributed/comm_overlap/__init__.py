@@ -10,9 +10,16 @@ sequence-parallel AG/RS block boundaries and the ring collective-matmul
 decomposition that interleaves those collectives with their producing/
 consuming GEMMs (entry points re-exported by fleet.layers.mpu.mp_ops).
 
+The ep (expert-parallel) axis half lives in a2a.py: the MoE
+dispatch/combine all-to-all exchange with int8 error-feedback wire
+compression and the chunked transfer/GEMM interleave (consumed by the
+models' MoE hybrid path).
+
 Flag surface: FLAGS_comm_bucket_mb, FLAGS_comm_quantize,
 FLAGS_comm_overlap_microbatches, FLAGS_xla_latency_hiding_scheduler,
-FLAGS_mp_seq_parallel, FLAGS_mp_collective_matmul.
+FLAGS_mp_seq_parallel, FLAGS_mp_collective_matmul,
+FLAGS_moe_index_dispatch, FLAGS_moe_quantize_a2a, FLAGS_moe_overlap,
+FLAGS_moe_overlap_chunks.
 Consumed by models.hybrid_engine.build_train_step (hybrid dp axis),
 models gpt/llama build_hybrid_train_step (mp_overlap= seq-parallel TP),
 distributed.sharding.group_sharded.build_sharded_train_step (stage-1/2
@@ -20,6 +27,9 @@ microbatched overlap) and optimizer.gradient_merge (communicate once per
 k steps via make_merge_comm_fn).
 """
 
+from .a2a import (MoeDispatchConfig, expert_exchange,  # noqa: F401
+                  moe_dispatch_from_flags, moe_ef_local_shapes,
+                  qa2a_gather, qa2a_scatter, resolve_moe_dispatch)
 from .bucketing import (Bucket, BucketPlan, LeafSlot,  # noqa: F401
                         build_bucket_plan, local_shape, pack_bucket,
                         unpack_bucket)
@@ -47,6 +57,8 @@ __all__ = [
     "MP_OVERLAP_MODES", "MpOverlapConfig", "mp_overlap_from_flags",
     "resolve_mp_overlap", "ag_matmul", "matmul_rs", "ag_seq", "rs_seq",
     "scatter_seq",
+    "MoeDispatchConfig", "moe_dispatch_from_flags", "resolve_moe_dispatch",
+    "expert_exchange", "qa2a_scatter", "qa2a_gather", "moe_ef_local_shapes",
 ]
 
 

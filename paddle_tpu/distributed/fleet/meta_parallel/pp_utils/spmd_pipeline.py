@@ -100,7 +100,8 @@ _replicate_from_last.defvjp(_replicate_from_last_fwd, _replicate_from_last_bwd)
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
-                  axis: str = "pp", checkpoint_stages: bool = True):
+                  axis: str = "pp", checkpoint_stages: bool = True,
+                  with_aux: bool = False):
     """Run a homogeneous-stage pipeline inside shard_map.
 
     stage_fn(stage_params_local, x) -> y with y.shape == x.shape
@@ -111,7 +112,16 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
 
     Returns [M, mb, ...] — outputs of the LAST stage, valid on every rank
     (zeros elsewhere are summed into place with one psum at the end).
-    """
+
+    with_aux=True: stage_fn returns (y, aux_tree) instead — a side channel
+    for per-stage scalars/stats that cannot ride the activation (the MoE
+    load-balance loss and routing stats, whose producing layers live
+    INSIDE the pipeline). Aux contributions are summed over the M VALID
+    ticks of each rank (bubble iterations run the stage body on zeros and
+    are masked out — their activations were always discarded; the mask
+    extends that to the side channel) and psum'd over the pipe axis, so
+    the returned aux tree is the sum over every (stage, microbatch)
+    execution, replicated on all ranks. Returns (outputs, aux)."""
     P = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     M = x_microbatches.shape[0]
@@ -119,14 +129,31 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
 
     fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
 
+    if with_aux:
+        aux_shape = jax.eval_shape(stage_fn, stage_params,
+                                   x_microbatches[0])[1]
+        aux0 = _zb_pvary(jax.tree.map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype), aux_shape), axis)
+    else:
+        aux0 = ()
+
     def step(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         # rotate activations one stage down the ring (stage d-1 -> d)
         prev = lax.ppermute(state, axis, [(i, i + 1) for i in range(P - 1)])
         inj = jnp.take(x_microbatches, jnp.clip(t, 0, M - 1), axis=0)
         inj = jnp.where(t < M, inj, jnp.zeros_like(inj))
         inp = jnp.where(idx == 0, inj, prev)
-        out = fn(stage_params, inp)
+        if with_aux:
+            out, aux = fn(stage_params, inp)
+            # rank idx runs microbatch m = t - idx; everything else is
+            # bubble compute on garbage
+            valid = (t >= idx) & (t - idx < M)
+            aux_acc = jax.tree.map(
+                lambda a, v: a + jnp.where(valid, v, jnp.zeros_like(v)),
+                aux_acc, aux)
+        else:
+            out = fn(stage_params, inp)
         # last stage emits microbatch m = t - (P-1)
         m = t - (P - 1)
         mc = jnp.clip(m, 0, M - 1)
@@ -134,13 +161,22 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x_microbatches,
         cur = lax.dynamic_index_in_dim(outputs, mc, axis=0, keepdims=False)
         val = jnp.where(write, out, cur)
         outputs = lax.dynamic_update_index_in_dim(outputs, val, mc, axis=0)
-        return (out, outputs), None
+        return (out, outputs, aux_acc), None
 
     out0 = _zb_pvary(jnp.zeros_like(x_microbatches), axis)
     state0 = _zb_pvary(jnp.zeros_like(x_microbatches[0]), axis)
-    (_, outputs), _ = lax.scan(step, (state0, out0), jnp.arange(T))
+    (_, outputs, aux_acc), _ = lax.scan(step, (state0, out0, aux0),
+                                        jnp.arange(T))
     # replicate last-stage outputs to every rank (loss is computed SPMD)
-    return _replicate_from_last(outputs, axis)
+    outputs = _replicate_from_last(outputs, axis)
+    if with_aux:
+        # psum-fwd / identity-bwd: the downstream cotangent is replicated
+        # across the pipe ranks, so a raw psum's transpose would deliver
+        # P times the aux-loss gradient (the _replicate_from_last lesson)
+        from ...layers.mpu import mp_ops
+        return outputs, jax.tree.map(
+            lambda a: mp_ops.mp_allreduce(a, axis), aux_acc)
+    return outputs
 
 
 def spmd_pipeline_interleaved(stage_fn: Callable, stage_params_chunks,

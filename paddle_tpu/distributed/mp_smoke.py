@@ -122,28 +122,45 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
 
 def run_training(mesh, steps: int = 4, return_params: bool = False,
                  num_microbatches: int = 1, schedule: str = "1F1B",
-                 zero1: bool = False):
+                 zero1: bool = False, virtual_pp: int = 1,
+                 moe: bool = False):
     """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
-    pp / mp); every process computes identical host inputs. The ONE copy of
-    the parity workload — the launcher golden, the spawned workers and the
-    reference-pattern tests (tests/mp_worker.py) all import it, so they can
-    never drift apart."""
+    pp / mp, plus ep for the MoE leg); every process computes identical
+    host inputs. The ONE copy of the parity workload — the launcher
+    golden, the spawned workers and the reference-pattern tests
+    (tests/mp_worker.py) all import it, so they can never drift apart.
+
+    moe=True runs the GPT-MoE config (switch FFN on alternating layers,
+    experts sharded over the mesh's 'ep' axis, index dispatch) so the
+    dispatch/combine all-to-alls cross whatever boundary the mesh puts
+    the ep axis on."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.models import gpt as G
 
-    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    if moe:
+        cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, max_seq_len=16, dtype=jnp.float32,
+                          moe_num_experts=4, moe_capacity_factor=4.0)
+    else:
+        # the interleaved schedule needs num_layers % (pp * vpp) == 0
+        nl = 2 * max(int(virtual_pp), 1)
+        cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=nl,
+                          num_heads=4, max_seq_len=16, dtype=jnp.float32)
     params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
     # zero1 mode also carries the axes-aware global-norm clip so the
     # cross-process parity covers the whole round-5 stage-1 path
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-2,
         grad_clip=(paddle.nn.ClipGradByGlobalNorm(0.5) if zero1 else None))
+    kw = {}
+    if moe:
+        from .comm_overlap import MoeDispatchConfig
+        kw["moe_dispatch"] = MoeDispatchConfig(index=True)
     step, shard_params, init_state = G.build_hybrid_train_step(
         cfg, mesh, opt, num_microbatches=num_microbatches,
-        schedule=schedule, zero1_dp=zero1)
+        schedule=schedule, zero1_dp=zero1, virtual_pp=virtual_pp, **kw)
     params = shard_params(params)
     state = init_state(params)
     rng = np.random.RandomState(0)
@@ -260,26 +277,42 @@ def elastic_restart_check(n_devices: int, ckpt_dir: str, devices=None,
 
 # "dpmp" is the hybrid
 # dp-across-processes layout; the pp modes put the PIPELINE axis on the
-# process boundary — each stage lives on its own process and the 1F1B/ZBH1
-# ppermute hops cross it, the reference's dominant multi-node integration
-# (fleet/meta_parallel/pp_utils/p2p_communication.py:570 cross-node p2p).
-# "sepring" runs ring attention with the SEP axis spanning both processes —
-# the ring's neighbor hops at the process edges are cross-process ppermutes
-# (2 of n hops with the contiguous hybrid layout; the long-context DCN
-# path at this box's fidelity).
-# mode -> (mesh dims builder, microbatches, schedule, zero1_dp)
+# process boundary — each stage lives on its own process and the
+# 1F1B/ZBH1/interleaved ppermute hops cross it, the reference's dominant
+# multi-node integration (fleet/meta_parallel/pp_utils/
+# p2p_communication.py:570 cross-node p2p). "ppvpp" is the interleaved
+# virtual-pipeline schedule over the same boundary (each rank's V chunk
+# wrap rides the circular permute across processes). "epmoe" puts the
+# EXPERT-parallel axis on the process boundary: the GPT-MoE
+# dispatch/combine all-to-alls (index dispatch) cross it every layer.
+# "sepring" runs ring attention with the SEP axis spanning both
+# processes — the ring's neighbor hops at the process edges are
+# cross-process ppermutes (2 of n hops with the contiguous hybrid
+# layout; the long-context DCN path at this box's fidelity).
+# mode -> dict(dims builder, microbatches, schedule, zero1, vpp, moe)
 _MODES = {
-    "dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B", False),
+    "dpmp": dict(dims=lambda n: {"dp": 2, "pp": 1, "mp": n // 2}),
     # zero1 stage-1 over the dp axis that SPANS the two processes: the
     # grad reduce-scatter and param all-gather hops cross the boundary
-    "z1dpmp": (lambda n: {"dp": 2, "pp": 1, "mp": n // 2}, 1, "1F1B",
-               True),
-    "pp1f1b": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "1F1B",
-               False),
-    "ppzbh1": (lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, 4, "ZBH1",
-               False),
-    "sepring": (lambda n: {"sep": n}, 1, "1F1B", False),
+    "z1dpmp": dict(dims=lambda n: {"dp": 2, "pp": 1, "mp": n // 2},
+                   zero1=True),
+    "pp1f1b": dict(dims=lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, m=4),
+    "ppzbh1": dict(dims=lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, m=4,
+                   schedule="ZBH1"),
+    "ppvpp": dict(dims=lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, m=4,
+                  vpp=2),
+    "epmoe": dict(dims=lambda n: {"ep": 2, "dp": 1, "pp": 1,
+                                  "mp": n // 2}, moe=True),
+    "sepring": dict(dims=lambda n: {"sep": n}),
 }
+
+
+def _mode_training_kwargs(mode_cfg):
+    return dict(num_microbatches=mode_cfg.get("m", 1),
+                schedule=mode_cfg.get("schedule", "1F1B"),
+                zero1=mode_cfg.get("zero1", False),
+                virtual_pp=mode_cfg.get("vpp", 1),
+                moe=mode_cfg.get("moe", False))
 
 
 def run_ring(mesh, steps: int = 3):
@@ -337,8 +370,8 @@ def main():
              "losses": {str(k): v for k, v in losses.items()},
              "resumed_from": info["resumed_from"]}), flush=True)
         return
-    dims_of, M, schedule, zero1 = _MODES[mode]
-    mesh = build_mesh(dims_of(n))
+    mode_cfg = _MODES[mode]
+    mesh = build_mesh(mode_cfg["dims"](n))
     if mode == "sepring":
         # the sep ring must CROSS the process boundary somewhere: count
         # neighbor pairs (incl. the wraparound) on different processes
@@ -352,20 +385,30 @@ def main():
             flush=True)
         return
     ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
-    dev = np.moveaxis(mesh.devices,
-                      (ax["dp"], ax["pp"], ax["mp"]), (0, 1, 2))
-    if mode in ("dpmp", "z1dpmp"):
-        # hybrid-layout invariant: mp intra-process, dp across processes
-        assert len({d.process_index for d in dev[0, 0, :]}) == 1
-        assert dev[0, 0, 0].process_index != dev[1, 0, 0].process_index
+    if mode == "epmoe":
+        # ep across the PROCESS boundary (the dispatch/combine
+        # all-to-alls cross it), mp intra-process
+        dev = np.moveaxis(mesh.devices, (ax["ep"], ax["mp"]), (0, -1))
+        dev = dev.reshape(2, -1)
+        for e in range(2):
+            assert len({d.process_index for d in dev[e]}) == 1, mode
+        assert dev[0, 0].process_index != dev[1, 0].process_index, mode
     else:
-        # pp across the PROCESS boundary: each stage entirely on one
-        # process, stages on different processes
-        for s in range(2):
-            assert len({d.process_index for d in dev[0, s, :]}) == 1, mode
-        assert dev[0, 0, 0].process_index != dev[0, 1, 0].process_index
-    losses = run_training(mesh, num_microbatches=M, schedule=schedule,
-                          zero1=zero1)
+        dev = np.moveaxis(mesh.devices,
+                          (ax["dp"], ax["pp"], ax["mp"]), (0, 1, 2))
+        if mode in ("dpmp", "z1dpmp"):
+            # hybrid-layout invariant: mp intra-process, dp across
+            # processes
+            assert len({d.process_index for d in dev[0, 0, :]}) == 1
+            assert dev[0, 0, 0].process_index != dev[1, 0, 0].process_index
+        else:
+            # pp across the PROCESS boundary: each stage entirely on one
+            # process, stages on different processes
+            for s in range(2):
+                assert len({d.process_index for d in dev[0, s, :]}) == 1, \
+                    mode
+            assert dev[0, 0, 0].process_index != dev[0, 1, 0].process_index
+    losses = run_training(mesh, **_mode_training_kwargs(mode_cfg))
     print("MPSMOKE " + json.dumps(
         {"rank": jax.process_index(), "mode": mode, "losses": losses}),
         flush=True)
@@ -392,12 +435,11 @@ def golden_for(n_devices: int, mode: str = "dpmp", devices=None):
     """Single-process golden loss curve for a spawn mode (same mesh dims,
     same schedule, one process)."""
     from .topology import build_mesh
-    dims_of, M, schedule, zero1 = _MODES[mode]
-    mesh = build_mesh(dims_of(n_devices), devices=devices)
+    mode_cfg = _MODES[mode]
+    mesh = build_mesh(mode_cfg["dims"](n_devices), devices=devices)
     if mode == "sepring":
         return run_ring(mesh)
-    return run_training(mesh, num_microbatches=M, schedule=schedule,
-                        zero1=zero1)
+    return run_training(mesh, **_mode_training_kwargs(mode_cfg))
 
 
 if __name__ == "__main__":

@@ -385,6 +385,42 @@ define_flag("comm_overlap_microbatches", 1,
             "microbatches still compute. 1 keeps a single backward "
             "(consumed by comm_overlap.config_from_flags and "
             "group_sharded.build_sharded_train_step).")
+define_flag("moe_index_dispatch", False,
+            "Zero-flop index (gather/scatter) dispatch for the hybrid "
+            "engines' MoE layers: tokens route to their (expert, "
+            "capacity-slot) by slot id instead of the dense [T, E, C] "
+            "one-hot einsum that costs 2*T*E*C*D MXU flops per "
+            "dispatch/combine — the TPU analogue of the reference's CUDA "
+            "global_scatter. Off (default): the dense-dispatch baseline "
+            "compiles bitwise-identically, and is the parity golden "
+            "(consumed by comm_overlap.a2a.moe_dispatch_from_flags via "
+            "models.gpt build_hybrid_train_step(moe='auto')).")
+define_flag("moe_quantize_a2a", False,
+            "int8-quantize the MoE expert dispatch/combine all-to-alls "
+            "with error feedback (EQuARX-style): the [E, C, D] payload "
+            "crosses the ep axis as int8 codes + per-expert fp32 scales "
+            "(~4x fewer fp32 wire bytes), and each rank's rounding error "
+            "rides opt_state['moe_ef'] into the next step's payload "
+            "exactly as the dp-gradient residuals ride "
+            "opt_state['comm_ef']. Backward cotangent all-to-alls stay "
+            "full precision. Requires pp degree 1 and num_microbatches 1 "
+            "(residual slots are per (layer, step)); pass "
+            "moe_ef_tokens=(per-rank batch, seq) to the model builder so "
+            "the residual state can be sized at build time (consumed by "
+            "comm_overlap.a2a.moe_dispatch_from_flags).")
+define_flag("moe_overlap", False,
+            "Chunk the MoE dispatch/combine all-to-alls along the "
+            "capacity dim and interleave each chunk's ep transfer with "
+            "the previous chunk's expert GEMM inside a lax.scan (the "
+            "PR 5 ring collective-matmul pattern applied to all-to-all): "
+            "chunk j+1's wire time hides behind chunk j's MXU work "
+            "instead of the whole exchange serializing against the whole "
+            "expert FFN. Pair with FLAGS_xla_latency_hiding_scheduler "
+            "(consumed by comm_overlap.a2a.moe_dispatch_from_flags).")
+define_flag("moe_overlap_chunks", 2,
+            "Capacity-dim chunks for the overlapped MoE all-to-all "
+            "(FLAGS_moe_overlap); must divide the per-microbatch expert "
+            "capacity (consumed by comm_overlap.a2a).")
 define_flag("mp_seq_parallel", False,
             "Megatron-style sequence parallelism on the tensor-parallel "
             "'mp' axis of the hybrid engines: between transformer blocks "

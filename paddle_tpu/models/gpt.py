@@ -40,10 +40,12 @@ from ..distributed.fleet.meta_parallel.pp_utils.spmd_pipeline import (
     spmd_pipeline, spmd_pipeline_interleaved, spmd_pipeline_zero_bubble,
     vpp_block_permutation, vpp_chunk_blocks, vpp_wrap_shard_params)
 
-__all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_6p7b",
+__all__ = ["GPTConfig", "GPT", "gpt_tiny", "gpt_small", "gpt_moe_tiny",
+           "gpt_1p3b", "gpt_6p7b",
            "init_hybrid_params", "hybrid_param_specs", "hybrid_loss_fn",
            "build_hybrid_train_step", "split_streamed_params",
-           "init_streamed_params", "streamed_fns", "GPT_FP8_SITES"]
+           "init_streamed_params", "streamed_fns", "GPT_FP8_SITES",
+           "moe_telemetry_series"]
 
 # the dense-stack GEMM sites that run fp8 under FLAGS_fp8 / amp O3 (the
 # attention einsums, LM head and embedding stay bf16 — quantization.fp8)
@@ -62,6 +64,14 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16  # MXU-native compute dtype
     param_dtype: Any = jnp.float32
     use_bias: bool = True
+    # GPT-MoE (hybrid engine): > 0 replaces every SECOND layer's FFN with
+    # a switch-routed (top-1, capacity-bounded) bank of this many experts,
+    # dispatched over the 'ep' mesh axis — the alternating dense/MoE
+    # layout of Switch-Transformer-style GPT variants. 0 = dense GPT,
+    # bitwise-unchanged.
+    moe_num_experts: int = 0
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
 
     def __post_init__(self):
         if self.ffn_hidden is None:
@@ -69,10 +79,18 @@ class GPTConfig:
         enforce(self.hidden_size % self.num_heads == 0,
                 "hidden_size must be divisible by num_heads", op="GPTConfig",
                 hidden_size=self.hidden_size, num_heads=self.num_heads)
+        enforce(self.moe_num_experts == 0 or self.num_layers % 2 == 0,
+                "GPT-MoE stacks (dense, MoE) layer PAIRS so the pipeline "
+                "scan stays homogeneous — num_layers must be even",
+                op="GPTConfig", num_layers=self.num_layers)
 
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
+
+    @property
+    def moe_on(self) -> bool:
+        return self.moe_num_experts > 0
 
 
 def gpt_tiny(**kw):
@@ -82,6 +100,12 @@ def gpt_tiny(**kw):
 
 def gpt_small(**kw):
     return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_moe_tiny(**kw):
+    kw.setdefault("moe_num_experts", 8)
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                     num_heads=4, max_seq_len=256, **kw)
 
 
 def gpt_1p3b(**kw):
@@ -155,7 +179,15 @@ class GPT(nn.Layer):
 # ---------------------------------------------------------------------------
 def init_hybrid_params(cfg: GPTConfig, key) -> Dict[str, Any]:
     """Stacked-parameter pytree. Blocks are stacked on a leading [L] axis so
-    the pipeline can shard them over 'pp' and scan within a stage."""
+    the pipeline can shard them over 'pp' and scan within a stage.
+
+    GPT-MoE (cfg.moe_num_experts > 0): blocks become (dense, MoE) layer
+    PAIRS stacked [L/2] — ``blocks = {"dense": {...}, "moe": {...}}`` —
+    so the pipeline scan stays homogeneous while every second layer runs
+    the switch-routed expert FFN. The MoE half carries its own attention
+    sublayer (same TP layout) plus ``gate_w [L/2, H, E]`` and the stacked
+    expert bank ``w1 [L/2, E, H, FF] / w2 [L/2, E, FF, H]`` that shards
+    over 'ep' (and 'mp' on the expert hidden dim)."""
     H, L, FF, V = cfg.hidden_size, cfg.num_layers, cfg.ffn_hidden, cfg.vocab_size
     k = jax.random.split(key, 12)
     std = 0.02
@@ -164,23 +196,49 @@ def init_hybrid_params(cfg: GPTConfig, key) -> Dict[str, Any]:
     def nrm(key, shape, scale=std):
         return (scale * jax.random.normal(key, shape)).astype(pd)
 
+    def dense_blocks(nl, kq, kp, k1, k2):
+        return {
+            "ln1_g": jnp.ones((nl, H), pd),
+            "ln1_b": jnp.zeros((nl, H), pd),
+            "qkv_w": nrm(kq, (nl, H, 3 * H)),
+            "qkv_b": jnp.zeros((nl, 3 * H), pd),
+            "proj_w": nrm(kp, (nl, H, H), std / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((nl, H), pd),
+            "ln2_g": jnp.ones((nl, H), pd),
+            "ln2_b": jnp.zeros((nl, H), pd),
+            "fc1_w": nrm(k1, (nl, H, FF)),
+            "fc1_b": jnp.zeros((nl, FF), pd),
+            "fc2_w": nrm(k2, (nl, FF, H), std / math.sqrt(2 * L)),
+            "fc2_b": jnp.zeros((nl, H), pd),
+        }
+
+    if cfg.moe_on:
+        L2, E = L // 2, cfg.moe_num_experts
+        blocks = {
+            "dense": dense_blocks(L2, k[2], k[3], k[4], k[5]),
+            "moe": {
+                "ln1_g": jnp.ones((L2, H), pd),
+                "ln1_b": jnp.zeros((L2, H), pd),
+                "qkv_w": nrm(k[7], (L2, H, 3 * H)),
+                "qkv_b": jnp.zeros((L2, 3 * H), pd),
+                "proj_w": nrm(k[8], (L2, H, H), std / math.sqrt(2 * L)),
+                "proj_b": jnp.zeros((L2, H), pd),
+                "ln2_g": jnp.ones((L2, H), pd),
+                "ln2_b": jnp.zeros((L2, H), pd),
+                "gate_w": nrm(k[9], (L2, H, E)),
+                "w1": nrm(k[10], (L2, E, H, FF)),
+                "b1": jnp.zeros((L2, E, FF), pd),
+                "w2": nrm(k[11], (L2, E, FF, H), std / math.sqrt(2 * L)),
+                "b2": jnp.zeros((L2, E, H), pd),
+            },
+        }
+    else:
+        blocks = dense_blocks(L, k[2], k[3], k[4], k[5])
+
     params = {
         "wte": nrm(k[0], (V, H)),
         "wpe": nrm(k[1], (cfg.max_seq_len, H)),
-        "blocks": {
-            "ln1_g": jnp.ones((L, H), pd),
-            "ln1_b": jnp.zeros((L, H), pd),
-            "qkv_w": nrm(k[2], (L, H, 3 * H)),
-            "qkv_b": jnp.zeros((L, 3 * H), pd),
-            "proj_w": nrm(k[3], (L, H, H), std / math.sqrt(2 * L)),
-            "proj_b": jnp.zeros((L, H), pd),
-            "ln2_g": jnp.ones((L, H), pd),
-            "ln2_b": jnp.zeros((L, H), pd),
-            "fc1_w": nrm(k[4], (L, H, FF)),
-            "fc1_b": jnp.zeros((L, FF), pd),
-            "fc2_w": nrm(k[5], (L, FF, H), std / math.sqrt(2 * L)),
-            "fc2_b": jnp.zeros((L, H), pd),
-        },
+        "blocks": blocks,
         "lnf_g": jnp.ones((H,), pd),
         "lnf_b": jnp.zeros((H,), pd),
         "head_w": nrm(k[6], (H, V)),
@@ -190,18 +248,40 @@ def init_hybrid_params(cfg: GPTConfig, key) -> Dict[str, Any]:
 
 def hybrid_param_specs(cfg: GPTConfig) -> Dict[str, Any]:
     """PartitionSpecs: blocks stacked-L over 'pp'; Megatron shardings over
-    'mp'; vocab-parallel embedding + head over 'mp'."""
+    'mp'; vocab-parallel embedding + head over 'mp'. GPT-MoE additionally
+    shards the stacked expert bank's E dim over 'ep' and the expert
+    hidden dim over 'mp' (w1 column-parallel, w2 row-parallel — one mp
+    all-reduce per expert FFN); the gate stays replicated over ep/mp so
+    routing is identical on every rank."""
+    dense = {
+        "ln1_g": P("pp"), "ln1_b": P("pp"),
+        "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
+        "proj_w": P("pp", "mp", None), "proj_b": P("pp"),
+        "ln2_g": P("pp"), "ln2_b": P("pp"),
+        "fc1_w": P("pp", None, "mp"), "fc1_b": P("pp", "mp"),
+        "fc2_w": P("pp", "mp", None), "fc2_b": P("pp"),
+    }
+    if cfg.moe_on:
+        blocks = {
+            "dense": dense,
+            "moe": {
+                "ln1_g": P("pp"), "ln1_b": P("pp"),
+                "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
+                "proj_w": P("pp", "mp", None), "proj_b": P("pp"),
+                "ln2_g": P("pp"), "ln2_b": P("pp"),
+                "gate_w": P("pp"),
+                "w1": P("pp", "ep", None, "mp"),
+                "b1": P("pp", "ep", "mp"),
+                "w2": P("pp", "ep", "mp", None),
+                "b2": P("pp", "ep"),
+            },
+        }
+    else:
+        blocks = dense
     return {
         "wte": P("mp", None),
         "wpe": P(),
-        "blocks": {
-            "ln1_g": P("pp"), "ln1_b": P("pp"),
-            "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
-            "proj_w": P("pp", "mp", None), "proj_b": P("pp"),
-            "ln2_g": P("pp"), "ln2_b": P("pp"),
-            "fc1_w": P("pp", None, "mp"), "fc1_b": P("pp", "mp"),
-            "fc2_w": P("pp", "mp", None), "fc2_b": P("pp"),
-        },
+        "blocks": blocks,
         "lnf_g": P(), "lnf_b": P(),
         "head_w": P(None, "mp"),
     }
@@ -232,6 +312,54 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _attn_sublayer(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None,
+                   sp=None):
+    """ln1 + Megatron-TP causal attention + residual — the shared first
+    half of the dense and MoE hybrid blocks (reads the ln1_*/qkv_*/proj_*
+    keys; sp callers must have pre-wrapped the replicated-but-SP params,
+    see _block_fn)."""
+    mp = lax.axis_size(mp_axis)
+    heads_local = cfg.num_heads // mp
+    B = x.shape[0]
+    H = cfg.hidden_size
+    from ..distributed.fleet.layers.mpu import mp_ops
+
+    if sp is None:
+        S = x.shape[1]
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        hi = mp_ops.c_identity(h, mp_axis)
+        qkv = (_fp8_mm(fp8, "qkv")(hi.astype(cfg.dtype),
+                                   p["qkv_w"].astype(cfg.dtype))
+               + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
+    else:
+        S = x.shape[1] * mp  # x is this rank's sequence shard
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = (mp_ops.ag_matmul(
+            h.astype(cfg.dtype), p["qkv_w"].astype(cfg.dtype), mp_axis,
+            ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "qkv"))
+            + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
+    qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
+    # registry op: Pallas flash on TPU (the engine's shard_map runs with
+    # check_vma=False, so the kernel traces inside it); composed O(S^2)
+    # fallback elsewhere — heads are fully local under TP, so per-shard
+    # attention is the whole computation (always over the FULL sequence;
+    # only the between-block residual stream is seq-sharded under sp)
+    attn = F.scaled_dot_product_attention(
+        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
+    attn = attn.reshape(B, S, H // mp)
+    if sp is None:
+        out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
+        out = (mp_ops.mp_allreduce(out, mp_axis)
+               + p["proj_b"].astype(cfg.dtype))
+    else:
+        out = (mp_ops.matmul_rs(
+            attn, p["proj_w"].astype(cfg.dtype), mp_axis, ring=sp.ring,
+            mm=None if fp8 is None else _fp8_mm(fp8, "proj"))
+            + p["proj_b"].astype(cfg.dtype))
+    return x + out
+
+
 def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
     """One transformer block, explicit Megatron TP (runs inside shard_map;
     degenerates correctly at mp degree 1).
@@ -258,20 +386,9 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
     partial products (collective matmul; fp8 must be off — per-chunk
     fp8_dot calls would sum partial amax observations)."""
     mp = lax.axis_size(mp_axis)
-    heads_local = cfg.num_heads // mp
-    B = x.shape[0]
-    H = cfg.hidden_size
     from ..distributed.fleet.layers.mpu import mp_ops
 
-    if sp is None:
-        S = x.shape[1]
-        h = _ln(x, p["ln1_g"], p["ln1_b"])
-        hi = mp_ops.c_identity(h, mp_axis)
-        qkv = (_fp8_mm(fp8, "qkv")(hi.astype(cfg.dtype),
-                                   p["qkv_w"].astype(cfg.dtype))
-               + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
-    else:
-        S = x.shape[1] * mp  # x is this rank's sequence shard
+    if sp is not None:
         # replicated-but-sequence-parallel params (the reference's
         # mark_as_sequence_parallel_parameter allreduce hook,
         # sequence_parallel_utils.py:192): LayerNorm weights and the
@@ -283,31 +400,7 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
         p = dict(p)
         for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "proj_b", "fc2_b"):
             p[k] = mp_ops.c_identity(p[k], mp_axis)
-        h = _ln(x, p["ln1_g"], p["ln1_b"])
-        qkv = (mp_ops.ag_matmul(
-            h.astype(cfg.dtype), p["qkv_w"].astype(cfg.dtype), mp_axis,
-            ring=sp.ring,
-            mm=None if fp8 is None else _fp8_mm(fp8, "qkv"))
-            + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
-    qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
-    # registry op: Pallas flash on TPU (the engine's shard_map runs with
-    # check_vma=False, so the kernel traces inside it); composed O(S^2)
-    # fallback elsewhere — heads are fully local under TP, so per-shard
-    # attention is the whole computation (always over the FULL sequence;
-    # only the between-block residual stream is seq-sharded under sp)
-    attn = F.scaled_dot_product_attention(
-        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
-    attn = attn.reshape(B, S, H // mp)
-    if sp is None:
-        out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
-        out = (mp_ops.mp_allreduce(out, mp_axis)
-               + p["proj_b"].astype(cfg.dtype))
-    else:
-        out = (mp_ops.matmul_rs(
-            attn, p["proj_w"].astype(cfg.dtype), mp_axis, ring=sp.ring,
-            mm=None if fp8 is None else _fp8_mm(fp8, "proj"))
-            + p["proj_b"].astype(cfg.dtype))
-    x = x + out
+    x = _attn_sublayer(p, x, cfg, mp_axis, fp8=fp8, sp=sp)
 
     h = _ln(x, p["ln2_g"], p["ln2_b"])
     if sp is None:
@@ -331,6 +424,80 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
             mm=None if fp8 is None else _fp8_mm(fp8, "fc2"))
             + p["fc2_b"].astype(cfg.dtype))
     return x + m
+
+
+def _moe_block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp",
+                  ep_axis: str = "ep", mcfg=None, ef=None):
+    """One MoE transformer block of the hybrid path: the shared TP
+    attention sublayer, then a switch-routed (top-1, capacity-bounded)
+    expert FFN dispatched over the 'ep' mesh axis.
+
+    Routing runs in fp32 on the LOCAL token shard (the gate is replicated
+    over ep/mp, so every rank derives identical slot math for its own
+    tokens); the routed [E, C, D] buffer crosses the ep axis through
+    comm_overlap.a2a.expert_exchange — plain all-to-alls by default,
+    index dispatch / int8-EF wire / chunked overlap per `mcfg`
+    (MoeDispatchConfig). `ef` is this layer's {"disp", "comb"} residual
+    slice when the exchange is quantized.
+
+    Returns (x_out, stats, new_ef): stats = {"aux": switch load-balance
+    loss E*sum(me*ce), "tokens": routed tokens per expert [E] (pre-drop),
+    "kept": tokens that won a capacity slot} — per (layer, microbatch)
+    execution, summed by the callers."""
+    from ..incubate.distributed.models.moe.gate import (
+        _capacity_dispatch, _capacity_dispatch_idx, _one_hot,
+        compute_capacity)
+    from ..incubate.distributed.models.moe.moe_layer import (
+        _index_combine, _index_scatter)
+    from ..distributed.comm_overlap import a2a as _a2a
+
+    x = _attn_sublayer(p, x, cfg, mp_axis)
+    h = _ln(x, p["ln2_g"], p["ln2_b"])
+    B, S, H = h.shape
+    T = B * S
+    E = cfg.moe_num_experts
+    xt = h.reshape(T, H).astype(cfg.dtype)
+    # route in fp32 (the BaseGate.logits discipline: softmax/argmax
+    # numerics matter more than MXU speed on a [T, E] matmul)
+    logits = xt.astype(jnp.float32) @ p["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_val = probs.max(axis=-1)
+    expert = probs.argmax(axis=-1)
+    me = probs.mean(axis=0)
+    ce = _one_hot(expert, E).mean(axis=0)
+    aux = jnp.sum(me * ce) * E  # Switch-Transformer load-balance loss
+    C = compute_capacity(T, E, 1, cfg.moe_capacity_factor)
+    index = mcfg is not None and mcfg.index
+    if index:
+        # zero-flop slot-id dispatch (the reference's CUDA global_scatter
+        # analogue) — saves the 2*T*E*C*D one-hot einsum each way
+        slot, gates, counts = _capacity_dispatch_idx(expert, gate_val, C, E)
+        kept = jnp.sum((slot >= 0).astype(jnp.float32))
+        dispatched, slot_safe = _index_scatter(xt, slot[:, None], E, C)
+    else:
+        combine, keep_tok, counts = _capacity_dispatch(expert, gate_val,
+                                                       C, E)
+        kept = jnp.sum(keep_tok.astype(jnp.float32))
+        dispatched = jnp.einsum("tec,td->ecd",
+                                (combine > 0).astype(xt.dtype), xt)
+
+    def act(m):
+        return jax.nn.gelu(m.astype(jnp.float32),
+                           approximate=True).astype(cfg.dtype)
+
+    returned, new_ef = _a2a.expert_exchange(
+        dispatched, p["w1"].astype(cfg.dtype), p["b1"].astype(cfg.dtype),
+        p["w2"].astype(cfg.dtype), p["b2"].astype(cfg.dtype),
+        ep_axis=ep_axis, mp_axis=mp_axis, activation=act, cfg=mcfg,
+        residuals=ef)
+    if index:
+        y = _index_combine(returned, gates[:, None], slot_safe)
+    else:
+        y = jnp.einsum("tec,ecd->td", combine.astype(returned.dtype),
+                       returned)
+    stats = {"aux": aux, "tokens": counts.astype(jnp.float32),
+             "kept": kept}
+    return x + y.reshape(B, S, H).astype(x.dtype), stats, new_ef
 
 
 def _vocab_parallel_embed(wte_local, tokens, mp_axis: str = "mp"):
@@ -596,10 +763,111 @@ def _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, num_microbatches,
         scatter_bytes=a_full))
 
 
+def _moe_pipeline(params, x_mb, cfg: GPTConfig, M: int, pp_axis, mp_axis,
+                  ep_axis, mcfg, moe_ef):
+    """1F1B pipeline over (dense, MoE) layer pairs with the aux side
+    channel (spmd_pipeline with_aux): returns (out [M, mb, s, H], stats
+    summed over every (layer, microbatch) execution and psum'd over pp,
+    new flat moe_ef residuals or None)."""
+    dense_p = params["blocks"]["dense"]
+    moe_p = params["blocks"]["moe"]
+    l2_local = jax.tree.leaves(dense_p)[0].shape[0]
+    ef_p = None
+    if moe_ef is not None:
+        from ..distributed.comm_overlap import a2a as _a2a
+        from ..incubate.distributed.models.moe.gate import compute_capacity
+        T = x_mb.shape[1] * x_mb.shape[2]
+        ep = lax.axis_size(ep_axis)
+        C = compute_capacity(T, cfg.moe_num_experts, 1,
+                             cfg.moe_capacity_factor)
+        chunks = mcfg.chunks if (mcfg is not None and mcfg.overlap) else 1
+        shapes = _a2a.moe_ef_local_shapes(cfg.moe_num_experts, C,
+                                          cfg.hidden_size, ep, chunks)
+        ef_p = {}
+        for key, shp in shapes.items():
+            want = l2_local * math.prod(shp)
+            enforce(moe_ef[key].size == want,
+                    "moe_ef residual size mismatch: the quantized-a2a "
+                    "residuals were sized at build time from "
+                    "moe_ef_tokens — pass the ACTUAL per-rank "
+                    "(batch, seq) of the training data",
+                    op="gpt.hybrid_loss_fn", leaf=key,
+                    have=int(moe_ef[key].size), want=int(want))
+            ef_p[key] = moe_ef[key].reshape((l2_local,) + shp)
+
+    def stage_fn(bp, h):
+        if moe_ef is not None:
+            pd, pm, efl = bp
+
+            def body(carry, xs):
+                pdl, pml, efll = xs
+                hh = _block_fn(pdl, carry, cfg, mp_axis)
+                hh, st, nef = _moe_block_fn(pml, hh, cfg, mp_axis,
+                                            ep_axis, mcfg, efll)
+                return hh, (st, nef)
+            out, (st, nef) = lax.scan(body, h, (pd, pm, efl))
+        else:
+            pd, pm = bp
+
+            def body(carry, xs):
+                pdl, pml = xs
+                hh = _block_fn(pdl, carry, cfg, mp_axis)
+                hh, st, _ = _moe_block_fn(pml, hh, cfg, mp_axis,
+                                          ep_axis, mcfg, None)
+                return hh, st
+            out, st = lax.scan(body, h, (pd, pm))
+            nef = ()
+        return out, {"stats": jax.tree.map(lambda a: a.sum(axis=0), st),
+                     "ef": nef}
+
+    stage_args = ((dense_p, moe_p) if moe_ef is None
+                  else (dense_p, moe_p, ef_p))
+    out, aux = spmd_pipeline(stage_fn, stage_args, x_mb, axis=pp_axis,
+                             with_aux=True)
+    new_ef = None
+    if moe_ef is not None:
+        new_ef = {k: v.reshape(-1) for k, v in aux["ef"].items()}
+    return out, aux["stats"], new_ef
+
+
+def _note_moe_wire(cfg: GPTConfig, tokens, mp_axis, pp_axis, ep_axis,
+                   num_microbatches: int, n_pairs_local: int, mcfg):
+    """Analytic per-step wire deposits for the GPT-MoE hybrid loss
+    (trace-time constants): the mp term — a (dense, MoE) pair costs the
+    dense layer's 2 column/row GEMM pairs plus the MoE attention's 1,
+    and the expert FFN's forward-only mp all-reduce of the arrived
+    [E, C, D] buffer — via note_mp_comm, and the ep dispatch/combine
+    all-to-all term via note_ep_comm. The telemetry tests re-derive both
+    independently (the PR 5 pattern)."""
+    from ..incubate.distributed.models.moe.gate import compute_capacity
+    from ..observability import metrics as _metrics
+    mp = lax.axis_size(mp_axis)
+    P_ = lax.axis_size(pp_axis)
+    ep = lax.axis_size(ep_axis)
+    b_local, S = tokens.shape
+    M = num_microbatches
+    dt = jnp.dtype(cfg.dtype).itemsize
+    H, E = cfg.hidden_size, cfg.moe_num_experts
+    C = compute_capacity((b_local // M) * S, E, 1, cfg.moe_capacity_factor)
+    a_blk = (b_local // M) * S * H * dt
+    a_full = b_local * S * H * dt
+    executed = (M + P_ - 1) * n_pairs_local
+    _metrics.note_mp_comm("allreduce", _metrics.mp_wire_bytes(
+        "allreduce", mp,
+        gemm_pair_bytes=3.0 * executed * a_blk,
+        allreduce_bytes=(2.0 * a_full + 4.0 * b_local * S * 4
+                         + executed * float(E * C * H * dt))))
+    _metrics.note_ep_comm(_metrics.ep_a2a_wire_bytes(
+        ep, payload_elems=float(E * C * H),
+        n_layer_executions=float(executed), itemsize=dt,
+        quantize=bool(mcfg is not None and mcfg.quantize)))
+
+
 def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1,
-                   schedule: str = "1F1B", fp8=None, sp=None):
+                   schedule: str = "1F1B", fp8=None, sp=None,
+                   ep_axis="ep", moe=None, moe_ef=None):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
@@ -615,6 +883,18 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     pp ppermutes, whose transfers shrink mp-fold too) are seq-sharded
     over mp; the LM head becomes an ag_matmul and the embedding output is
     seq-scattered. Requires S % mp == 0.
+
+    GPT-MoE (cfg.moe_num_experts > 0): the batch is sharded over dp AND
+    ep (b_local is the per-(dp, ep)-rank shard), every second layer runs
+    the expert FFN over the ep axis, the switch load-balance loss rides
+    the pipeline's aux channel (spmd_pipeline with_aux) weighted by
+    cfg.moe_aux_weight, and the final loss pmean spans (dp, ep). moe: a
+    comm_overlap.MoeDispatchConfig (or None for the dense-dispatch
+    baseline); moe_ef: this rank's flat {"disp", "comb"} int8
+    error-feedback residuals when the exchange is quantized — the return
+    value then becomes (loss, new_moe_ef). 1F1B only; not composed with
+    fp8 or sequence parallelism (the MoE block runs the
+    replicated-activation TP path).
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -625,6 +905,17 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
             "fp8 delayed scaling supports the 1F1B schedule only",
             op="gpt.hybrid_loss_fn", virtual_pp=virtual_pp,
             schedule=schedule)
+    moe_on = cfg.moe_on
+    if moe_on:
+        enforce(fp8 is None and sp is None,
+                "the GPT-MoE hybrid path is not composed with fp8 delayed "
+                "scaling or sequence parallelism (the MoE block runs the "
+                "replicated-activation TP path)", op="gpt.hybrid_loss_fn")
+        enforce(virtual_pp == 1 and schedule == "1F1B",
+                "GPT-MoE supports the 1F1B schedule only (the aux channel "
+                "and expert stacking follow the plain pipeline layout)",
+                op="gpt.hybrid_loss_fn", virtual_pp=virtual_pp,
+                schedule=schedule)
     from ..distributed.comm_overlap import collective_matmul as _cm
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x + params["wpe"][None, :S]
@@ -637,33 +928,38 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         x = _cm.scatter_seq(x, mp_axis, dim=1)  # [b_local, S/mp, H]
     x_mb = x.reshape(M, b_local // M, x.shape[1], cfg.hidden_size)
 
-    def stage_fn(block_params, h):
-        if fp8 is not None:
-            blocks, scales = block_params
+    moe_stats = None
+    if moe_on:
+        out, moe_stats, new_moe_ef = _moe_pipeline(
+            params, x_mb, cfg, M, pp_axis, mp_axis, ep_axis, moe, moe_ef)
+    else:
+        def stage_fn(block_params, h):
+            if fp8 is not None:
+                blocks, scales = block_params
 
-            def body(carry, pf):
-                p, f = pf
-                return _block_fn(p, carry, cfg, mp_axis, fp8=f,
-                                 sp=sp), None
-            out, _ = lax.scan(body, h, (blocks, scales))
+                def body(carry, pf):
+                    p, f = pf
+                    return _block_fn(p, carry, cfg, mp_axis, fp8=f,
+                                     sp=sp), None
+                out, _ = lax.scan(body, h, (blocks, scales))
+                return out
+
+            def body(carry, p):
+                return _block_fn(p, carry, cfg, mp_axis, sp=sp), None
+            out, _ = lax.scan(body, h, block_params)
             return out
 
-        def body(carry, p):
-            return _block_fn(p, carry, cfg, mp_axis, sp=sp), None
-        out, _ = lax.scan(body, h, block_params)
-        return out
-
-    stage_params = (params["blocks"] if fp8 is None
-                    else (params["blocks"], fp8))
-    if virtual_pp > 1:
-        out = spmd_pipeline_interleaved(
-            stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
-            axis=pp_axis)
-    elif schedule == "ZBH1":
-        out = spmd_pipeline_zero_bubble(stage_fn, params["blocks"], x_mb,
-                                        axis=pp_axis)
-    else:
-        out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
+        stage_params = (params["blocks"] if fp8 is None
+                        else (params["blocks"], fp8))
+        if virtual_pp > 1:
+            out = spmd_pipeline_interleaved(
+                stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp),
+                x_mb, axis=pp_axis)
+        elif schedule == "ZBH1":
+            out = spmd_pipeline_zero_bubble(stage_fn, params["blocks"],
+                                            x_mb, axis=pp_axis)
+        else:
+            out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
     out = out.reshape(b_local, x.shape[1], cfg.hidden_size)
     from ..distributed.fleet.layers.mpu import mp_ops
     lnf_g, lnf_b = params["lnf_g"], params["lnf_b"]
@@ -684,12 +980,50 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         logits_local = mp_ops.ag_matmul(
             out.astype(cfg.dtype), params["head_w"].astype(cfg.dtype),
             mp_axis, ring=sp.ring)
-    _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
-                  jax.tree.leaves(params["blocks"])[0].shape[0],
-                  virtual_pp=virtual_pp)
+    if moe_on:
+        _note_moe_wire(cfg, tokens, mp_axis, pp_axis, ep_axis, M,
+                       jax.tree.leaves(params["blocks"]["dense"])[0]
+                       .shape[0], moe)
+    else:
+        _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
+                      jax.tree.leaves(params["blocks"])[0].shape[0],
+                      virtual_pp=virtual_pp)
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    if moe_on:
+        from ..observability import metrics as _metrics
+        L2 = cfg.num_layers // 2
+        # aux summed over every (layer, microbatch) execution -> mean
+        aux_mean = moe_stats["aux"] / float(L2 * M)
+        total = total + jnp.float32(cfg.moe_aux_weight) * aux_mean
+        routed = float(L2 * M) * float((b_local // M) * S)
+        _metrics.observe("moe_drop_frac",
+                         1.0 - moe_stats["kept"] / routed)
+        if cfg.moe_num_experts <= 32:
+            for i in range(cfg.moe_num_experts):
+                _metrics.observe(f"moe_tokens_e{i}",
+                                 moe_stats["tokens"][i])
+        # the batch is sharded over dp AND ep — the loss mean spans both
+        total = lax.pmean(total, (dp_axis, ep_axis))
+        if moe_ef is not None:
+            return total, new_moe_ef
+        return total
     return lax.pmean(total, dp_axis)
+
+
+def moe_telemetry_series(cfg: GPTConfig):
+    """Telemetry series the GPT-MoE hybrid loss observes: the
+    capacity-drop fraction plus (for expert counts small enough to chart)
+    one routed-tokens series per expert. build_hybrid_train_step
+    registers these onto an explicitly-passed TelemetryConfig; flag-driven
+    telemetry registers them via FLAGS_telemetry_extra."""
+    if not cfg.moe_on:
+        return ()
+    series = ("moe_drop_frac",)
+    if cfg.moe_num_experts <= 32:
+        series += tuple(f"moe_tokens_e{i}"
+                        for i in range(cfg.moe_num_experts))
+    return series
 
 
 def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
@@ -699,7 +1033,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             grad_reduce_dtype="auto",
                             zero1_dp: bool = False, comm_overlap="auto",
                             fp8="auto", telemetry="auto",
-                            mp_overlap="auto"):
+                            mp_overlap="auto", ep_axis="ep",
+                            moe_dispatch="auto", moe_ef_tokens=None):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -733,11 +1068,25 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     per-chunk GEMMs would sum partial amax observations — seq_parallel
     itself composes with fp8 fine: the site GEMMs see the gathered
     full-sequence input exactly as the allreduce path does).
+
+    GPT-MoE (cfg.moe_num_experts > 0): the mesh must carry an `ep_axis`
+    (degree >= 1, dividing the expert count); the batch shards over
+    dp x ep and every second layer's FFN becomes the switch-routed
+    expert bank exchanged over ep. moe_dispatch: "auto" reads
+    FLAGS_moe_index_dispatch / FLAGS_moe_quantize_a2a / FLAGS_moe_overlap
+    (all off = the dense-dispatch plain-exchange baseline, which
+    compiles BITWISE-identically to an explicit moe_dispatch=None
+    build); a comm_overlap.MoeDispatchConfig forces. The quantized
+    exchange threads int8 error-feedback residuals through
+    opt_state["moe_ef"] and needs moe_ef_tokens=(per-rank batch, seq)
+    to size them at build time (pp degree 1, one pipeline microbatch).
+    Not composed with fp8, sequence parallelism, VPP or ZBH1.
     """
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
     from ..distributed.comm_overlap.collective_matmul import \
         resolve_mp_overlap
+    from ..distributed.comm_overlap.a2a import resolve_moe_dispatch
 
     sp = resolve_mp_overlap(mp_overlap)
     fp8_plan = _f8.resolve_fp8_plan(
@@ -754,6 +1103,90 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                 "permutation)", op="gpt.build_hybrid_train_step",
                 virtual_pp=virtual_pp, schedule=schedule)
 
+    moe_on = cfg.moe_on
+    mcfg = resolve_moe_dispatch(moe_dispatch) if moe_on else None
+    moe_plan = None
+    if moe_on:
+        from ..distributed.comm_overlap import a2a as _a2a
+        from ..incubate.distributed.models.moe.gate import compute_capacity
+        from .. import observability as _obs
+        enforce(ep_axis in mesh.axis_names,
+                "GPT-MoE shards the expert bank over an expert-parallel "
+                f"mesh axis: add '{ep_axis}' (degree >= 1) to the mesh",
+                op="gpt.build_hybrid_train_step",
+                axes=tuple(mesh.axis_names))
+        ep = int(mesh.shape[ep_axis])
+        E = cfg.moe_num_experts
+        enforce(E % ep == 0, "the ep degree must divide the expert count",
+                op="gpt.build_hybrid_train_step", experts=E, ep=ep)
+        enforce(cfg.ffn_hidden % int(mesh.shape[mp_axis]) == 0,
+                "the expert hidden dim shards over mp",
+                op="gpt.build_hybrid_train_step",
+                ffn_hidden=cfg.ffn_hidden, mp=int(mesh.shape[mp_axis]))
+        enforce(fp8_plan is None and sp is None,
+                "the GPT-MoE hybrid path is not composed with fp8 "
+                "delayed scaling or sequence parallelism — disable "
+                "FLAGS_fp8 / FLAGS_mp_seq_parallel",
+                op="gpt.build_hybrid_train_step")
+        enforce(virtual_pp == 1 and schedule == "1F1B",
+                "GPT-MoE supports the 1F1B schedule only",
+                op="gpt.build_hybrid_train_step", virtual_pp=virtual_pp,
+                schedule=schedule)
+        ef = None
+        if mcfg is not None and mcfg.quantize:
+            enforce(int(mesh.shape[pp_axis]) == 1
+                    and num_microbatches == 1,
+                    "moe_quantize_a2a threads ONE error-feedback "
+                    "residual slot per MoE layer per step; pipeline "
+                    "microbatching would sum residuals across "
+                    "microbatches — use pp degree 1 and "
+                    "num_microbatches 1",
+                    op="gpt.build_hybrid_train_step",
+                    pp=int(mesh.shape[pp_axis]),
+                    num_microbatches=num_microbatches)
+            enforce(moe_ef_tokens is not None,
+                    "moe_quantize_a2a sizes the residual state at build "
+                    "time: pass moe_ef_tokens=(per-rank batch, seq)",
+                    op="gpt.build_hybrid_train_step")
+            b_loc, s_ef = moe_ef_tokens
+            C = compute_capacity(int(b_loc) * int(s_ef), E, 1,
+                                 cfg.moe_capacity_factor)
+            chunks = mcfg.chunks if mcfg.overlap else 1
+            shapes = _a2a.moe_ef_local_shapes(E, C, cfg.hidden_size, ep,
+                                              chunks)
+            L2 = cfg.num_layers // 2
+            n_dev = int(mesh.devices.size)
+            sizes = {k: L2 * math.prod(s) for k, s in shapes.items()}
+            ef = {
+                "init": (lambda: {k: jnp.zeros((n_dev * sz,), jnp.float32)
+                                  for k, sz in sizes.items()}),
+                "specs": {k: P(tuple(mesh.axis_names)) for k in sizes},
+            }
+        moe_plan = {
+            "ep_axis": ep_axis, "ef": ef,
+            "meta": {"ep": ep, "experts": E,
+                     "dispatch": ("index" if (mcfg is not None
+                                              and mcfg.index)
+                                  else "dense"),
+                     "quantize": bool(mcfg is not None and mcfg.quantize),
+                     "overlap": bool(mcfg is not None and mcfg.overlap)},
+        }
+        if isinstance(telemetry, _obs.TelemetryConfig):
+            # register the MoE series on the caller's config (in place —
+            # their TelemetryHost decodes from the same object; build the
+            # engine before constructing the host)
+            telemetry.extra = telemetry.extra + tuple(
+                s for s in moe_telemetry_series(cfg)
+                if s not in telemetry.extra)
+
+    if moe_plan is not None and moe_plan["ef"] is not None:
+        def loss_fn(p, tokens, labels, moe_ef):
+            return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
+                                  dp_axis, pp_axis, mp_axis,
+                                  virtual_pp=virtual_pp, schedule=schedule,
+                                  sp=sp, ep_axis=ep_axis, moe=mcfg,
+                                  moe_ef=moe_ef)
+    elif fp8_plan is not None:
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
@@ -764,16 +1197,17 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
-                                  sp=sp)
+                                  sp=sp, ep_axis=ep_axis, moe=mcfg)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
+        data_spec=(P((dp_axis, ep_axis)) if moe_on else None),
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
         comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry,
-        mp_overlap=sp)
+        mp_overlap=sp, moe=moe_plan)
     # elastic-checkpoint hint (checkpoint.reshard): the stacked-[L] block
     # leaves' STORAGE order is (pp, vpp)-dependent under the interleaved
     # schedule; resume onto a different layout permutes them (fp8_meta's

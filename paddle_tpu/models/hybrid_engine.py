@@ -199,7 +199,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
                      comm_overlap="auto", fp8=None, telemetry="auto",
-                     mp_overlap=None, donate: bool = False):
+                     mp_overlap=None, moe=None, donate: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -281,7 +281,28 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     build-time constant (activation shapes appear at trace time), so the
     models deposit them through observability.note_mp_comm inside the
     loss trace; the engine opens the collecting scope around the step
-    body and folds the value into the comms_bytes telemetry series."""
+    body and folds the value into the comms_bytes telemetry series.
+
+    moe: expert-parallelism plan from a MoE model builder —
+    {"ep_axis": mesh axis the expert bank shards over, "ef": None or
+    {"init", "specs"} for the quantized-a2a error-feedback residuals,
+    "meta": build metadata for the telemetry header}. The engine then
+    (a) ep-synchronizes gradients with SPEC-AWARE semantics: leaves
+    whose PartitionSpec carries the ep axis (the expert bank) already
+    hold the COMPLETE sum of the ep group's token contributions via the
+    transposed all-to-all and only rescale by 1/ep, while every other
+    leaf is replicated over ep with PARTIAL local-shard grads and
+    pmeans; (b) threads the residuals as opt_state["moe_ef"] — the loss
+    then takes a fourth arg (the flat residual tree) and returns
+    (loss, new_residuals), exactly the comm_ef/fp8_meta carry
+    discipline; (c) counts the ep sync and the model-deposited a2a wire
+    bytes (observability.note_ep_comm) into the comms_bytes telemetry
+    series. The replication-aware global-norm clip and the telemetry
+    grad-norm need NO MoE special-casing: _repl_factor reads the specs,
+    so expert leaves count once per distinct element automatically.
+    Not composed with fp8; the "ef" form is not composed with
+    comm_overlap (the overlap scan calls the loss once per comm
+    microbatch — residual slots are per step)."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -352,6 +373,30 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     "partial amax observations — use seq_parallel with "
                     "fp8, or disable one of the two",
                     op="build_train_step")
+    # -- expert parallelism (MoE plan from the model builder) ----------------
+    moe_plan = moe
+    ep_axis = None
+    ep_n = 1
+    if moe_plan is not None:
+        from ..enforce import enforce
+        ep_axis = moe_plan["ep_axis"]
+        enforce(ep_axis in mesh.axis_names,
+                f"the MoE plan names ep axis '{ep_axis}' which the mesh "
+                "does not define", op="build_train_step",
+                axes=tuple(mesh.axis_names))
+        ep_n = int(mesh.shape[ep_axis])
+        enforce(fp8_plan is None,
+                "fp8 delayed scaling is not composed with the MoE plan "
+                "(the expert scan's stacking differs from the fp8 scale "
+                "threading) — disable one of the two",
+                op="build_train_step")
+        if moe_plan.get("ef") is not None:
+            enforce(ocfg is None,
+                    "moe_quantize_a2a threads ONE error-feedback "
+                    "residual slot per step; the comm_overlap scan calls "
+                    "the loss once per comm microbatch and would sum "
+                    "residuals — disable FLAGS_comm_* or "
+                    "FLAGS_moe_quantize_a2a", op="build_train_step")
     # -- in-program telemetry (observability) --------------------------------
     from .. import observability as _obs
     tcfg = _obs.telemetry_from_flags() if telemetry == "auto" else telemetry
@@ -362,10 +407,12 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         tcfg.static["mesh"] = {a: int(mesh.shape[a])
                                for a in mesh.axis_names}
         for k in ("comm_buckets_bytes", "comm_quantize",
-                  "comm_microbatches", "mp_mode"):
+                  "comm_microbatches", "mp_mode", "moe"):
             tcfg.static.pop(k, None)
         if mp_mode is not None:
             tcfg.static["mp_mode"] = mp_mode
+        if moe_plan is not None:
+            tcfg.static["moe"] = dict(moe_plan.get("meta", {}))
         if ocfg is not None and example_params is not None:
             # per-bucket wire bytes from the bucket plan over the LOCAL
             # grad shapes (the int8 path's residual plan IS this plan)
@@ -385,6 +432,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         wrap_specs["comm_ef"] = _co.ef_residual_specs(ef_plan, mesh)
     if fp8_plan is not None:
         wrap_specs["fp8_meta"] = fp8_plan["specs"]
+    if moe_plan is not None and moe_plan.get("ef") is not None:
+        wrap_specs["moe_ef"] = moe_plan["ef"]["specs"]
     if tcfg is not None:
         wrap_specs["telemetry"] = _obs.buffer_specs(tcfg)
     if wrap_specs:
@@ -412,6 +461,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         }
     if fp8_plan is not None:
         layout_extra["carries"]["fp8_meta"] = "follow"
+    if moe_plan is not None and moe_plan.get("ef") is not None:
+        # a2a residuals are per-rank rounding errors of a mesh-shaped
+        # exchange — any topology change invalidates them
+        layout_extra["carries"]["moe_ef"] = "reset_on_mismatch"
     if tcfg is not None:
         layout_extra["carries"]["telemetry"] = "reinit"
 
@@ -429,6 +482,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             extras["fp8_meta"] = jax.tree.map(
                 lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
                 fp8_plan["init"](), fp8_plan["specs"])
+        if moe_plan is not None and moe_plan.get("ef") is not None:
+            extras["moe_ef"] = jax.tree.map(
+                lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+                moe_plan["ef"]["init"](), moe_plan["ef"]["specs"])
         if tcfg is not None:
             extras["telemetry"] = jax.tree.map(
                 lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
@@ -562,6 +619,34 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                  "slots": jax.tree.unflatten(treedef, new_s)},
                 tele)
 
+    def _ep_sync(grads):
+        """MoE ep-axis gradient combine (spec-aware): expert leaves
+        (PartitionSpec carries the ep axis) already hold the COMPLETE
+        sum of the ep group's token contributions — the transposed
+        all-to-all delivered every visiting token's cotangent — so they
+        only rescale by 1/ep (the pmean's divisor without its psum);
+        every other leaf is replicated over ep and its local-shard grad
+        is PARTIAL -> pmean. Runs BEFORE the dp sync in every grad path
+        (monolithic / overlap scan / zero1)."""
+        if moe_plan is None or ep_n <= 1:
+            return grads
+
+        def one(g, sp):
+            if ep_axis in _spec_axes(sp):
+                return (g / ep_n).astype(g.dtype)
+            return lax.pmean(g, ep_axis)
+
+        if tcfg is not None and tele_comms["ep"] is None:
+            td = jax.tree.structure(grads)
+            f = 2.0 * (ep_n - 1) / ep_n
+            mult = ocfg.microbatches if ocfg is not None else 1
+            tele_comms["ep"] = mult * sum(
+                f * g.size * jnp.dtype(g.dtype).itemsize
+                for g, sp in zip(td.flatten_up_to(grads),
+                                 td.flatten_up_to(specs))
+                if ep_axis not in _spec_axes(sp))
+        return jax.tree.map(one, grads, specs)
+
     def _overlap_bytes(g_leaves, z_leaves, wire_dtype):
         """Trace-time dp wire bytes of ONE microbatch's overlap reduction
         (ring accounting, same tables as fleet.collective_perf)."""
@@ -594,6 +679,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                       else grad_reduce_dtype)
 
         def reduce_fn(g, res):
+            g = _ep_sync(g)
             if extra_axes:
                 # sep/context-parallel partial grads combine in their own
                 # dtype, exactly as the monolithic path does
@@ -632,10 +718,11 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                lr)
 
     def _local_step(mp_cell, params, opt_state, tokens, labels, lr):
-        ef = fmeta = tbuf = None
+        ef = fmeta = tbuf = mef = None
         if wrap_specs:
             ef = opt_state.get("comm_ef")
             fmeta = opt_state.get("fp8_meta")
+            mef = opt_state.get("moe_ef")
             tbuf = opt_state.get("telemetry")
             opt_state = opt_state["opt"]
 
@@ -664,13 +751,16 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 vals["loss"] = loss
                 vals["grad_norm"] = jnp.sqrt(tele["grad_sq"])
                 vals["nonfinite_count"] = tele["nonfinite"]
-                # mp bytes are per loss CALL — the overlap scan calls the
-                # loss once per comm microbatch on the split batch
+                # mp/ep a2a bytes are per loss CALL — the overlap scan
+                # calls the loss once per comm microbatch on the split
+                # batch
                 mp_calls = ocfg.microbatches if ocfg is not None else 1
                 vals["comms_bytes"] = ((tele_comms["reduce"] or 0.0)
                                        + (tele_comms["zero1"] or 0.0)
+                                       + (tele_comms["ep"] or 0.0)
                                        + mp_calls
-                                       * mp_cell.get("wire_bytes", 0.0))
+                                       * (mp_cell.get("wire_bytes", 0.0)
+                                          + mp_cell.get("ep_bytes", 0.0)))
                 if fp8_plan is not None and amax is not None:
                     vals["fp8_amax_max"] = jnp.stack(
                         [jnp.max(a) for a in jax.tree.leaves(amax)]).max()
@@ -684,6 +774,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     w["comm_ef"] = new_ef
                 if fp8_plan is not None:
                     w["fp8_meta"] = new_fmeta
+                if moe_plan is not None and moe_plan.get("ef") is not None:
+                    # reads the enclosing `mef`, which the moe-ef branch
+                    # rebinds to the loss's new residuals before exiting
+                    w["moe_ef"] = mef
                 if tcfg is not None:
                     w["telemetry"] = new_tbuf
                 new_state = w
@@ -724,6 +818,29 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                                           opt_state, lr)
                 return rewrap(new_params, new_state, ef, fmeta, loss,
                               tele=z1t, amax=amax, obs=obs)
+        elif moe_plan is not None and moe_plan.get("ef") is not None:
+            # quantized-a2a MoE: the residuals ride in as a loss arg and
+            # the refreshed residuals ride out as an aux output — the
+            # fp8_meta discipline with aux instead of cotangents (the
+            # residual is a forward-side value, not a gradient)
+            mef_loss = lambda p: loss_fn(p, tokens, labels, mef)
+            if tcfg is not None:
+                def mef_loss_obs(p):
+                    with _obs.collecting() as sink:
+                        l, nef = mef_loss(p)
+                    return l, (nef, _obs.metrics.obs_dict(sink))
+                (loss, (new_mef, obs)), grads = jax.value_and_grad(
+                    mef_loss_obs, has_aux=True)(params)
+            else:
+                (loss, new_mef), grads = jax.value_and_grad(
+                    mef_loss, has_aux=True)(params)
+            mef = new_mef
+            grads = _ep_sync(grads)
+            if zero1_dp:
+                new_params, new_state, z1t = _zero1_apply(params, grads,
+                                                          opt_state, lr)
+                return rewrap(new_params, new_state, ef, fmeta, loss,
+                              tele=z1t, obs=obs)
         else:
             plain_loss = lambda p: loss_fn(p, tokens, labels)
             if tcfg is not None:
@@ -735,6 +852,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     plain_loss_obs, has_aux=True)(params)
             else:
                 loss, grads = jax.value_and_grad(plain_loss)(params)
+            grads = _ep_sync(grads)
             if zero1_dp:
                 new_params, new_state, z1t = _zero1_apply(params, grads,
                                                           opt_state, lr)
@@ -843,7 +961,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     # pmean / overlap scan / zero1 pass 1), "zero1" by the param
     # all-gather; a retrace re-derives identical values (grad shapes do
     # not depend on the batch), so the idempotent set is safe
-    tele_comms = {"reduce": None, "zero1": None}
+    tele_comms = {"reduce": None, "zero1": None, "ep": None}
     step = _shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, sspec, data_spec, data_spec, P()),

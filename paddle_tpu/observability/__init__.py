@@ -32,8 +32,9 @@ from .flops import (collective_seconds, gpt_flops_per_token,
                     llama_flops_per_token, mfu, param_count, peak_flops,
                     plan_wire_bytes, transformer_flops_per_token)
 from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
-                      buffer_specs, collecting, init_buffer, mp_comm_scope,
-                      mp_wire_bytes, note_mp_comm, observe,
+                      buffer_specs, collecting, ep_a2a_wire_bytes,
+                      init_buffer, mp_comm_scope, mp_wire_bytes,
+                      note_ep_comm, note_mp_comm, observe,
                       telemetry_from_flags, update_buffer)
 from .prom import MetricsServer, PromRegistry, serve_registry
 from .step_timer import StepTimer
@@ -43,6 +44,7 @@ __all__ = [
     "TelemetryConfig", "TelemetryHost", "telemetry_from_flags", "observe",
     "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
     "update_buffer", "mp_wire_bytes", "note_mp_comm", "mp_comm_scope",
+    "ep_a2a_wire_bytes", "note_ep_comm",
     "StepTimer",
     "gpt_flops_per_token", "llama_flops_per_token",
     "transformer_flops_per_token", "param_count", "mfu", "peak_flops",

@@ -39,7 +39,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = ["TelemetryConfig", "telemetry_from_flags", "observe",
            "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
            "update_buffer", "TelemetryHost", "mp_wire_bytes",
-           "note_mp_comm", "mp_comm_scope"]
+           "note_mp_comm", "mp_comm_scope", "ep_a2a_wire_bytes",
+           "note_ep_comm"]
 
 # always-present builtin slots (fp8 slots stay 0.0 when fp8 is off) — a
 # FIXED tuple so host decode needs only the config, never the engine
@@ -137,7 +138,50 @@ def mp_wire_bytes(mode: Optional[str], mp: int, *,
     return total
 
 
+def ep_a2a_wire_bytes(ep: int, *, payload_elems: float,
+                      n_layer_executions: float, itemsize: int,
+                      quantize: bool = False) -> float:
+    """Analytic per-rank ep-axis wire bytes of ONE train step's MoE
+    dispatch/combine all-to-alls (ring accounting, forward + backward),
+    shared by the engine's telemetry and the tests' expected values.
+
+    payload_elems: elements of ONE exchange payload per layer execution —
+        E_global * capacity * d_model (the [E, C, D] buffer; dispatch and
+        combine move the same count, chunking only re-slices it).
+    n_layer_executions: MoE-layer executions per rank per step —
+        (M + pp - 1) * L_moe_local for the 1F1B pipeline (bubble ticks
+        exchange real bytes too), L_moe for pp = 1.
+    itemsize: bytes per element of the unquantized payload (the compute
+        dtype's).
+    quantize: forward dispatch+combine cross the wire as int8 codes
+        (1 byte/elem); the backward cotangent all-to-alls stay at
+        `itemsize` either way. The per-rank scale all-gather (4 bytes per
+        peer per transfer) is noise and not counted.
+
+    Each all-to-all moves (ep-1)/ep of its payload off-rank; one step
+    pays 2 forward transfers (dispatch + combine) and 2 backward
+    (their transposes).
+    """
+    if ep <= 1:
+        return 0.0
+    f = (ep - 1) / ep
+    fwd_item = 1 if quantize else itemsize
+    per_exec = 2.0 * f * payload_elems * fwd_item \
+        + 2.0 * f * payload_elems * itemsize
+    return n_layer_executions * per_exec
+
+
 _MP_COMM = threading.local()
+
+
+def note_ep_comm(wire_bytes: float) -> None:
+    """Deposit a model's analytic ep-axis (MoE all-to-all) wire bytes
+    from inside its loss trace — the expert-parallel sibling of
+    note_mp_comm, folded into the same comms_bytes builtin by the engine.
+    Inert unless an engine has a scope open; last write wins."""
+    cell = getattr(_MP_COMM, "cell", None)
+    if cell is not None:
+        cell["ep_bytes"] = float(wire_bytes)
 
 
 def note_mp_comm(mode: Optional[str], wire_bytes: float) -> None:

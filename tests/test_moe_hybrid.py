@@ -1,0 +1,524 @@
+"""GPT-MoE inside the hybrid engine (ISSUE 9): the ep mesh axis composed
+with dp/mp(+pp)/zero1, index vs dense dispatch, the int8 error-feedback
+overlapped all-to-all, MoE-aware global clipping, and the telemetry wire
+model.
+
+Parity anchor: a dense single-device reference of the SAME math —
+alternating dense/MoE layer pairs, switch top-1 routing computed per
+(dp x ep rank, microbatch) token shard so the load-balance aux matches
+the sharded run's, drop-free capacity so slot assignment cannot matter.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.enforce import EnforceNotMet
+from paddle_tpu.distributed.comm_overlap import MoeDispatchConfig
+from paddle_tpu.models import gpt as G
+
+CFG = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                  max_seq_len=16, dtype=jnp.float32, moe_num_experts=4,
+                  moe_capacity_factor=8.0, moe_aux_weight=1e-2)
+LR = jnp.float32(1e-2)
+
+
+def _data(batch=8, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randint(0, CFG.vocab_size, (batch, seq))),
+            jnp.asarray(rng.randint(0, CFG.vocab_size, (batch, seq))))
+
+
+def _run(mesh_dims, steps=4, M=1, cfg=CFG, state_hook=None, lr=LR, **kw):
+    mesh = dist.build_mesh(mesh_dims)
+    opt = kw.pop("opt", None) or paddle.optimizer.AdamW(1e-2)
+    step, shard, init = G.build_hybrid_train_step(
+        cfg, mesh, opt, num_microbatches=M, **kw)
+    p = shard(G.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    s = init(p)
+    tokens, labels = _data()
+    out = []
+    for _ in range(steps):
+        if state_hook is not None:
+            s = state_hook(s)
+        p, s, loss = step(p, s, tokens, labels, lr)
+        out.append(float(loss))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dense single-device reference (same math, shard-matched aux)
+# ---------------------------------------------------------------------------
+def _attn_ref(p, x, cfg):
+    B, S, H = x.shape
+    h = G._ln(x, p["ln1_g"], p["ln1_b"])
+    qkv = (h @ p["qkv_w"] + p["qkv_b"]).reshape(B, S, cfg.num_heads, 3,
+                                                cfg.head_dim)
+    attn = G._attention(qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+    return x + attn.reshape(B, S, H) @ p["proj_w"] + p["proj_b"]
+
+
+def _dense_block_ref(p, x, cfg):
+    x = _attn_ref(p, x, cfg)
+    h = G._ln(x, p["ln2_g"], p["ln2_b"])
+    m = jax.nn.gelu((h @ p["fc1_w"] + p["fc1_b"]).astype(jnp.float32),
+                    approximate=True)
+    return x + (m @ p["fc2_w"] + p["fc2_b"])
+
+
+def _moe_block_ref(p, x, cfg, shard_slices):
+    """Drop-free switch MoE on the full batch: every expert applied to
+    every token, the routed one selected — exact vs the capacity path
+    when nothing drops. aux computed PER SHARD SLICE of the flattened
+    token axis (= the sharded run's per-(rank, microbatch) gates)."""
+    E = cfg.moe_num_experts
+    x = _attn_ref(p, x, cfg)
+    h = G._ln(x, p["ln2_g"], p["ln2_b"])
+    B, S, H = h.shape
+    xt = h.reshape(B * S, H)
+    probs = jax.nn.softmax(xt.astype(jnp.float32) @ p["gate_w"], axis=-1)
+    gate_val = probs.max(axis=-1)
+    expert = probs.argmax(axis=-1)
+    auxes = []
+    for sl in shard_slices:
+        me = probs[sl].mean(axis=0)
+        ce = jax.nn.one_hot(expert[sl], E, dtype=jnp.float32).mean(axis=0)
+        auxes.append(jnp.sum(me * ce) * E)
+    h1 = jax.nn.gelu(
+        (jnp.einsum("td,edf->tef", xt, p["w1"])
+         + p["b1"][None]).astype(jnp.float32),
+        approximate=True)
+    ye = jnp.einsum("tef,efd->ted", h1, p["w2"]) + p["b2"][None]
+    y = jnp.take_along_axis(ye, expert[:, None, None], axis=1)[:, 0]
+    y = gate_val[:, None] * y
+    return x + y.reshape(B, S, H), jnp.stack(auxes)
+
+
+def dense_moe_loss_ref(params, tokens, labels, cfg, n_shards: int, M: int):
+    """Reference loss = CE mean + aux_weight * mean over every
+    (shard, microbatch, layer) aux — exactly the hybrid aggregation."""
+    B, S = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][None, :S]
+    b_sh = B // n_shards
+    mb = b_sh // M
+    slices = []
+    for r in range(n_shards):
+        for m in range(M):
+            lo = (r * b_sh + m * mb) * S
+            slices.append(np.arange(lo, lo + mb * S))
+    auxes = []
+    L2 = cfg.num_layers // 2
+    for l in range(L2):
+        pd = jax.tree.map(lambda a: a[l], params["blocks"]["dense"])
+        pm = jax.tree.map(lambda a: a[l], params["blocks"]["moe"])
+        x = _dense_block_ref(pd, x, cfg)
+        x, aux = _moe_block_ref(pm, x, cfg, slices)
+        auxes.append(aux)
+    x = G._ln(x, params["lnf_g"], params["lnf_b"])
+    logits = x @ params["head_w"]
+    ce = paddle.nn.functional.cross_entropy(logits, labels,
+                                            reduction="none")
+    aux_mean = jnp.stack(auxes).mean()
+    return jnp.mean(ce) + jnp.float32(cfg.moe_aux_weight) * aux_mean
+
+
+# ---------------------------------------------------------------------------
+# Parity vs the dense reference
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mesh_dims,M", [
+    ({"dp": 2, "ep": 2, "pp": 1, "mp": 2}, 1),
+    ({"dp": 1, "ep": 2, "pp": 2, "mp": 2}, 2),
+], ids=["dp2ep2mp2", "ep2pp2mp2"])
+def test_moe_hybrid_matches_dense_ref(mesh_dims, M):
+    """ep-in-hybrid parity vs the dense MoE math (ISSUE 9 satellite):
+    the full composed program — ep dispatch all-to-alls, mp-sharded
+    expert FFN, (optionally) the pp aux channel — must track the
+    single-device reference trajectory. A wrong ep grad combine, a
+    pp-scaled aux gradient, or a lost expert cotangent diverges far
+    beyond this tolerance within 4 AdamW steps."""
+    tokens, labels = _data()
+    n_shards = mesh_dims["dp"] * mesh_dims["ep"]
+
+    def mk_opt():
+        return paddle.optimizer.AdamW(1e-2)
+
+    p = G.init_hybrid_params(CFG, jax.random.PRNGKey(0))
+    opt = mk_opt()
+    state = opt.init_state(p)
+    dense = []
+    for _ in range(4):
+        l, g = jax.value_and_grad(
+            lambda p_: dense_moe_loss_ref(p_, tokens, labels, CFG,
+                                          n_shards, M))(p)
+        p, state = opt.apply(p, g, state, 1e-2)
+        dense.append(float(l))
+
+    hybrid = _run(mesh_dims, steps=4, M=M, opt=mk_opt())
+    np.testing.assert_allclose(hybrid, dense, rtol=1e-3, atol=0)
+
+
+def test_moe_global_clip_matches_dense_golden():
+    """MoE-aware global-norm clip: expert leaves shard over ep, so the
+    replication-aware accounting must count each expert element ONCE
+    (spec-driven _repl_factor) — a norm that pmean'd expert grads like
+    replicas, or counted them ep times, diverges from the dense clipped
+    trajectory when the clip engages."""
+    tokens, labels = _data()
+
+    def mk_opt():
+        return paddle.optimizer.AdamW(
+            1e-2, grad_clip=paddle.nn.ClipGradByGlobalNorm(0.05))
+
+    p = G.init_hybrid_params(CFG, jax.random.PRNGKey(0))
+    opt = mk_opt()
+    state = opt.init_state(p)
+    dense = []
+    for _ in range(4):
+        l, g = jax.value_and_grad(
+            lambda p_: dense_moe_loss_ref(p_, tokens, labels, CFG, 4, 1))(p)
+        p, state = opt.apply(p, g, state, 1e-2)
+        dense.append(float(l))
+
+    for zero1 in (False, True):
+        hybrid = _run({"dp": 2, "ep": 2, "pp": 1, "mp": 2}, steps=4,
+                      opt=mk_opt(), zero1_dp=zero1)
+        np.testing.assert_allclose(hybrid, dense, rtol=1e-3, atol=0,
+                                   err_msg=f"zero1={zero1}")
+
+
+def test_moe_zero1_matches_plain():
+    """ZeRO-1 composed with ep: identical trajectory to the plain hybrid
+    step, with the expert moments provably sharded over ep AND dp."""
+    mesh = dist.build_mesh({"dp": 2, "ep": 2, "pp": 1, "mp": 2})
+    tokens, labels = _data()
+
+    def run(zero1):
+        opt = paddle.optimizer.AdamW(1e-2)
+        step, shard, init = G.build_hybrid_train_step(
+            CFG, mesh, opt, num_microbatches=1, zero1_dp=zero1)
+        p = shard(G.init_hybrid_params(CFG, jax.random.PRNGKey(0)))
+        s = init(p)
+        out = []
+        for _ in range(4):
+            p, s, loss = step(p, s, tokens, labels, LR)
+            out.append(float(loss))
+        return out, s
+
+    plain, _ = run(False)
+    z1, s_z1 = run(True)
+    np.testing.assert_allclose(z1, plain, rtol=2e-5, atol=2e-5)
+    m1 = s_z1["slots"]["blocks"]["moe"]["w1"]["moment1"]
+    axes = [a for e in m1.sharding.spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+    assert "ep" in axes and "dp" in axes, m1.sharding.spec
+
+
+# ---------------------------------------------------------------------------
+# Dispatch modes: flags-off bitwise baseline, index golden parity
+# ---------------------------------------------------------------------------
+def test_flags_off_compiles_dense_baseline_bitwise():
+    """ISSUE 9 acceptance: with the moe_* flags off, moe_dispatch='auto'
+    lowers to byte-identical HLO as an explicit dense build — and the
+    index build genuinely changes the program (the telemetry/mp_overlap
+    no-op pattern)."""
+    paddle.set_flags({"FLAGS_moe_index_dispatch": False,
+                      "FLAGS_moe_quantize_a2a": False,
+                      "FLAGS_moe_overlap": False})
+    mesh = dist.build_mesh({"dp": 2, "ep": 2, "pp": 1, "mp": 2})
+    tokens, labels = _data()
+
+    def build(dispatch):
+        step, shard, init = G.build_hybrid_train_step(
+            CFG, mesh, paddle.optimizer.AdamW(1e-2), num_microbatches=1,
+            moe_dispatch=dispatch)
+        p = shard(G.init_hybrid_params(CFG, jax.random.PRNGKey(0)))
+        return step, p, init(p)
+
+    step_none, p, s = build(None)
+    base = step_none.lower(p, s, tokens, labels, LR).as_text()
+    step_auto, _, _ = build("auto")
+    assert step_auto.lower(p, s, tokens, labels, LR).as_text() == base
+    step_idx, _, _ = build(MoeDispatchConfig(index=True))
+    assert step_idx.lower(p, s, tokens, labels, LR).as_text() != base
+
+    # ...and the flag-driven build resolves to the same program as the
+    # explicit index build
+    paddle.set_flags({"FLAGS_moe_index_dispatch": True})
+    try:
+        step_flag, _, _ = build("auto")
+        assert (step_flag.lower(p, s, tokens, labels, LR).as_text()
+                == step_idx.lower(p, s, tokens, labels, LR).as_text())
+    finally:
+        paddle.set_flags({"FLAGS_moe_index_dispatch": False})
+
+
+def test_index_dispatch_matches_dense_golden():
+    """Index (gather/scatter) dispatch equals the dense-einsum dispatch
+    goldenly across training steps — only the 2*T*E*C*D dispatch flops
+    change, not the math."""
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    base = _run(m, steps=6)
+    idx = _run(m, steps=6, moe_dispatch=MoeDispatchConfig(index=True))
+    np.testing.assert_allclose(idx, base, rtol=1e-5, atol=1e-6)
+
+
+def test_overlap_exact_and_chunked():
+    """The chunked transfer/GEMM interleave re-slices the exchange but
+    must not change the math: unquantized overlapped == monolithic to
+    fp32 exactness."""
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    base = _run(m, steps=4, moe_dispatch=MoeDispatchConfig(index=True))
+    ovl = _run(m, steps=4,
+               moe_dispatch=MoeDispatchConfig(index=True, overlap=True,
+                                              chunks=2))
+    np.testing.assert_allclose(ovl, base, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# int8 error-feedback a2a
+# ---------------------------------------------------------------------------
+def _zero_moe_ef(s):
+    s = dict(s)
+    s["moe_ef"] = jax.tree.map(jnp.zeros_like, s["moe_ef"])
+    return s
+
+
+def test_int8_ef_a2a_tracks_baseline_fast():
+    """8-step smoke of the acceptance gate (50-step run in the slow
+    tier): the quantized exchange tracks the fp32 baseline within 1e-2
+    relative. LR 1e-3 — the 64-vocab toy at LR 1e-2 overfits toward
+    zero loss where ANY trajectory noise reads as huge relative error;
+    at 1e-3 the run still trains (4.15 -> 2.4 over 50 steps) and the
+    relative gate measures the quantization, not the collapse."""
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    lr = jnp.float32(1e-3)
+    base = _run(m, steps=8, lr=lr,
+                moe_dispatch=MoeDispatchConfig(index=True))
+    q = _run(m, steps=8, lr=lr,
+             moe_dispatch=MoeDispatchConfig(index=True, quantize=True),
+             moe_ef_tokens=(2, 16))
+    rel = max(abs(a - b) / max(abs(b), 1e-9) for a, b in zip(q, base))
+    assert rel <= 1e-2, (q, base, rel)
+
+
+@pytest.mark.slow
+def test_int8_ef_a2a_50_steps_and_ef_beats_no_ef():
+    """ISSUE 9 acceptance: quantized+overlapped a2a tracks the fp32
+    baseline <= 1e-2 relative over 50 steps WITH error feedback on
+    (measured ~4.5e-3 max rel at LR 1e-3, loss 4.15 -> 2.39), and
+    disabling the feedback (residuals zeroed before every step — same
+    wire format, no memory) tracks strictly worse on both the max-rel
+    and the summed-absolute drift."""
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    lr = jnp.float32(1e-3)
+    mc = MoeDispatchConfig(index=True, quantize=True, overlap=True,
+                           chunks=2)
+    base = _run(m, steps=50, lr=lr,
+                moe_dispatch=MoeDispatchConfig(index=True))
+    ef = _run(m, steps=50, lr=lr, moe_dispatch=mc, moe_ef_tokens=(2, 16))
+    noef = _run(m, steps=50, lr=lr, moe_dispatch=mc,
+                moe_ef_tokens=(2, 16), state_hook=_zero_moe_ef)
+    rel = max(abs(a - b) / max(abs(b), 1e-9) for a, b in zip(ef, base))
+    assert rel <= 1e-2, (rel, ef[-5:], base[-5:])
+    err_ef = sum(abs(a - b) for a, b in zip(ef, base))
+    err_no = sum(abs(a - b) for a, b in zip(noef, base))
+    assert err_ef < err_no, (err_ef, err_no)
+
+
+def test_quantized_overlapped_bitwise_determinism():
+    """Same init, same batch, twice: the quantized+overlapped program is
+    deterministic to the bit (ISSUE 9 satellite)."""
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    mc = MoeDispatchConfig(index=True, quantize=True, overlap=True,
+                           chunks=2)
+    a = _run(m, steps=4, moe_dispatch=mc, moe_ef_tokens=(2, 16))
+    b = _run(m, steps=4, moe_dispatch=mc, moe_ef_tokens=(2, 16))
+    assert a == b, (a, b)
+
+
+def test_moe_ef_layout_extra_and_carry():
+    """The residuals ride opt_state['moe_ef'] with the elastic-checkpoint
+    reset hint, and actually change across steps (the feedback is live)."""
+    mesh = dist.build_mesh({"dp": 2, "ep": 2, "pp": 1, "mp": 2})
+    opt = paddle.optimizer.AdamW(1e-2)
+    step, shard, init = G.build_hybrid_train_step(
+        CFG, mesh, opt, num_microbatches=1,
+        moe_dispatch=MoeDispatchConfig(index=True, quantize=True),
+        moe_ef_tokens=(2, 16))
+    assert init.layout_extra["carries"]["moe_ef"] == "reset_on_mismatch"
+    p = shard(G.init_hybrid_params(CFG, jax.random.PRNGKey(0)))
+    s = init(p)
+    assert set(s["moe_ef"]) == {"disp", "comb"}
+    tokens, labels = _data()
+    p, s1, _ = step(p, s, tokens, labels, LR)
+    disp = np.asarray(s1["moe_ef"]["disp"])
+    assert np.abs(disp).sum() > 0.0  # rounding error was recorded
+
+
+# ---------------------------------------------------------------------------
+# Composition refusals + comm_overlap compose
+# ---------------------------------------------------------------------------
+def test_moe_refusals():
+    mesh = dist.build_mesh({"dp": 2, "ep": 2, "pp": 1, "mp": 2})
+    opt = paddle.optimizer.AdamW(1e-2)
+    mk = lambda **kw: G.build_hybrid_train_step(CFG, mesh, opt, **kw)
+    with pytest.raises(EnforceNotMet, match="fp8"):
+        mk(fp8=True)
+    with pytest.raises(EnforceNotMet, match="sequence"):
+        mk(mp_overlap="seq_parallel")
+    with pytest.raises(EnforceNotMet, match="1F1B"):
+        mk(schedule="ZBH1")
+    with pytest.raises(EnforceNotMet, match="moe_ef_tokens"):
+        mk(moe_dispatch=MoeDispatchConfig(quantize=True))
+    with pytest.raises(EnforceNotMet, match="microbatches"):
+        mk(moe_dispatch=MoeDispatchConfig(quantize=True),
+           moe_ef_tokens=(1, 16), num_microbatches=2)
+    # quantized a2a x comm_overlap: residual slots are per step
+    from paddle_tpu.distributed.comm_overlap import CommOverlapConfig
+    with pytest.raises(EnforceNotMet, match="comm"):
+        mk(moe_dispatch=MoeDispatchConfig(quantize=True),
+           moe_ef_tokens=(2, 16),
+           comm_overlap=CommOverlapConfig(bucket_mb=0.001))
+    # no ep axis on the mesh
+    mesh_noep = dist.build_mesh({"dp": 4, "pp": 1, "mp": 2})
+    with pytest.raises(EnforceNotMet, match="ep"):
+        G.build_hybrid_train_step(CFG, mesh_noep, opt)
+
+
+def test_moe_composes_with_comm_overlap():
+    """Plain-dispatch MoE under the bucketed dp grad sync: the fp32
+    bucketed path must equal the monolithic pmean exactly (psum of a
+    concatenation == concatenation of psums; the ep combine happens
+    before either)."""
+    from paddle_tpu.distributed.comm_overlap import CommOverlapConfig
+    m = {"dp": 2, "ep": 2, "pp": 1, "mp": 2}
+    mono = _run(m, steps=4)
+    bucket = _run(m, steps=4,
+                  comm_overlap=CommOverlapConfig(bucket_mb=0.001))
+    assert mono == bucket, (mono, bucket)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: per-expert series + analytic wire cross-check
+# ---------------------------------------------------------------------------
+def test_telemetry_moe_series_and_comms_analytic():
+    """The per-expert load-balance series ride the ring buffer, and
+    comms_bytes equals the independently re-derived analytic model:
+    ep-sync of the non-expert grads + the mp term (dense pairs + MoE
+    attention pair + the expert FFN's forward mp all-reduce) + the ep
+    dispatch/combine all-to-alls. dp=1 isolates the new terms (zero dp
+    sync bytes)."""
+    import paddle_tpu.observability as obs
+    mesh = dist.build_mesh({"dp": 1, "ep": 2, "pp": 2, "mp": 2})
+    tcfg = obs.TelemetryConfig(interval=2)
+    opt = paddle.optimizer.AdamW(1e-3)
+    M = 2
+    step, shard, init = G.build_hybrid_train_step(
+        CFG, mesh, opt, num_microbatches=M, telemetry=tcfg)
+    # the builder registered the MoE series on the caller's config
+    assert "moe_drop_frac" in tcfg.series
+    assert "moe_tokens_e0" in tcfg.series
+    assert tcfg.static["moe"]["ep"] == 2
+    p = shard(G.init_hybrid_params(CFG, jax.random.PRNGKey(0)))
+    s = init(p)
+    tokens, labels = _data()
+    host = obs.TelemetryHost(tcfg)
+    for i in range(2):
+        p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-3))
+        host.poll(s, i)
+
+    # routed-token accounting: every token routes somewhere each MoE
+    # layer execution
+    E, L2 = CFG.moe_num_experts, CFG.num_layers // 2
+    b_local, S = 4, 16  # batch 8 over dp1 x ep2
+    tok_sum = sum(host.series[f"moe_tokens_e{i}"][-1] for i in range(E))
+    assert tok_sum == pytest.approx(b_local * S * L2), tok_sum
+    drop = host.series["moe_drop_frac"][-1]
+    assert 0.0 <= drop < 1.0
+
+    # analytic comms_bytes re-derivation (independent of the engine)
+    from paddle_tpu.incubate.distributed.models.moe.gate import \
+        compute_capacity
+    ep, pp, mp = 2, 2, 2
+    H, dt = CFG.hidden_size, 4
+    mb_T = (b_local // M) * S
+    C = compute_capacity(mb_T, E, 1, CFG.moe_capacity_factor)
+    a_blk = (b_local // M) * S * H * dt
+    a_full = b_local * S * H * dt
+    executed = (M + pp - 1) * (L2 // pp)
+    mp_term = obs.mp_wire_bytes(
+        "allreduce", mp,
+        gemm_pair_bytes=3.0 * executed * a_blk,
+        allreduce_bytes=(2.0 * a_full + 4.0 * b_local * S * 4
+                         + executed * float(E * C * H * dt)))
+    ep_a2a = obs.ep_a2a_wire_bytes(ep, payload_elems=float(E * C * H),
+                                   n_layer_executions=float(executed),
+                                   itemsize=dt)
+    # ep grad sync: every NON-expert leaf pmeans its LOCAL shard over ep
+    # (2f bytes per rank — pp/mp-sharded leaves move 1/(pp*mp) of their
+    # global size)
+    mesh_sizes = {"dp": 1, "ep": ep, "pp": pp, "mp": mp}
+    specs = G.hybrid_param_specs(CFG)
+    example = jax.eval_shape(
+        lambda: G.init_hybrid_params(CFG, jax.random.PRNGKey(0)))
+    td = jax.tree.structure(example)
+    f = 2.0 * (ep - 1) / ep
+
+    def spec_axes(sp):
+        return {a for e in sp if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))}
+
+    def local_elems(leaf, sp):
+        n = leaf.size
+        for a in spec_axes(sp):
+            n //= mesh_sizes[a]
+        return n
+
+    ep_sync = sum(f * local_elems(leaf, sp) * 4
+                  for leaf, sp in zip(td.flatten_up_to(example),
+                                      td.flatten_up_to(specs))
+                  if "ep" not in spec_axes(sp))
+    expected = mp_term + ep_a2a + ep_sync
+    got = host.series["comms_bytes"][-1]
+    assert got == pytest.approx(expected, rel=1e-6), (got, expected)
+
+    # int8 wire: the forward a2as drop to 1 byte/elem, backward stays fp
+    q_a2a = obs.ep_a2a_wire_bytes(ep, payload_elems=float(E * C * H),
+                                  n_layer_executions=float(executed),
+                                  itemsize=dt, quantize=True)
+    assert q_a2a < ep_a2a
+    assert q_a2a == pytest.approx(
+        ep_a2a - 2.0 * ((ep - 1) / ep) * E * C * H * (dt - 1) * executed)
+
+
+def test_moe_loss_decreases_and_experts_used():
+    """End-to-end sanity at default (drop-prone) capacity: training
+    converges and more than one expert receives tokens."""
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32,
+                      moe_num_experts=4, moe_capacity_factor=1.25)
+    import paddle_tpu.observability as obs
+    tcfg = obs.TelemetryConfig(interval=1)
+    mesh = dist.build_mesh({"dp": 2, "ep": 2, "pp": 1, "mp": 2})
+    opt = paddle.optimizer.AdamW(1e-2)
+    step, shard, init = G.build_hybrid_train_step(
+        cfg, mesh, opt, num_microbatches=1, telemetry=tcfg,
+        moe_dispatch=MoeDispatchConfig(index=True))
+    p = shard(G.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    s = init(p)
+    tokens, labels = _data()
+    host = obs.TelemetryHost(tcfg)
+    losses = []
+    for i in range(8):
+        p, s, loss = step(p, s, tokens, labels, LR)
+        losses.append(float(loss))
+        host.poll(s, i)
+    assert losses[-1] < losses[0] * 0.9, losses
+    used = sum(host.series[f"moe_tokens_e{i}"][-1] > 0 for i in range(4))
+    assert used >= 2, [host.series[f"moe_tokens_e{i}"][-1]
+                       for i in range(4)]

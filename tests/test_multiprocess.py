@@ -113,6 +113,31 @@ def test_two_process_pipeline_parity(mode):
     _spawn_and_check(8, golden, mode=mode)
 
 
+def test_two_process_vpp_parity():
+    """Interleaved virtual-pipeline (vpp2) with the pp axis on the
+    process boundary: every circular-permute hop of the V-chunk schedule
+    — including the rank-(P-1) -> rank-0 wrap — crosses the boundary
+    (ISSUE 9 satellite / VERDICT missing #6)."""
+    from paddle_tpu.distributed import mp_smoke
+
+    golden = mp_smoke.golden_for(8, "ppvpp")
+    assert all(np.isfinite(golden)), golden
+    _spawn_and_check(8, golden, mode="ppvpp")
+
+
+def test_two_process_epmoe_parity():
+    """GPT-MoE with the EXPERT-parallel axis on the process boundary:
+    the index-dispatch expert all-to-alls cross it every layer, and the
+    spec-aware ep gradient combine (pmean for replicated leaves, 1/ep
+    rescale for the expert bank) must reproduce the single-process run
+    (ISSUE 9 satellite / VERDICT missing #6)."""
+    from paddle_tpu.distributed import mp_smoke
+
+    golden = mp_smoke.golden_for(8, "epmoe")
+    assert all(np.isfinite(golden)), golden
+    _spawn_and_check(8, golden, mode="epmoe")
+
+
 def test_hybrid_mesh_construction_virtual():
     """Single-process unit check of the hybrid construction path: feed
     build_mesh devices tagged with fake process indices and assert inner

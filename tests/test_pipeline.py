@@ -96,6 +96,65 @@ def test_pipeline_grads_match_dense(pipeline_setup):
                            atol=1e-5), k
 
 
+def test_pipeline_with_aux_channel(pipeline_setup):
+    """with_aux=True (the MoE side channel): aux contributions sum over
+    exactly the M valid ticks per rank (bubble compute on garbage is
+    masked out) and psum over pp, replicated; and an aux term folded
+    into the loss gets the SAME gradient as the dense computation — the
+    psum-fwd/identity-bwd combine must not scale aux grads by the pipe
+    degree."""
+    mesh, params, x = pipeline_setup
+
+    def stage_fn_aux(stage_params, h):
+        out = _stage_fn(stage_params, h)
+        return out, {"count": jnp.ones((), jnp.float32),
+                     "sq": jnp.sum(out.astype(jnp.float32) ** 2)}
+
+    def run(params, x):
+        out, aux = spmd_pipeline(stage_fn_aux, params, x, axis="pp",
+                                 with_aux=True)
+        return out, aux
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+                   out_specs=(P(), {"count": P(), "sq": P()}))
+    out, aux = jax.jit(fn)(params, x)
+    # every (stage, microbatch) execution counted exactly once
+    assert float(aux["count"]) == PP * M, float(aux["count"])
+    ref = jax.vmap(lambda xi: _dense_forward(params, xi))(x)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # gradient of an aux-only loss vs the dense equivalent: sq sums the
+    # squared STAGE OUTPUTS over every (stage, microbatch) execution
+    def pp_grad(params, x):
+        def loss(params):
+            _, aux = spmd_pipeline(stage_fn_aux, params, x, axis="pp",
+                                   with_aux=True)
+            return aux["sq"]
+        return jax.grad(loss)(params)
+
+    g_pp = jax.jit(shard_map(
+        pp_grad, mesh=mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+        out_specs={"w": P("pp"), "b": P("pp")}))(params, x)
+
+    def dense_sq(params):
+        total = jnp.zeros((), jnp.float32)
+        for m in range(M):
+            h = x[m]
+            for s in range(PP):
+                for l in range(L_PER):
+                    h = _block(jax.tree.map(
+                        lambda a: a[s * L_PER + l], params), h)
+                total = total + jnp.sum(h.astype(jnp.float32) ** 2)
+        return total
+
+    g_ref = jax.grad(dense_sq)(params)
+    for k in g_ref:
+        assert np.allclose(np.asarray(g_pp[k]), np.asarray(g_ref[k]),
+                           atol=1e-4), k
+
+
 def test_pipeline_with_dp_axis(pipeline_setup):
     """pp x dp hybrid: batch sharded over dp, blocks over pp."""
     mesh, params, x = pipeline_setup  # axes pp=4, rest=2 (use as dp)
