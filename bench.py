@@ -377,8 +377,6 @@ def _run_moe_config(jax, paddle, G, conf, iters):
     import jax.numpy as jnp
     import paddle_tpu.distributed as dist
     from paddle_tpu.distributed.comm_overlap import MoeDispatchConfig
-    from paddle_tpu.incubate.distributed.models.moe.gate import \
-        compute_capacity
     from paddle_tpu.observability import ep_a2a_wire_bytes
     from paddle_tpu.observability import flops as _flops
 
@@ -407,8 +405,11 @@ def _run_moe_config(jax, paddle, G, conf, iters):
     lr = jnp.float32(1e-4)
     b_rank = batch // (dp * ep)
     T = b_rank * seq
-    C = compute_capacity(T, E, 1, cfg.moe_capacity_factor)
-    H, FF, L2 = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers // 2
+    # the ONE copy of the MoE flop math (planner + bench share it;
+    # tests assert it equals the former inline formulas bit-for-bit)
+    moe_fl = _flops.gpt_moe_flops_per_token(cfg, tokens_per_rank=T, mp=mp)
+    C = int(moe_fl["capacity"])
+    H = cfg.hidden_size
     dt = 2 if on_tpu else 4
 
     def timed(dispatch, **kw):
@@ -440,8 +441,9 @@ def _run_moe_config(jax, paddle, G, conf, iters):
     # per-rank expert-GEMM flops/step: each rank's local expert shard
     # processes all E*C capacity slots of its ep group after the a2a
     # (padding slots do real MXU work), 2 GEMMs of H x FF/mp each,
-    # fwd + 2x bwd, L2 MoE layers
-    expert_flops = 12.0 * E * C * H * (FF // mp) * L2
+    # fwd + 2x bwd, L2 MoE layers (observability.flops owns the math)
+    expert_flops = moe_fl["expert_gemm_flops_per_rank_step"]
+    L2 = cfg.num_layers // 2
     peak = _flops.peak_flops(jax.devices())
     payload = float(E * C * H)
     return {
@@ -461,7 +463,8 @@ def _run_moe_config(jax, paddle, G, conf, iters):
                 100.0 * expert_flops / (t_qovl * peak), 2)},
         # the 2*T*E*C*D one-hot einsum the index dispatch deletes —
         # PER dispatch AND combine, fwd (backward re-runs both)
-        "dense_dispatch_flops_per_moe_layer": 2.0 * 2 * T * E * C * H,
+        "dense_dispatch_flops_per_moe_layer":
+            moe_fl["dense_dispatch_flops_per_moe_layer"],
         "a2a_bytes_per_step_per_rank": {
             "wire_dtype": "bf16" if on_tpu else "fp32",
             "unquantized_wire": ep_a2a_wire_bytes(
@@ -529,6 +532,93 @@ def _run_telemetry_config(jax, paddle, G, conf, iters,
     report["flops_per_token"] = {"model": fpt["model"],
                                  "hardware_full_remat": fpt_hw["hardware"]}
     return report
+
+
+def _run_planner_config(jax, G, conf):
+    """Auto-parallel planner end-to-end (distributed.auto_tuner): plan the
+    bench shape over the local mesh, then run a 4-point measured sweep —
+    the planner's top-1, two mid-surface configs and a deliberately-bad
+    pipeline config — through build_hybrid_train_step(**engine_kwargs),
+    calibrate the cost model on the first three (rate / per-collective
+    launch / per-step overhead) and report plan wall time, top-1
+    predicted-vs-measured step ms and the ranking-order check. Mesh-shape
+    hops between sweep points carry the params through the PR-7
+    elastic-reshard path (warm_hop) so reshard-on-load is exercised
+    across every mesh change."""
+    import tempfile
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import auto_tuner as AT
+    from paddle_tpu.distributed.auto_tuner.sweep import (ranking_agreement,
+                                                         run_sweep)
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        return {"skipped": f"needs 8 devices for the sweep meshes, have "
+                           f"{n_dev}"}
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    batch, seq = max(conf["batch"], 16), conf["seq"]
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=max(conf["max_seq_len"], seq),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    t0 = time.perf_counter()
+    report = AT.plan(cfg, world=8, global_batch=batch, seq=seq,
+                     family="gpt")
+    plan_s = time.perf_counter() - t0
+    top1 = report.top(1)[0]
+    spec = report.spec
+    P = AT.PlanCandidate
+    bad_pp = 4 if cfg.num_layers % 4 == 0 else 2
+    sweep = [top1.candidate,
+             P(dp=8, micro_batches=1),
+             P(dp=2, mp=2, pp=2, micro_batches=2),
+             P(dp=4, mp=2, micro_batches=1),
+             # deliberately bad: max bubble at M=1 on the deepest legal
+             # pipeline for this layer count
+             P(dp=8 // bad_pp, pp=bad_pp, micro_batches=1)]
+    # dedupe + constraint-check while keeping the top-1 first; 4 points
+    # (3 calibration anchors + the bad config as the held-out check)
+    from paddle_tpu.distributed.auto_tuner.planner import check_candidate
+    seen, cands = set(), []
+    for c in sweep:
+        if c not in seen and check_candidate(
+                c, spec, world=8, global_batch=batch, seq=seq) is None:
+            seen.add(c)
+            cands.append(c)
+    cands = cands[:3] + [sweep[-1]] if len(cands) > 4 else cands
+    cm = AT.CostModel(spec, report.profile, global_batch=batch, seq=seq)
+    with tempfile.TemporaryDirectory(prefix="planner_hop_") as hop_dir:
+        rows, cal = run_sweep(cfg, cands, cost_model=cm, family="gpt",
+                              global_batch=batch, seq=seq, iters=3,
+                              repeats=2, anchors=cands[:3],
+                              warm_hop_dir=hop_dir)
+    agr = ranking_agreement(rows, noise_rel=0.2)
+    return {
+        "config_hash": _config_hash(conf),
+        "plan_s": round(plan_s, 2),
+        "n_generated": report.n_generated,
+        "n_valid": len(report.ranked),
+        "n_pruned": len(report.pruned),
+        "top1": top1.row(),
+        "sweep": [{"candidate": str(r["candidate"]),
+                   "measured_ms": round(r["measured_s"] * 1e3, 2),
+                   "predicted_ms": round(r["predicted_s"] * 1e3, 2),
+                   "anchor": bool(r.get("anchor"))} for r in rows],
+        "top1_predicted_vs_measured": round(
+            rows[0]["predicted_s"] / rows[0]["measured_s"], 3),
+        "ranking_order_ok": agr["ok"],
+        "ranking_checked_pairs": agr["checked_pairs"],
+        "calibrated": {
+            "rate_flops": cal.rate,
+            "collective_launch_us": round(cal.t_launch * 1e6, 1),
+            "step_overhead_ms": round(cal.step_overhead_s * 1e3, 2)},
+        "warm_hop": "params reshard-loaded across mesh hops "
+                    "(checkpoint.reshard)",
+        "cpu_smoke": not on_tpu,
+    }
 
 
 def _run_serving_config(jax, G):
@@ -646,6 +736,16 @@ def main():
     out["telemetry"] = _run_telemetry_config(
         jax, paddle, G, tele_conf, iters if on_tpu else 3,
         comms_fraction=out["overlap"]["comms_fraction"])
+    # auto-parallel planner (distributed.auto_tuner): plan time, top-1
+    # predicted vs measured step ms on this host's mesh, ranking-order
+    # check over a 4-point sweep with reshard warm hops between mesh
+    # shapes — the tier-1 acceptance row exercises the planner end-to-end.
+    # The CPU smoke needs >= 4 layers and a non-trivial seq or every
+    # config ties inside the fixed per-step overhead.
+    planner_conf = dict(SECONDARY) if on_tpu else dict(
+        vocab_size=512, hidden_size=64, num_layers=4, num_heads=4,
+        max_seq_len=128, batch=16, seq=128)
+    out["planner"] = _run_planner_config(jax, G, planner_conf)
     # single-dispatch ragged serving (FLAGS_serving_ragged): the unified
     # prefill+decode engine vs the frozen two-program baseline — tokens/s,
     # dispatches/step (the contract: halved, 1.0/step), latency

@@ -1,8 +1,26 @@
-"""Parallelism auto-tuner (reference: python/paddle/distributed/auto_tuner/
-— tuner.py:21 AutoTuner: generate dp/mp/pp/sharding/micro-batch candidates,
-prune by divisibility + memory model, trial-run, pick the best)."""
+"""Auto-parallel planner (reference: python/paddle/distributed/auto_tuner
++ the semi-auto ``InferSpmd``/spmd_rules layer): analytic config search
+over the hybrid engine's real flag surface — (dp, mp, pp, ep) x schedule
+(1F1B/ZBH1/interleaved-VPP) x micro_batches x zero1 x fp8 x
+comm_bucket_mb x mp_overlap x MoE dispatch — scored with the
+measurement-validated observability models (FLOPs, mp/dp/ep wire bytes,
+pipeline tick formulas), pruned by an analytic per-chip HBM model
+(cross-checkable against compiled ``memory_analysis``), emitted as
+ready-to-run ``build_hybrid_train_step`` kwargs, and validated against a
+measured bench sweep (``auto_tuner.sweep``).
 
-from .tuner import AutoTuner, Candidate, estimate_memory_gb, generate_candidates, prune_candidates
+CLI: ``python -m paddle_tpu.distributed.auto_tuner plan --model gpt1p3b
+--mesh 2x4`` (see ``--help``). Flags: FLAGS_auto_parallel_plan /
+FLAGS_auto_parallel_topk / FLAGS_auto_parallel_hbm_gb.
+"""
 
-__all__ = ["AutoTuner", "Candidate", "generate_candidates",
-           "prune_candidates", "estimate_memory_gb"]
+from .planner import (CostModel, HardwareProfile, KNOWN_PROFILES,
+                      ModelSpec, PLAN_MODELS, PlanCandidate, PlanReport,
+                      Prediction, ScoredPlan, generate_plan_candidates,
+                      model_config_by_name, plan, profile_for)
+from .tuner import AutoTuner
+
+__all__ = ["PlanCandidate", "ModelSpec", "HardwareProfile",
+           "KNOWN_PROFILES", "CostModel", "Prediction", "PlanReport",
+           "ScoredPlan", "generate_plan_candidates", "plan", "profile_for",
+           "model_config_by_name", "PLAN_MODELS", "AutoTuner"]

@@ -1,0 +1,115 @@
+"""CLI for the auto-parallel planner.
+
+    python -m paddle_tpu.distributed.auto_tuner plan \
+        --model {gpt_tiny,gpt1p3b,gpt_moe_tiny,llama_tiny} --mesh AxB \
+        [--global-batch N] [--seq S] [--hbm-gb G] [--profile NAME] \
+        [--top K] [--json] [--show-pruned N] [--fp8]
+
+Prints the ranked top-k table (predicted step ms, MFU, exposed-comm
+fraction, pipeline-bubble fraction, peak analytic HBM, collective count)
+plus prune reasons for rejected candidates; ``--json`` emits the full
+machine-readable report instead. The mesh argument is the physical slice
+shape (AxB... chips = the device count the plan factorizes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_mesh(s: str) -> int:
+    total = 1
+    for part in s.lower().replace("*", "x").split("x"):
+        total *= int(part)
+    return total
+
+
+def main(argv=None) -> int:
+    from . import planner as PL
+    from ...flags import flag
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.auto_tuner",
+        description="Analytic auto-parallel planner over the hybrid "
+                    "engine's flag surface.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("plan", help="rank configs for a model + mesh")
+    sp.add_argument("--model", required=True, choices=PL.PLAN_MODELS)
+    sp.add_argument("--mesh", required=True,
+                    help="physical slice shape AxB (device count = "
+                         "product)")
+    sp.add_argument("--global-batch", type=int, default=None,
+                    help="global batch size (default: one sample per "
+                         "device, rounded up to 8)")
+    sp.add_argument("--seq", type=int, default=None,
+                    help="sequence length (default: the config's "
+                         "max_seq_len)")
+    sp.add_argument("--hbm-gb", type=float,
+                    default=float(flag("auto_parallel_hbm_gb")),
+                    help="per-chip HBM budget override "
+                         "(FLAGS_auto_parallel_hbm_gb; 0 = profile "
+                         "default)")
+    sp.add_argument("--profile", default=None,
+                    choices=sorted(PL.KNOWN_PROFILES),
+                    help="hardware profile (default: detect from the "
+                         "current jax backend)")
+    sp.add_argument("--top", type=int,
+                    default=int(flag("auto_parallel_topk")),
+                    help="ranked rows to emit (FLAGS_auto_parallel_topk)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    sp.add_argument("--show-pruned", type=int, default=8,
+                    help="pruned candidates to list in table mode")
+    sp.add_argument("--fp8", action="store_true",
+                    help="also enumerate fp8 candidates")
+    args = p.parse_args(argv)
+
+    world = _parse_mesh(args.mesh)
+    cfg, family = PL.model_config_by_name(args.model)
+    seq = args.seq if args.seq else cfg.max_seq_len
+    gb = args.global_batch if args.global_batch else max(8, world)
+    profile = (PL.KNOWN_PROFILES[args.profile]
+               if args.profile else PL.profile_for(hbm_gb=args.hbm_gb
+                                                   or None))
+    report = PL.plan(cfg, world=world, global_batch=gb, seq=seq,
+                     family=family, profile=profile,
+                     hbm_gb=args.hbm_gb or None,
+                     fp8_options=(False, True) if args.fp8 else (False,))
+
+    if args.json:
+        print(json.dumps(report.to_json(top_k=args.top)))
+        return 0
+
+    print(f"# {args.model} on {world} chips ({report.profile.name}, "
+          f"{report.profile.hbm_gb:g} GB HBM) — batch {gb}, seq {seq}")
+    print(f"# generated {report.n_generated} candidates, "
+          f"{len(report.ranked)} valid, {len(report.pruned)} pruned")
+    hdr = (f"{'rank':>4}  {'candidate':32s} {'step_ms':>9} {'MFU%':>6} "
+           f"{'comm':>6} {'bubble':>6} {'HBM_GB':>7} {'ncoll':>6}")
+    print(hdr)
+    for i, s in enumerate(report.top(args.top)):
+        r = s.row()
+        print(f"{i + 1:>4}  {r['candidate']:32s} {r['step_ms']:>9.3f} "
+              f"{r['mfu_pct']:>6.2f} {r['comm_frac']:>6.3f} "
+              f"{r['bubble_frac']:>6.3f} {r['hbm_gb']:>7.3f} "
+              f"{r['n_collectives']:>6}")
+    if args.show_pruned and report.pruned:
+        print(f"# pruned (showing {min(args.show_pruned, len(report.pruned))}"
+              f" of {len(report.pruned)}):")
+        for c, reason in report.pruned[:args.show_pruned]:
+            print(f"  - {str(c):40s} {reason}")
+    if report.ranked:
+        best = report.ranked[0]
+        print("# top-1 engine kwargs: build_hybrid_train_step(cfg, "
+              "mesh, opt, **kw) with")
+        print(f"#   mesh = build_mesh({best.candidate.mesh_dims()})")
+        kw = best.candidate.engine_kwargs(family=family, global_batch=gb,
+                                          seq=seq)
+        print(f"#   kw = {kw}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,138 +1,40 @@
-"""Auto-tuner implementation (reference: auto_tuner/tuner.py — candidate
-generation auto_tuner/search.py GridSearch, pruning auto_tuner/prune.py
-`_PRUNE_FUNC` registry, memory model auto_tuner/recorder.py history).
+"""Measured-trial driver for the auto-parallel planner.
 
-TPU shape: a candidate is a mesh factorization (dp/mp/pp/sharding) +
-microbatch count; pruning uses divisibility plus an analytic HBM model
-(params/grads/optimizer sharded by the right axes + activation estimate);
-trials run the user's `run_trial(candidate)` (typically: build the hybrid
-train step on a virtual mesh, time a step) with failures recorded and
-skipped — the reference launches subprocess trials the same way.
+The analytic half of the search lives in :mod:`.planner` (PlanCandidate
+generation, the three-part cost model, HBM pruning, ranking). This module
+is the measurement half: :class:`AutoTuner` runs ``run_trial(candidate)``
+over a candidate sequence — typically the planner's top-k, so only the
+configurations the model already ranks well pay for a real build+step —
+records metrics/failures, and picks the best. The launcher
+(``launch --auto_tune``) drives the user's own training script through it
+as subprocess trials; :mod:`.sweep` drives in-process hybrid train steps
+through it for the predicted-vs-measured validation.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import itertools
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["Candidate", "generate_candidates", "prune_candidates",
-           "estimate_memory_gb", "AutoTuner"]
-
-
-@dataclasses.dataclass(frozen=True)
-class Candidate:
-    dp: int = 1
-    mp: int = 1
-    pp: int = 1
-    sharding: int = 1
-    micro_batches: int = 1
-
-    @property
-    def world(self) -> int:
-        return self.dp * self.mp * self.pp * self.sharding
-
-    def mesh_dims(self) -> Dict[str, int]:
-        return {"dp": self.dp, "pp": self.pp, "sharding": self.sharding,
-                "sep": 1, "mp": self.mp}
-
-    def __str__(self):
-        return (f"dp{self.dp}_mp{self.mp}_pp{self.pp}_sh{self.sharding}"
-                f"_mb{self.micro_batches}")
-
-
-def _divisors(n: int) -> List[int]:
-    return [d for d in range(1, n + 1) if n % d == 0]
-
-
-def generate_candidates(world_size: int,
-                        micro_batch_options: Sequence[int] = (1, 2, 4, 8),
-                        use_sharding: bool = True) -> List[Candidate]:
-    """All mesh factorizations of world_size (plus microbatch counts)."""
-    out = []
-    for dp in _divisors(world_size):
-        for mp in _divisors(world_size // dp):
-            rem = world_size // (dp * mp)
-            for pp in _divisors(rem):
-                sh = rem // pp
-                if sh > 1 and not use_sharding:
-                    continue
-                for mb in micro_batch_options:
-                    out.append(Candidate(dp, mp, pp, sh, mb))
-    return out
-
-
-def estimate_memory_gb(candidate: Candidate, num_params: float,
-                       hidden_size: int, num_layers: int, seq_len: int,
-                       global_batch: int, bytes_per_param: int = 4,
-                       optimizer_slots: int = 2,
-                       activation_factor: float = 12.0) -> float:
-    """Analytic per-chip HBM estimate (reference: auto_tuner memory model).
-
-    params+grads shard over mp*pp; optimizer state additionally over the
-    sharding axis (ZeRO-1 semantics); activations scale with the local
-    microbatch slice and pp stage depth.
-    """
-    c = candidate
-    model_shard = num_params / (c.mp * c.pp)
-    params_grads = model_shard * bytes_per_param * 2
-    opt_state = model_shard * bytes_per_param * optimizer_slots / max(
-        c.sharding, 1)
-    local_batch = global_batch / (c.dp * c.sharding)
-    micro = max(local_batch / c.micro_batches, 1)
-    acts = (activation_factor * micro * seq_len * hidden_size
-            * (num_layers / c.pp) * 2)  # bf16 activations
-    return (params_grads + opt_state + acts) / 1e9
-
-
-def prune_candidates(candidates: Sequence[Candidate], *,
-                     num_layers: int, num_heads: int, vocab_size: int,
-                     global_batch: int, seq_len: int, hidden_size: int,
-                     num_params: Optional[float] = None,
-                     hbm_gb: Optional[float] = None,
-                     max_mp: Optional[int] = None) -> List[Candidate]:
-    """Drop invalid/over-budget candidates (reference prune registry:
-    divisibility of layers/heads/batch, memory ceiling, degree caps)."""
-    out = []
-    for c in candidates:
-        if num_layers % c.pp != 0:
-            continue
-        if num_heads % c.mp != 0 or vocab_size % c.mp != 0:
-            continue
-        replicas = c.dp * c.sharding
-        if global_batch % replicas != 0:
-            continue
-        local = global_batch // replicas
-        if local % c.micro_batches != 0:
-            continue
-        if max_mp is not None and c.mp > max_mp:
-            continue
-        if hbm_gb is not None and num_params is not None:
-            est = estimate_memory_gb(c, num_params, hidden_size, num_layers,
-                                     seq_len, global_batch)
-            if est > hbm_gb:
-                continue
-        out.append(c)
-    return out
+__all__ = ["AutoTuner"]
 
 
 class AutoTuner:
-    """Search driver (reference: tuner.py AutoTuner + recorder).
+    """Trial loop over candidates (higher metric = better, e.g. tokens/s).
 
-    run_trial(candidate) -> metric (higher is better, e.g. tokens/sec);
-    raise or return None to mark the candidate failed.
+    run_trial(candidate) -> metric; raise or return None to mark the
+    candidate failed (a crash IS a runtime prune — the analytic OOM model
+    can only predict, the trial proves).
     """
 
-    def __init__(self, run_trial: Callable[[Candidate], Optional[float]],
-                 max_trials: Optional[int] = None,
+    def __init__(self, run_trial: Callable, max_trials: Optional[int] = None,
                  max_time_s: Optional[float] = None):
         self.run_trial = run_trial
         self.max_trials = max_trials
         self.max_time_s = max_time_s
         self.history: List[Dict] = []
 
-    def tune(self, candidates: Sequence[Candidate]) -> Optional[Candidate]:
+    def tune(self, candidates: Sequence):
         best, best_metric = None, float("-inf")
         t0 = time.perf_counter()
         for i, cand in enumerate(candidates):
@@ -161,11 +63,12 @@ class AutoTuner:
         return max(ok, key=lambda h: h["metric"], default=None)
 
     def summary(self) -> str:
-        lines = ["candidate              metric        time_s  error"]
+        lines = ["candidate                        metric        time_s  "
+                 "error"]
         for h in sorted(self.history,
                         key=lambda h: -(h["metric"] if h["metric"]
                                         is not None else float("-inf"))):
             m = "FAILED" if h["metric"] is None else f"{h['metric']:.1f}"
-            lines.append(f"{str(h['candidate']):22s} {m:>10s}  "
+            lines.append(f"{str(h['candidate']):32s} {m:>10s}  "
                          f"{h['time_s']:8.2f}  {h['error'] or ''}")
         return "\n".join(lines)

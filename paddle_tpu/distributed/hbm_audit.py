@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["per_device_bytes", "audit_hybrid_compile",
-           "audit_stage3_compile"]
+           "audit_stage3_compile", "audit_plan_compile"]
 
 
 def per_device_bytes(shapes, specs, mesh: Mesh) -> int:
@@ -140,6 +140,58 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
            "per_device_param_bytes": param_b,
            "per_device_state_bytes": state_b,
            "compile_s": round(compile_s, 1)}
+    out.update(_mem_stats(compiled))
+    return out
+
+
+def audit_plan_compile(cand, cfg, *, family: str = "gpt",
+                       global_batch: int, seq: int, optimizer=None,
+                       devices=None) -> Dict[str, Any]:
+    """AOT-compile ONE auto-parallel PlanCandidate's full hybrid train
+    step on a virtual mesh and return its ``memory_analysis`` byte
+    accounting — the compiled cross-check for the planner's analytic
+    per-chip HBM model (no buffer is ever materialized; the engine's
+    ``init_state.abstract``/``init_state.state_specs`` AOT hook supplies
+    the state carry shapes)."""
+    import time
+
+    import paddle_tpu as paddle
+    if family == "gpt":
+        from ..models import gpt as M
+    else:
+        from ..models import llama as M
+
+    mesh = cand.build_mesh(devices)
+    opt = optimizer if optimizer is not None \
+        else paddle.optimizer.AdamW(learning_rate=1e-4)
+    kw = cand.engine_kwargs(family=family, global_batch=global_batch,
+                            seq=seq)
+    step, _, init_state = M.build_hybrid_train_step(cfg, mesh, opt, **kw)
+
+    specs = M.hybrid_param_specs(cfg)
+    pshape = jax.eval_shape(
+        lambda: M.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    sshape = init_state.abstract(pshape)
+    sspec = init_state.state_specs
+
+    def shaped(shapes, spec_tree):
+        return jax.tree.map(
+            lambda s, sp: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+            shapes, spec_tree)
+
+    data_spec = P(("dp", "ep")) if getattr(cfg, "moe_on", False) else P("dp")
+    tok = jax.ShapeDtypeStruct((global_batch, seq), jnp.int32,
+                               sharding=NamedSharding(mesh, data_spec))
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    t0 = time.perf_counter()
+    compiled = step.lower(shaped(pshape, specs), shaped(sshape, sspec),
+                          tok, tok, lr).compile()
+    out = {"candidate": str(cand), "mesh": dict(mesh.shape),
+           "global_batch": global_batch, "seq": seq,
+           "per_device_param_bytes": per_device_bytes(pshape, specs, mesh),
+           "per_device_state_bytes": per_device_bytes(sshape, sspec, mesh),
+           "compile_s": round(time.perf_counter() - t0, 2)}
     out.update(_mem_stats(compiled))
     return out
 

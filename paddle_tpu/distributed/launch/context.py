@@ -49,14 +49,17 @@ def parse_args(argv: Optional[List[str]] = None):
                         "watch in fleet/elastic/manager.py)")
     p.add_argument("--auto_tune", action="store_true",
                    default=os.environ.get("PADDLE_AUTO_TUNE", "") == "1",
-                   help="search dp*mp*pp*sharding*micro_batches before the "
-                        "real run (reference: launch auto-tuner mode)")
+                   help="trial-run auto-parallel PlanCandidates (planner-"
+                        "ranked top-k under FLAGS_auto_parallel_plan) "
+                        "before the real run (reference: launch "
+                        "auto-tuner mode)")
     p.add_argument("--auto_tuner_json", default=None,
-                   help="json with model dims for candidate pruning and "
-                        "trial limits (global_batch, num_layers, "
-                        "num_heads, hidden_size, vocab_size, seq_len, "
-                        "hbm_gb, num_params, micro_batch_options, "
-                        "max_trials, max_time_s)")
+                   help="json for the candidate search: either a named "
+                        "'model' (gpt_tiny/gpt1p3b/gpt_moe_tiny/"
+                        "llama_tiny) or raw dims (num_layers, num_heads, "
+                        "hidden_size, vocab_size), plus global_batch, "
+                        "seq_len, hbm_gb, top_k, analytic_rank, "
+                        "micro_batch_options, max_trials, max_time_s)")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)

@@ -489,6 +489,29 @@ define_flag("xla_latency_hiding_scheduler", False,
             "per-bucket collectives actually hide under backward "
             "compute.", on_set=apply_xla_overlap_flags)
 
+# --- auto-parallel planner --------------------------------------------------
+# (consumed by distributed.auto_tuner + distributed.launch.auto_tune;
+# see README "Auto-parallel planner")
+define_flag("auto_parallel_plan", True,
+            "Use the analytic auto-parallel planner to generate, "
+            "HBM-prune and RANK the candidate configs before the "
+            "launcher's --auto_tune trial loop, so only the planner's "
+            "top-k (FLAGS_auto_parallel_topk) pay for a real subprocess "
+            "trial. Off: the trial loop sweeps every constraint-valid "
+            "mesh factorization unranked, the pre-planner behavior "
+            "(consumed by distributed.launch.auto_tune.run_auto_tune).")
+define_flag("auto_parallel_topk", 5,
+            "Ranked candidates the planner emits/trials: the CLI's "
+            "--top default and the --auto_tune trial budget when "
+            "FLAGS_auto_parallel_plan is on (consumed by "
+            "distributed.auto_tuner.__main__ and launch.auto_tune).")
+define_flag("auto_parallel_hbm_gb", 0.0,
+            "Per-chip HBM budget override for the planner's analytic "
+            "OOM pruning; 0 uses the detected hardware profile's budget "
+            "(v5e 16, v5p 95, ...). The CLI's --hbm-gb default "
+            "(consumed by distributed.auto_tuner planner/CLI and "
+            "launch.auto_tune).")
+
 # --- observability / telemetry ---------------------------------------------
 # (consumed by paddle_tpu.observability + models.hybrid_engine telemetry=,
 # Model.fit, resilience.run_resilient, inference.serving; see README

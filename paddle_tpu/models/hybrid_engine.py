@@ -495,6 +495,30 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         return inner
     init_state.layout_extra = layout_extra
 
+    def abstract_state(params_shape):
+        """ShapeDtypeStruct tree of the full step-state carry (opt state +
+        whatever extras this build threads) WITHOUT materializing any
+        buffer — the AOT hook the auto-parallel planner's
+        `jit(step).lower(...).compile().memory_analysis()` cross-check
+        compiles against (hbm_audit.audit_plan_compile)."""
+        inner = jax.eval_shape(optimizer.init_state, params_shape)
+        extras = {}
+        if ef_plan is not None:
+            extras["comm_ef"] = jax.eval_shape(
+                lambda: _co.init_ef_residuals(ef_plan, mesh))
+        if fp8_plan is not None:
+            extras["fp8_meta"] = jax.eval_shape(fp8_plan["init"])
+        if moe_plan is not None and moe_plan.get("ef") is not None:
+            extras["moe_ef"] = jax.eval_shape(moe_plan["ef"]["init"])
+        if tcfg is not None:
+            extras["telemetry"] = jax.eval_shape(
+                lambda: _obs.init_buffer(tcfg))
+        if extras:
+            return {"opt": inner, **extras}
+        return inner
+    init_state.abstract = abstract_state
+    init_state.state_specs = sspec
+
     def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
         """Per-leaf ZeRO-1 update inside shard_map: reduce-scatter the
         leaf's grad over dp, update only this rank's param/state shard,

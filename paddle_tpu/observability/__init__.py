@@ -29,8 +29,9 @@ Entry points: ``models.hybrid_engine.build_train_step(telemetry=)``,
 
 from .events import EventLog, emit_event, get_event_log, set_event_log
 from .flops import (collective_seconds, gpt_flops_per_token,
-                    llama_flops_per_token, mfu, param_count, peak_flops,
-                    plan_wire_bytes, transformer_flops_per_token)
+                    gpt_moe_flops_per_token, llama_flops_per_token, mfu,
+                    param_count, peak_flops, plan_wire_bytes,
+                    transformer_flops_per_token)
 from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
                       buffer_specs, collecting, ep_a2a_wire_bytes,
                       init_buffer, mp_comm_scope, mp_wire_bytes,
@@ -46,7 +47,8 @@ __all__ = [
     "update_buffer", "mp_wire_bytes", "note_mp_comm", "mp_comm_scope",
     "ep_a2a_wire_bytes", "note_ep_comm",
     "StepTimer",
-    "gpt_flops_per_token", "llama_flops_per_token",
+    "gpt_flops_per_token", "gpt_moe_flops_per_token",
+    "llama_flops_per_token",
     "transformer_flops_per_token", "param_count", "mfu", "peak_flops",
     "collective_seconds", "plan_wire_bytes",
     "EventLog", "emit_event", "get_event_log", "set_event_log",

@@ -23,7 +23,8 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 __all__ = ["transformer_flops_per_token", "gpt_flops_per_token",
-           "llama_flops_per_token", "param_count", "mfu", "peak_flops",
+           "llama_flops_per_token", "gpt_moe_flops_per_token",
+           "param_count", "mfu", "peak_flops",
            "collective_seconds", "plan_wire_bytes"]
 
 _REMAT_MODES = ("none", "full", "selective")
@@ -94,6 +95,50 @@ def llama_flops_per_token(cfg, seq_len: int, *, params=None,
     return transformer_flops_per_token(
         n_params=n, num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
         seq_len=seq_len, remat=remat)
+
+
+def gpt_moe_flops_per_token(cfg, *, tokens_per_rank: int,
+                            mp: int = 1) -> Dict[str, float]:
+    """MoE flop accounting for a GPT-MoE config (cfg.moe_num_experts > 0),
+    the ONE copy of the math bench.py's `moe` section and the auto-parallel
+    planner both consume (tests assert the bench formulas bit-for-bit).
+
+    tokens_per_rank: tokens one (dp, ep) rank routes per step (per-rank
+    batch x seq — per MICROBATCH when pipelined, matching the capacity the
+    gate actually computes).
+
+    Returns:
+
+    * ``capacity`` — slots per expert C (the gate's compute_capacity).
+    * ``expert_gemm_flops_per_rank_step`` — MXU flops of one rank's local
+      expert shard per step: after the all-to-all each rank processes all
+      E*C capacity slots of its ep group (padding slots do real MXU work),
+      2 GEMMs of H x FF/mp each, fwd + 2x bwd, over the L/2 MoE layers.
+    * ``dense_dispatch_flops_per_moe_layer`` — the 2*T*E*C*D one-hot
+      einsum cost the index dispatch deletes, PER dispatch AND combine,
+      forward (the backward re-runs both; FLAGS_moe_index_dispatch).
+    * ``model_flops_per_token`` — useful (MFU-numerator) expert work per
+      routed token: top-1 routing runs ONE H x FF FFN per token per MoE
+      layer, 6 flops/param-touch fwd+bwd.
+    * ``hardware_flops_per_token`` — executed expert work per token at
+      capacity (padded slots included), summed over the mp group.
+    """
+    from ..incubate.distributed.models.moe.gate import compute_capacity
+    E = cfg.moe_num_experts
+    if E <= 0:
+        raise ValueError("gpt_moe_flops_per_token needs a MoE config "
+                         "(cfg.moe_num_experts > 0)")
+    H, FF, L2 = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers // 2
+    T = int(tokens_per_rank)
+    C = compute_capacity(T, E, 1, cfg.moe_capacity_factor)
+    expert_rank_step = 12.0 * E * C * H * (FF // mp) * L2
+    return {
+        "capacity": float(C),
+        "expert_gemm_flops_per_rank_step": expert_rank_step,
+        "dense_dispatch_flops_per_moe_layer": 2.0 * 2 * T * E * C * H,
+        "model_flops_per_token": 6.0 * 2 * H * FF * L2,
+        "hardware_flops_per_token": 12.0 * E * C * H * FF * L2 / T,
+    }
 
 
 def peak_flops(devices=None) -> float:

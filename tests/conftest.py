@@ -50,7 +50,7 @@ def pytest_configure(config):
 # Measured via `pytest --durations` (round 2); update when tests move.
 _SLOW_TESTS = {
     "test_hybrid_curve_aligns_with_dense", "test_vpp_curve_aligns_with_dense",
-    "test_zero_sharded_curve_aligns", "test_tuner_end_to_end_tiny_gpt",
+    "test_zero_sharded_curve_aligns",
     "test_fused_multi_transformer_dropout_active_in_train",
     "test_fused_multi_transformer_jits_and_grads",
     "test_fused_multi_transformer_prefill_decode_parity",

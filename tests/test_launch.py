@@ -209,8 +209,9 @@ def test_elastic_scale_out(tmp_path, monkeypatch):
 
 
 def test_auto_tune_picks_best_and_runs_real_job(tmp_path, monkeypatch):
-    """--auto_tune trials the user's script over mesh candidates and the
-    real run sees the winner (reference launch/main.py auto-tuner mode)."""
+    """--auto_tune trials the user's script over PlanCandidates (the
+    planner vocabulary — JSON env protocol) and the real run sees the
+    winner (reference launch/main.py auto-tuner mode)."""
     script = tmp_path / "train.py"
     script.write_text(textwrap.dedent("""
         import os, sys
@@ -228,7 +229,7 @@ def test_auto_tune_picks_best_and_runs_real_job(tmp_path, monkeypatch):
     cfg.write_text(json.dumps({
         "global_batch": 4, "num_layers": 4, "num_heads": 4,
         "hidden_size": 32, "vocab_size": 64, "seq_len": 16,
-        "micro_batch_options": [1, 2], "use_sharding": False,
+        "micro_batch_options": [1, 2], "top_k": 8,
     }))
     import os as _os
     monkeypatch.setenv("PYTHONPATH", _os.pathsep.join(
@@ -238,6 +239,8 @@ def test_auto_tune_picks_best_and_runs_real_job(tmp_path, monkeypatch):
                  "--log_dir", str(tmp_path / "log"), str(script),
                  str(tmp_path)])
     assert rc == 0
-    final = (tmp_path / "final.txt").read_text()
-    # world=1 -> only dp=mp=pp=sh=1; best micro_batches=2 by the metric
-    assert final == "1,1,1,1,2", final
+    final = json.loads((tmp_path / "final.txt").read_text())
+    # world=1 -> dp=mp=pp=ep=1; best micro_batches=2 by the metric
+    assert (final["dp"], final["mp"], final["pp"], final["ep"]) == \
+        (1, 1, 1, 1)
+    assert final["micro_batches"] == 2, final
