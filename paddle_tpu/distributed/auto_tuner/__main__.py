@@ -52,9 +52,11 @@ def main(argv=None) -> int:
                          "(FLAGS_auto_parallel_hbm_gb; 0 = profile "
                          "default)")
     sp.add_argument("--profile", default=None,
-                    choices=sorted(PL.KNOWN_PROFILES),
-                    help="hardware profile (default: detect from the "
-                         "current jax backend)")
+                    help="hardware profile: a table name "
+                         f"({'/'.join(sorted(PL.KNOWN_PROFILES))}) or a "
+                         "path to a measured-profile JSON captured by "
+                         "observability.profile_reader (default: detect "
+                         "from the current jax backend)")
     sp.add_argument("--top", type=int,
                     default=int(flag("auto_parallel_topk")),
                     help="ranked rows to emit (FLAGS_auto_parallel_topk)")
@@ -70,9 +72,14 @@ def main(argv=None) -> int:
     cfg, family = PL.model_config_by_name(args.model)
     seq = args.seq if args.seq else cfg.max_seq_len
     gb = args.global_batch if args.global_batch else max(8, world)
-    profile = (PL.KNOWN_PROFILES[args.profile]
-               if args.profile else PL.profile_for(hbm_gb=args.hbm_gb
-                                                   or None))
+    try:
+        profile = PL.resolve_profile(args.profile,
+                                     hbm_gb=args.hbm_gb or None)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        # a mistyped name / unreadable JSON is a usage error, not a
+        # traceback (--profile lost its argparse choices= when it
+        # started accepting measured-profile paths)
+        p.error(f"--profile: {e}")
     report = PL.plan(cfg, world=world, global_batch=gb, seq=seq,
                      family=family, profile=profile,
                      hbm_gb=args.hbm_gb or None,

@@ -46,7 +46,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = ["PlanCandidate", "ModelSpec", "HardwareProfile", "profile_for",
            "KNOWN_PROFILES", "CostModel", "Prediction",
            "generate_plan_candidates", "plan", "PlanReport", "ScoredPlan",
-           "model_config_by_name", "PLAN_MODELS"]
+           "model_config_by_name", "PLAN_MODELS", "HIDE_KEYS",
+           "profile_to_json", "profile_from_json", "resolve_profile"]
 
 SCHEDULES = ("1f1b", "zbh1", "interleaved")
 MP_OVERLAP_MODES = (None, "seq_parallel", "collective_matmul")
@@ -57,6 +58,11 @@ MP_OVERLAP_MODES = (None, "seq_parallel", "collective_matmul")
 # (the 43.3% multichip MFU of BENCH_r05's secondary), seq-parallel's
 # AG/RS pairs schedule async against the GEMMs, the ring collective
 # matmul interleaves chunk transfers with partial products (PR 5).
+# These are the TABLE defaults; a measured HardwareProfile (the
+# observability.profile_reader capture pipeline) carries per-mode
+# overrides in its ``hide`` dict under the HIDE_KEYS vocabulary, and
+# measured entries WIN over both the table and the overlap_capable
+# zeroing — attribution from the observed timeline beats the heuristic.
 _HIDE_MP = {None: 0.2, "allreduce": 0.2,
             "seq_parallel": 0.55, "collective_matmul": 0.85}
 # dp gradient sync: the monolithic end-of-backward pmean serializes
@@ -68,6 +74,12 @@ _HIDE_DP_BUCKETED = 0.7
 # chunk j+1's transfer behind chunk j's expert GEMM.
 _HIDE_EP = {False: 0.1, True: 0.6}
 _HIDE_PP = 0.0  # pipeline ppermutes sit on the critical path
+
+# the hide-override vocabulary a measured profile may carry (profile
+# capture labels its windows with these; CostModel consults them)
+HIDE_KEYS = ("mp:allreduce", "mp:seq_parallel", "mp:collective_matmul",
+             "dp:monolithic", "dp:bucketed", "ep:plain", "ep:overlap",
+             "pp")
 
 
 # ---------------------------------------------------------------------------
@@ -96,6 +108,14 @@ class HardwareProfile:
     # CPU proxy ordering (allreduce < sp < ring) — while TPU profiles
     # rank by exposed wire after the T3 hidable-fraction discount.
     overlap_capable: bool = True
+    # measured per-mode hidable-fraction overrides (HIDE_KEYS vocabulary),
+    # filled by observability.profile_reader.derive_hardware_profile; a
+    # key present here WINS over the table constant AND the
+    # overlap_capable zeroing (it IS the measurement). compare=False so
+    # frozen-dataclass hashing never touches the dict.
+    hide: Optional[Dict[str, float]] = dataclasses.field(
+        default=None, compare=False)
+    source: str = dataclasses.field(default="table", compare=False)
 
 
 KNOWN_PROFILES: Dict[str, HardwareProfile] = {
@@ -135,6 +155,44 @@ def profile_for(devices=None, *, hbm_gb: Optional[float] = None
     if hbm_gb is not None and hbm_gb > 0:
         prof = dataclasses.replace(prof, hbm_gb=float(hbm_gb))
     return prof
+
+
+def profile_to_json(profile: HardwareProfile) -> Dict[str, Any]:
+    return dataclasses.asdict(profile)
+
+
+def profile_from_json(d: Dict[str, Any]) -> HardwareProfile:
+    """HardwareProfile from a dict (the ``hardware_profile`` payload the
+    profile-capture pipeline serializes); unknown keys are ignored so the
+    format can grow."""
+    fields = {f.name for f in dataclasses.fields(HardwareProfile)}
+    kw = {k: v for k, v in d.items() if k in fields}
+    if kw.get("hide") is not None:
+        kw["hide"] = {str(k): float(v) for k, v in kw["hide"].items()}
+    return HardwareProfile(**kw)
+
+
+def resolve_profile(spec: Optional[str], *,
+                    hbm_gb: Optional[float] = None) -> HardwareProfile:
+    """CLI/launcher profile resolution: a KNOWN_PROFILES name, a path to
+    a measured-profile JSON (the observability.profile_reader artifact —
+    anything ending in .json or naming an existing file), or None to
+    detect from the current backend."""
+    import os
+    if spec:
+        if spec in KNOWN_PROFILES:
+            prof = KNOWN_PROFILES[spec]
+        elif spec.endswith(".json") or os.path.exists(spec):
+            from ...observability.profile_reader import load_profile_json
+            prof = load_profile_json(spec)
+        else:
+            raise ValueError(
+                f"unknown profile {spec!r}: not one of "
+                f"{sorted(KNOWN_PROFILES)} and not a profile JSON path")
+        if hbm_gb is not None and hbm_gb > 0:
+            prof = dataclasses.replace(prof, hbm_gb=float(hbm_gb))
+        return prof
+    return profile_for(hbm_gb=hbm_gb)
 
 
 # ---------------------------------------------------------------------------
@@ -710,25 +768,40 @@ class CostModel:
             total += n * item / _shard_product(spec_axes, sizes)
         return total
 
+    def _hide(self, key: str, table: float) -> float:
+        """Hidable fraction for one wire term: a measured override in the
+        profile's ``hide`` dict wins outright (it came from attributing a
+        real window); otherwise the table constant, zeroed when the
+        backend cannot overlap at all."""
+        h = self.profile.hide or {}
+        if key in h:
+            return min(max(float(h[key]), 0.0), 1.0)
+        return table if self.profile.overlap_capable else 0.0
+
+    def hide_fractions(self, c: PlanCandidate) -> Dict[str, float]:
+        """The per-axis hidable fractions this candidate is scored with
+        (override-aware) — also what the bench's profile_attribution
+        section prints next to the measured ones."""
+        mp_mode = ("allreduce" if self.spec.moe_on
+                   else (c.mp_overlap or "allreduce"))
+        return {
+            "mp": self._hide(f"mp:{mp_mode}", _HIDE_MP[
+                c.mp_overlap if not self.spec.moe_on else "allreduce"]),
+            "dp": (self._hide("dp:bucketed", _HIDE_DP_BUCKETED)
+                   if c.comm_bucket_mb > 0
+                   else self._hide("dp:monolithic", _HIDE_DP_MONOLITHIC)),
+            "ep": self._hide("ep:overlap" if c.moe_overlap else "ep:plain",
+                             _HIDE_EP[bool(c.moe_overlap)]),
+            "pp": self._hide("pp", _HIDE_PP),
+        }
+
     def exposed_comm_s(self, c: PlanCandidate) -> Tuple[float,
                                                         Dict[str, float]]:
         wire = self.wire_bytes(c)
         bw = self.profile.ici_gbs * 1e9
-        if self.profile.overlap_capable:
-            hide_mp = _HIDE_MP[c.mp_overlap if not self.spec.moe_on
-                               else "allreduce"]
-            hide_dp = (_HIDE_DP_BUCKETED if c.comm_bucket_mb > 0
-                       else _HIDE_DP_MONOLITHIC)
-            hide_ep = _HIDE_EP[bool(c.moe_overlap)]
-            hide_pp = _HIDE_PP
-        else:
-            hide_mp = hide_dp = hide_ep = hide_pp = 0.0
-        exp = {
-            "mp": wire["mp"] / bw * (1 - hide_mp),
-            "dp": wire["dp"] / bw * (1 - hide_dp),
-            "ep": wire["ep"] / bw * (1 - hide_ep),
-            "pp": wire["pp"] / bw * (1 - hide_pp),
-        }
+        hide = self.hide_fractions(c)
+        exp = {ax: wire[ax] / bw * (1 - hide[ax])
+               for ax in ("mp", "dp", "ep", "pp")}
         return sum(exp.values()), wire
 
     # -- (c) collective dispatch count --------------------------------------

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .planner import CostModel, PlanCandidate
 
 __all__ = ["measure_candidate", "run_sweep", "ranking_agreement",
-           "reshard_params_hop"]
+           "reshard_params_hop", "profile_candidate"]
 
 
 def _builder(family: str):
@@ -91,6 +91,48 @@ def measure_candidate(cfg, cand: PlanCandidate, *, family: str = "gpt",
     return {"step_s": best, "compile_s": compile_s, "loss": float(loss),
             "params": p, "state": st,
             "layout_extra": init_state.layout_extra}
+
+
+def profile_candidate(cfg, cand: PlanCandidate, *, family: str = "gpt",
+                      global_batch: int, seq: int, steps: int = 3,
+                      rates=None, mode: Optional[str] = None,
+                      host_params=None, optimizer=None):
+    """Build one candidate and capture an ATTRIBUTED profile window of
+    its compiled step (observability.profile_reader): while-trip-aware
+    HLO census, measured rates, compute vs hidden/exposed collective
+    split. `mode` labels what the window measures in the planner's
+    HIDE_KEYS vocabulary ("dp:monolithic", "mp:allreduce", ...) so
+    derive_hardware_profile can map its hidable fraction; pass one
+    shared MeasuredRates across a multi-config capture. The bench's
+    profile_attribution section and the slow-tier attribution gate share
+    this harness."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from ...observability.profile_reader import capture_step_profile
+
+    M = _builder(family)
+    mesh = cand.build_mesh()
+    opt = optimizer if optimizer is not None \
+        else paddle.optimizer.AdamW(learning_rate=1e-4)
+    kw = cand.engine_kwargs(family=family, global_batch=global_batch,
+                            seq=seq)
+    step, shard_params, init_state = M.build_hybrid_train_step(
+        cfg, mesh, opt, **kw)
+    if host_params is None:
+        host_params = M.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    with mesh:
+        p = shard_params(host_params)
+        st = init_state(p)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                     (global_batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                     (global_batch, seq)))
+    return capture_step_profile(
+        step, (p, st, tokens, labels, jnp.float32(1e-4)), steps=steps,
+        label=str(cand), mode=mode, mesh=mesh, rates=rates)
 
 
 def reshard_params_hop(saved: Dict[str, Any], target_params,

@@ -75,6 +75,11 @@ def _launcher_profile(cfg_json: dict):
     if name is None:
         plat = os.environ.get("JAX_PLATFORMS", "")
         name = "cpu" if plat.startswith("cpu") else "tpu-v5e"
+    if name not in KNOWN_PROFILES:
+        # a measured-profile JSON path (observability.profile_reader
+        # capture artifact) — still backend-free: just a file read
+        from ..auto_tuner.planner import resolve_profile
+        return resolve_profile(name)
     return KNOWN_PROFILES[name]
 
 

@@ -23,9 +23,9 @@ import sys
 
 import numpy as np
 
-__all__ = ["run_training", "run_training_resilient", "spawn_cluster",
-           "spawn_and_check", "elastic_restart_check", "main",
-           "ClusterUnsupported"]
+__all__ = ["run_training", "run_training_resilient", "run_training_fleet",
+           "spawn_cluster", "spawn_and_check", "elastic_restart_check",
+           "fleet_telemetry_check", "main", "ClusterUnsupported"]
 
 
 class ClusterUnsupported(RuntimeError):
@@ -94,21 +94,40 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
         procs.append(subprocess.Popen(
             argv, env=env, cwd=repo, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
+    timed_out = False
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=timeout)
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # kill and STILL collect the output: when one rank dies
+                # with the unsupported marker mid-rendezvous, its peer
+                # sometimes wedges in the dead rendezvous instead of
+                # crashing — the marker (in the dead sibling's output)
+                # is what distinguishes that from a real deadlock
+                timed_out = True
+                p.kill()
+                out, _ = p.communicate()
             outs.append(out)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    for out in outs:
+        for marker in _UNSUPPORTED_MARKERS:
+            if marker in out:
+                raise ClusterUnsupported(
+                    f"platform cannot run the {nproc}-process cluster "
+                    f"({marker!r}):\n{out[-1500:]}")
+    if timed_out:
+        # no unsupported marker anywhere: a genuine hang stays a HARD
+        # failure (a deadlock regression must not report as a skip)
+        raise RuntimeError(
+            f"worker(s) exceeded the {timeout}s timeout with no "
+            f"unsupported-platform marker:\n" +
+            "\n".join(o[-1500:] for o in outs))
     for p, out in zip(procs, outs):
         if p.returncode not in ok_returncodes:
-            for marker in _UNSUPPORTED_MARKERS:
-                if marker in out:
-                    raise ClusterUnsupported(
-                        f"platform cannot run the {nproc}-process cluster "
-                        f"({marker!r}):\n{out[-1500:]}")
             raise RuntimeError(f"worker failed (rc={p.returncode}):\n"
                                f"{out[-4000:]}")
     results = []
@@ -212,6 +231,101 @@ def run_training_resilient(mesh, steps: int, ckpt_dir: str):
                             layout_extra=init_state.layout_extra,
                             on_step=lambda i, l: losses.__setitem__(i, l))
     return losses, info
+
+
+def run_training_fleet(mesh, steps: int, store, rank: int, world: int,
+                       slow_ms: float = 0.0, interval: int = 4):
+    """The seed-deterministic tiny-GPT workload driven through
+    ``run_resilient(aggregator=)`` with a fleet TelemetryAggregator over
+    the shared TCP store — the fleet-telemetry leg's one workload copy.
+    ``slow_ms`` injects a per-step stall on THIS rank (the synthetic
+    straggler the detector must flag). Returns run_resilient's info;
+    rank 0's ``info["fleet"]`` carries the last aggregate report
+    (per-host medians/p95, skew, stragglers)."""
+    import tempfile
+    import time as _time
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.observability import TelemetryAggregator
+    from .resilience import run_resilient
+
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, max_seq_len=16, dtype=jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step, shard_params, init_state = G.build_hybrid_train_step(
+        cfg, mesh, opt)
+    params = shard_params(params)
+    state = {"params": params, "opt": init_state(params)}
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+
+    def step_fn(st, i):
+        del i
+        if slow_ms > 0:
+            _time.sleep(slow_ms / 1e3)  # the injected straggler stall
+        p, s, loss = step(st["params"], st["opt"], tokens, labels,
+                          jnp.float32(1e-2))
+        return {"params": p, "opt": s}, loss
+
+    agg = TelemetryAggregator(rank=rank, world_size=world, store=store,
+                              host=rank, interval=interval, window=16,
+                              straggler_factor=1.35, gather_timeout_s=60.0)
+    ckpt_dir = tempfile.mkdtemp(prefix="fleet_ck_")
+    try:
+        _, info = run_resilient(step_fn, state, steps=steps,
+                                ckpt_dir=ckpt_dir, ckpt_every=0,
+                                resume=False, aggregator=agg)
+    finally:
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return info
+
+
+def fleet_telemetry_check(n_devices: int, timeout: float = 300.0,
+                          steps: int = 8, slow_ms: float = 80.0) -> dict:
+    """Fleet-telemetry leg: a 2-process dp2 x mp(n/2) cluster trains with
+    a TelemetryAggregator over a real cross-process TCP store; rank 1 is
+    artificially slowed by `slow_ms` per step, and rank 0's aggregate
+    MUST flag exactly host 1 as the straggler (skew above the 1.35
+    factor) with the straggler_detected event emitted. Returns a summary
+    dict for the dryrun record."""
+    from .. import _native
+    if _native.load() is None:
+        raise ClusterUnsupported(
+            "fleet telemetry leg needs the native TCPStore (cross-process "
+            "KV); the pure-Python fallback store is in-process only")
+    assert n_devices % 2 == 0 and n_devices >= 4, n_devices
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    store_port = s.getsockname()[1]
+    s.close()
+    results = spawn_cluster(
+        [sys.executable, "-m", "paddle_tpu.distributed.mp_smoke"],
+        nproc=2, devices_per_proc=n_devices // 2, sentinel="MPSMOKE ",
+        timeout=timeout,
+        extra_env={"MPSMOKE_MODE": "fleet",
+                   "MPSMOKE_STORE_PORT": str(store_port),
+                   "MPSMOKE_STEPS": str(steps),
+                   "MPSMOKE_SLOW_MS": str(slow_ms)})
+    r0 = next((r for r in results if r and r.get("rank") == 0), None)
+    assert r0 is not None and r0.get("fleet"), results
+    rep = r0["fleet"]
+    if rep["stragglers"] != [1]:
+        raise AssertionError(
+            f"straggler detector flagged {rep['stragglers']}, expected "
+            f"[1] (rank 1 slowed by {slow_ms} ms/step); report: {rep}")
+    if not rep["skew"] or rep["skew"] <= 1.35:
+        raise AssertionError(f"skew {rep['skew']} not above the 1.35 "
+                             f"straggler factor; report: {rep}")
+    return {"stragglers": rep["stragglers"],
+            "skew": round(rep["skew"], 2),
+            "fleet_median_ms": round(rep["fleet_median_ms"], 2),
+            "hosts": {h: round(st["median_ms"], 2)
+                      for h, st in rep["hosts"].items()}}
 
 
 def elastic_restart_check(n_devices: int, ckpt_dir: str, devices=None,
@@ -356,6 +470,32 @@ def main():
 
     mode = os.environ.get("MPSMOKE_MODE", "dpmp")
     n = len(jax.devices())
+    if mode == "fleet":
+        # fleet-telemetry worker: dp spans the two processes; every rank
+        # publishes its step-time window + prom snapshot through the
+        # launcher's TCP store, rank 0 aggregates and must flag the
+        # slowed rank as a straggler
+        from .store import MasterStore
+        rank = jax.process_index()
+        mesh = build_mesh({"dp": 2, "pp": 1, "mp": n // 2})
+        store = MasterStore(
+            f"127.0.0.1:{os.environ['MPSMOKE_STORE_PORT']}", 2, rank,
+            timeout=60.0)  # bounded: a dead sibling must not wedge us
+        #                    past the launcher's spawn timeout
+        slow = (float(os.environ.get("MPSMOKE_SLOW_MS", "0"))
+                if rank == 1 else 0.0)
+        info = run_training_fleet(
+            mesh, steps=int(os.environ.get("MPSMOKE_STEPS", "8")),
+            store=store, rank=rank, world=2, slow_ms=slow)
+        print("MPSMOKE " + json.dumps(
+            {"rank": rank, "mode": mode, "fleet": info.get("fleet")}),
+            flush=True)
+        # rank 0 hosts the store server: linger briefly so a slower peer
+        # can finish its last publish before the server dies with us
+        if rank == 0:
+            import time as _time
+            _time.sleep(1.0)
+        return
     if mode == "elastic":
         # elastic-restart worker: dp spans the two processes, per-step
         # crash-safe commits with layout metadata; the launcher arms

@@ -127,6 +127,7 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                   keep_n: Optional[int] = None,
                   resume: bool = True,
                   layout_extra: Optional[Dict[str, Any]] = None,
+                  aggregator=None,
                   on_step: Optional[Callable[[int, Optional[float]], None]]
                   = None) -> Tuple[Dict, Dict[str, Any]]:
     """Drive ``step_fn(state, step) -> (new_state, loss)`` for ``steps``
@@ -134,6 +135,19 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
     ``(final_state, info)``; info records resume/preemption/watchdog
     details. `state` must be a (nested) dict of arrays/scalars — the same
     contract as ``save_state_dict``.
+
+    aggregator: a fleet :class:`observability.TelemetryAggregator` — the
+    loop feeds it every step's wall time (loss forced, so it measures
+    execution, not dispatch) and drives its publish/gather cadence; rank
+    0's gauges then carry per-host step-time p50/p95 and straggler flags
+    (``straggler_detected`` JSONL events). The final fleet report lands
+    in ``info["fleet"]``.
+
+    Crash forensics: when FLAGS_flight_recorder_dir is set, a watchdog
+    timeout (the CommWatchdog dumps from its own monitor thread), the
+    SIGTERM drain and the non-finite abort each leave a bounded
+    flight-recorder bundle (telemetry ring tail, recent JSONL events,
+    open spans, heartbeat ages).
 
     Elastic resume (FLAGS_ckpt_reshard): commits record the topology
     layout (schema v2), and resume compares it against THIS run's `state`
@@ -241,9 +255,15 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                 info["preempted"] = True
                 return
             faults.maybe_fail("loop/before_step")
+            t_step0 = time.perf_counter()
             with wd.watch("resilient_step", timeout=step_timeout):
+                # the wedged-step injection point (hangN clause): stalls
+                # INSIDE the watchdog span so the timeout + flight
+                # recorder fire, then the step proceeds normally
+                faults.maybe_fail("watchdog/hang")
                 new_state, loss = step_fn(state, i)
             loss_val = _loss_value(loss)
+            step_ms = (time.perf_counter() - t_step0) * 1e3
             if loss_val is not None and not math.isfinite(loss_val):
                 # found_inf discipline at loop level: reject the step,
                 # keep the last good state
@@ -253,6 +273,9 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                       consecutive=progress["nonfinite"])
                 if progress["nonfinite"] >= max_consecutive_nonfinite:
                     from ...amp.grad_scaler import nonfinite_report
+                    from ...observability.flight_recorder import maybe_dump
+                    maybe_dump("nonfinite_abort", watchdog=wd,
+                               extra={"step": i, "loss": loss_val})
                     raise NonFiniteLossError(
                         f"{progress['nonfinite']} consecutive non-finite "
                         f"steps (last loss={loss_val} at step {i}); "
@@ -262,6 +285,11 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                 progress["nonfinite"] = 0
                 state = new_state
             progress["done"] = i + 1
+            if aggregator is not None:
+                # float(loss) above forced the step, so this is executed
+                # wall time — what the straggler detector must see
+                aggregator.note_step(step_ms)
+                aggregator.tick(i)
             if on_step is not None:
                 on_step(i, loss_val)
             if (ckpt_every and progress["done"] % ckpt_every == 0
@@ -293,6 +321,10 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
             if info["preempted"]:
                 _emit("resilience_sigterm", step=done,
                       watchdog_abort=info["watchdog_abort"])
+                from ...observability.flight_recorder import maybe_dump
+                maybe_dump("watchdog_abort" if info["watchdog_abort"]
+                           else "sigterm", watchdog=wd,
+                           extra={"step": done})
                 # preemption drain: flush in-flight async writers, then one
                 # final SYNCHRONOUS commit inside the grace budget
                 t0 = time.monotonic()
@@ -321,6 +353,8 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
 
     info["completed_steps"] = done
     info["watchdog"] = wd.stats()
+    if aggregator is not None:
+        info["fleet"] = aggregator.last_report
     _emit("resilience_run_end", completed_steps=done,
           preempted=info["preempted"],
           watchdog_abort=info["watchdog_abort"],

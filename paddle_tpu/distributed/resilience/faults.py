@@ -17,6 +17,10 @@ Spec grammar (``FLAGS_fault_inject``, comma-separated clauses)::
                         from FLAGS_fault_inject_seed, so the same seed+spec
                         replays the identical failure schedule
     site:p0.25:kill     probabilistic hard-exit
+    site:2:hang5        HANG: on the 2nd hit, block in time.sleep for 5
+                        seconds then CONTINUE normally (no exception) —
+                        the wedged-step simulator the watchdog/flight-
+                        recorder tests arm (``hang`` alone sleeps 30 s)
 
 Sites currently planted (grep for ``maybe_fail`` /
 ``maybe_corrupt_file`` to enumerate):
@@ -32,6 +36,10 @@ Sites currently planted (grep for ``maybe_fail`` /
 * ``store/connect`` ``store/get`` ``store/set`` ``store/wait`` — transient
   store faults (raised as TransientStoreError so the retry path engages)
 * ``loop/before_step``        — the resilient train driver's step boundary
+* ``watchdog/hang``           — INSIDE the driver's watchdog span, before
+  the step runs: arm with a ``hangN`` clause to wedge the step past its
+  budget so the watchdog fires and the flight recorder dumps, then let
+  the run continue (the hang is a stall, not a crash)
 """
 
 from __future__ import annotations
@@ -53,14 +61,15 @@ class FaultInjected(RuntimeError):
 
 
 class _Clause:
-    __slots__ = ("site", "nth", "prob", "kill", "fired", "rng")
+    __slots__ = ("site", "nth", "prob", "kill", "hang_s", "fired", "rng")
 
     def __init__(self, site: str, nth: Optional[int], prob: Optional[float],
-                 kill: bool):
+                 kill: bool, hang_s: Optional[float] = None):
         self.site = site
         self.nth = nth
         self.prob = prob
         self.kill = kill
+        self.hang_s = hang_s
         self.fired = False
         self.rng: Optional[random.Random] = None
 
@@ -90,16 +99,19 @@ def configure(spec: str) -> None:
         nth: Optional[int] = 1
         prob: Optional[float] = None
         kill = False
+        hang_s: Optional[float] = None
         for p in parts[1:]:
             if p == "kill":
                 kill = True
             elif p == "raise":
                 kill = False
+            elif p.startswith("hang"):
+                hang_s = float(p[4:]) if p[4:] else 30.0
             elif p.startswith("p"):
                 prob, nth = float(p[1:]), None
             else:
                 nth = int(p)
-        armed[site] = _Clause(site, nth, prob, kill)
+        armed[site] = _Clause(site, nth, prob, kill, hang_s)
     with _LOCK:
         _ARMED.clear()
         _ARMED.update(armed)
@@ -176,10 +188,18 @@ def _fire(site: str, exc, before=None) -> None:
             fire = (not cl.fired) and n == cl.nth
             cl.fired = cl.fired or fire
         kill = cl.kill
+        hang_s = cl.hang_s
     if not fire:
         return
     if before is not None:
         before()  # e.g. tear the file THEN die, like real torn storage
+    if hang_s is not None:
+        # a STALL, not a crash: wedge here (outside the lock) long enough
+        # for the watchdog to fire, then resume normally — the injected
+        # hang a flight-recorder test diagnoses from the bundle alone
+        import time
+        time.sleep(hang_s)
+        return
     if kill:
         os._exit(FAULT_EXIT_CODE)  # crash without cleanup: no atexit drain,
         #                            no buffered IO flush — a real SIGKILL

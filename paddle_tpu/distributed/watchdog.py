@@ -130,7 +130,23 @@ class CommWatchdog:
             for s in overdue:
                 with self._lock:
                     self.timeout_count += 1
-                self.on_timeout(s, self._report(s, now))
+                report = self._report(s, now)
+                # hang flight recorder (FLAGS_flight_recorder_dir): dump
+                # the crash bundle HERE, independent of on_timeout — the
+                # resilient driver replaces the handler for escalation
+                # and a custom handler must not lose the forensics.
+                # Inert (one flag read) when the recorder is off.
+                try:
+                    from ..observability.flight_recorder import maybe_dump
+                    maybe_dump(f"watchdog_timeout:{s.tag}", watchdog=self,
+                               report=report,
+                               extra={"tag": s.tag,
+                                      "running_s": round(now - s.start, 3),
+                                      "budget_s": round(
+                                          s.deadline - s.start, 3)})
+                except Exception:
+                    pass
+                self.on_timeout(s, report)
 
     def _report(self, span: "_Span", now: float) -> str:
         lines = [

@@ -546,6 +546,38 @@ define_flag("telemetry_prometheus_port", 0,
             "utilization, decode/prefill mix). 0 disables (consumed by "
             "observability.prom.serve_registry via "
             "inference.ServingEngine.serve_metrics).")
+define_flag("telemetry_jsonl_max_mb", 0.0,
+            "Size cap in MB for the JSONL event log before it rotates "
+            "(the live file renames to <path>.1 and a fresh file opens "
+            "with a jsonl_rotated event). 0 = unbounded (consumed by "
+            "observability.events.EventLog).")
+define_flag("telemetry_fleet_window", 32,
+            "Per-host step-time window length (recent steps) the fleet "
+            "TelemetryAggregator gathers into rank-0 gauges and feeds "
+            "the straggler detector (consumed by "
+            "observability.aggregate.TelemetryAggregator).")
+define_flag("telemetry_fleet_interval", 16,
+            "Steps between fleet-telemetry publish/aggregate rounds "
+            "through the distributed store (consumed by "
+            "observability.aggregate.TelemetryAggregator.tick).")
+define_flag("telemetry_straggler_factor", 1.5,
+            "A host is flagged as a straggler when its step-time window "
+            "median exceeds the fleet median by this factor (consumed by "
+            "observability.aggregate.detect_stragglers; emits a "
+            "straggler_detected JSONL event).")
+define_flag("flight_recorder_dir", "",
+            "Crash-bundle directory for the hang flight recorder: on a "
+            "watchdog timeout, resilience SIGTERM or nonfinite abort, a "
+            "bounded bundle (telemetry ring tail, recent JSONL events, "
+            "open spans, per-host heartbeat ages, active profile window) "
+            "is dumped here. Empty disables (consumed by "
+            "observability.flight_recorder).")
+define_flag("flight_recorder_events", 200,
+            "JSONL event-log tail length (lines) included in a flight "
+            "recorder bundle.")
+define_flag("flight_recorder_keep", 4,
+            "Flight-recorder bundles retained in FLAGS_flight_recorder_dir "
+            "(oldest pruned first — the crash dir stays bounded).")
 
 # --- data / io -------------------------------------------------------------
 define_flag("dataloader_num_workers", 0,

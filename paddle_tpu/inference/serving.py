@@ -48,7 +48,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from collections import deque
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -59,6 +58,7 @@ from ..enforce import InvalidArgumentError
 from jax import lax
 
 from ..models import gpt as G
+from ..profiler.utils import RecordEvent
 
 __all__ = ["Request", "ServingEngine", "generate_static_batch"]
 
@@ -385,10 +385,12 @@ class ServingEngine:
         self._c_att = max(1, min(chunk, self.token_budget))
         self.adaptive_mix = adaptive_mix
         self.ttft_slo_s = ttft_slo_s
-        # SLO pressure reads a recent window, not the exported summary's
-        # lifetime mean — one compile-heavy startup wave must not pin the
-        # adaptive mix at shortened bursts for the engine's whole life
-        self._recent_ttft: deque = deque(maxlen=16)
+        # SLO pressure reads the prom registry's recent-window p95 (16
+        # samples), not the exported summary's lifetime mean — one
+        # compile-heavy startup wave must not pin the adaptive mix at
+        # shortened bursts for the engine's whole life, and a p95 SLO is
+        # what the fleet router will compare across replicas
+        self._ttft_window = 16
         # dispatch accounting (the ragged path's contract is ONE compiled
         # dispatch per engine step; the bench reports dispatches/step)
         self.dispatches = 0
@@ -676,10 +678,10 @@ class ServingEngine:
             return self.decode_burst
         q_depth = int(self._prom.get("queue_depth") or 0)
         pressure = q_depth + n_prefilling
-        # recent window, NOT the summary's lifetime mean: the mean never
-        # decays, so one slow startup wave would halve bursts forever
-        ttft = (sum(self._recent_ttft) / len(self._recent_ttft)
-                if self._recent_ttft else None)
+        # recent-window p95, NOT the summary's lifetime mean: the mean
+        # never decays, so one slow startup wave would halve bursts
+        # forever; p95 (vs the window mean) is the tail the SLO names
+        ttft = self._prom.quantile("ttft_seconds", 0.95)
         if (self.ttft_slo_s is not None and ttft is not None
                 and ttft > self.ttft_slo_s):
             pressure = max(pressure * 2, 1)
@@ -705,7 +707,10 @@ class ServingEngine:
         from ..observability import get_event_log
         log = get_event_log()
         if log is not None:
-            log.emit("serving_admit", rid=rid, prompt_len=len(r.prompt),
+            # role override: serving events stay attributable after
+            # merge_event_streams folds them into the trainer timeline
+            log.emit("serving_admit", role="serving", rid=rid,
+                     prompt_len=len(r.prompt),
                      max_new_tokens=r.max_new_tokens,
                      queue_depth=len(self.queue))
         return rid
@@ -778,10 +783,13 @@ class ServingEngine:
         self._tokens_total += 1
         if len(r.output) == 1:
             r.ttft_s = time.perf_counter() - r.submit_time
-            self._recent_ttft.append(r.ttft_s)
             self._prom.summary_observe(
                 "ttft_seconds", r.ttft_s,
-                help="submit-to-first-token latency")
+                help="submit-to-first-token latency",
+                window=self._ttft_window)
+            self._prom.histogram_observe(
+                "ttft_seconds_hist", r.ttft_s,
+                help="submit-to-first-token latency distribution")
         if r.on_token is not None:
             r.on_token(r.rid, tok)
         return (len(r.output) >= r.max_new_tokens
@@ -791,11 +799,17 @@ class ServingEngine:
         """One engine iteration. Ragged path: admit -> ONE compiled
         program (prefill chunks + decode burst fused over a packed
         ragged batch). Two-program path: admit -> one prefill chunk ->
-        one decode burst. Returns requests finished this step."""
+        one decode burst. Returns requests finished this step.
+
+        The whole step runs inside a ``serving_step`` RecordEvent span
+        (dispatches get their own nested spans), so serving lands on the
+        SAME host timeline as training: Profiler summaries, chrome-trace
+        exports and observability.capture_spans all see it."""
         self.engine_steps += 1
-        if self.ragged:
-            return self._step_ragged()
-        return self._step_two_program()
+        with RecordEvent("serving_step"):
+            if self.ragged:
+                return self._step_ragged()
+            return self._step_two_program()
 
     def _step_two_program(self) -> List[Request]:
         """The frozen parity baseline: one batched prefill-chunk dispatch
@@ -833,13 +847,17 @@ class ServingEngine:
                 his[i] = hi
             self._key, sub = jax.random.split(self._key)
             self.dispatches += 1
-            tok_dev, self.k_pools, self.v_pools = self._prefill(
-                self.params, jnp.asarray(buf), jnp.asarray(pos0),
-                jnp.asarray(tables_pre), jnp.asarray(last_idx),
-                jnp.asarray(temps), sub, self.k_pools, self.v_pools)
-            completing = [r for r in pre
-                          if his[r.slot] >= len(r.prompt)]
-            tok_np = np.asarray(tok_dev) if completing else None  # 1 fetch
+            with RecordEvent("serving_prefill_dispatch"):
+                tok_dev, self.k_pools, self.v_pools = self._prefill(
+                    self.params, jnp.asarray(buf), jnp.asarray(pos0),
+                    jnp.asarray(tables_pre), jnp.asarray(last_idx),
+                    jnp.asarray(temps), sub, self.k_pools, self.v_pools)
+                completing = [r for r in pre
+                              if his[r.slot] >= len(r.prompt)]
+                # the fetch stays INSIDE the span: dispatch is async, the
+                # wall time lands here — a span around only the call
+                # would attribute prefill to nothing on the timeline
+                tok_np = np.asarray(tok_dev) if completing else None
             for r in pre:
                 r.prefill_done = his[r.slot]
                 self.lens[r.slot] = his[r.slot]
@@ -876,12 +894,13 @@ class ServingEngine:
                         break
             self.decode_microsteps += K
             self.dispatches += 1
-            toks, self.k_pools, self.v_pools, lens = self._decode_k[K](
-                self.params, jnp.asarray(self._pending_tok), self.k_pools,
-                self.v_pools, jnp.asarray(self.tables),
-                jnp.asarray(self.lens), jnp.asarray(remaining),
-                jnp.asarray(eos_ids), jnp.asarray(temps), sub)
-            toks = np.asarray(toks)          # [K, B] — ONE host fetch
+            with RecordEvent("serving_decode_dispatch"):
+                toks, self.k_pools, self.v_pools, lens = self._decode_k[K](
+                    self.params, jnp.asarray(self._pending_tok),
+                    self.k_pools, self.v_pools, jnp.asarray(self.tables),
+                    jnp.asarray(self.lens), jnp.asarray(remaining),
+                    jnp.asarray(eos_ids), jnp.asarray(temps), sub)
+                toks = np.asarray(toks)      # [K, B] — ONE host fetch
             self.lens = np.array(lens)
             for r in dec:
                 for t in range(toks.shape[0]):
@@ -990,9 +1009,10 @@ class ServingEngine:
         if self.kv_quantized:
             args = args + (self.k_scales, self.v_scales)
         self.dispatches += 1
-        (toks, self.k_pools, self.v_pools, self.k_scales, self.v_scales,
-         lens) = self._unified(K)(*args)
-        toks = np.asarray(toks)              # [K, R] — ONE host fetch
+        with RecordEvent("serving_unified_dispatch"):
+            (toks, self.k_pools, self.v_pools, self.k_scales,
+             self.v_scales, lens) = self._unified(K)(*args)
+            toks = np.asarray(toks)          # [K, R] — ONE host fetch
         self.lens = np.array(lens)
         for r in pre:
             r.prefill_done += grants.get(r.slot, 0)
@@ -1075,7 +1095,7 @@ class ServingEngine:
                     time.perf_counter() - r.submit_time,
                     help="submit-to-completion latency")
                 if log is not None:
-                    log.emit("serving_complete", rid=r.rid,
+                    log.emit("serving_complete", role="serving", rid=r.rid,
                              tokens=len(r.output), ttft_s=r.ttft_s)
 
     def metrics_text(self) -> str:

@@ -406,6 +406,12 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         # accounting into this run's JSONL header
         tcfg.static["mesh"] = {a: int(mesh.shape[a])
                                for a in mesh.axis_names}
+        # attribution metadata for MERGED streams (fleet aggregation /
+        # merge_event_streams): which process and which half of the
+        # system this buffer's telemetry events came from
+        from ..observability.events import default_host
+        tcfg.static["host"] = default_host()
+        tcfg.static["role"] = "trainer"
         for k in ("comm_buckets_bytes", "comm_quantize",
                   "comm_microbatches", "mp_mode", "moe"):
             tcfg.static.pop(k, None)

@@ -15,19 +15,37 @@ makes the split measurable without leaving the compiled program:
 * :mod:`.step_timer` / :mod:`.flops` — compile vs steady-state split,
   per-phase breakdown, analytic GPT/Llama FLOPs (fwd/bwd/remat-aware)
   for MFU, comms fraction measured or estimated from bucket plans.
-* :mod:`.events` — flushed-per-line JSONL event log (crash forensics;
-  the resilient runner logs resumes/skips/commits/SIGTERM through it).
+* :mod:`.events` — flushed-per-line, size-capped JSONL event log with
+  host/role-tagged records (crash forensics; the resilient runner logs
+  resumes/skips/commits/SIGTERM through it) + ``merge_event_streams``
+  for one role-tagged timeline over trainer + serving logs.
 * :mod:`.trace` — chrome-trace spans unified with ``paddle_tpu.profiler``.
-* :mod:`.prom` — Prometheus text-format scrape surface for the serving
-  engine (TTFT, tokens/s, queue depth, KV-pool utilization, decode/
-  prefill mix).
+* :mod:`.prom` — Prometheus text-format scrape surface (counters,
+  gauges, summaries, bucketed histograms, recent-window p50/p95
+  quantiles) for the serving engine and the fleet view.
+* :mod:`.profile_reader` — the MEASUREMENT half (ISSUE 11): capture a
+  windowed profile of a compiled step (while-trip-aware compiled-HLO op
+  census + micro-benchmarked rates), attribute per-op time into compute
+  vs hidden/exposed collective time by kind, and derive a measured
+  ``HardwareProfile`` JSON the auto-parallel planner consumes directly.
+* :mod:`.aggregate` — fleet telemetry: per-process step-time windows +
+  prom snapshots gathered through the distributed store into rank-0
+  gauges, with straggler detection (``straggler_detected`` events).
+* :mod:`.flight_recorder` — hang flight recorder: watchdog timeouts and
+  resilience SIGTERM/abort paths dump a bounded crash bundle (telemetry
+  ring tail, recent events, open spans, heartbeat ages, active profile
+  window).
 
 Entry points: ``models.hybrid_engine.build_train_step(telemetry=)``,
 ``Model.fit``, ``distributed.resilience.run_resilient``,
 ``inference.ServingEngine`` and ``bench.py``. See README "Observability".
 """
 
-from .events import EventLog, emit_event, get_event_log, set_event_log
+from .aggregate import TelemetryAggregator, detect_stragglers
+from .events import (EventLog, emit_event, get_event_log,
+                     merge_event_streams, set_event_log)
+from .flight_recorder import (FlightRecorder, get_flight_recorder,
+                              set_flight_recorder)
 from .flops import (collective_seconds, gpt_flops_per_token,
                     gpt_moe_flops_per_token, llama_flops_per_token, mfu,
                     param_count, peak_flops, plan_wire_bytes,
@@ -37,6 +55,11 @@ from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
                       init_buffer, mp_comm_scope, mp_wire_bytes,
                       note_ep_comm, note_mp_comm, observe,
                       telemetry_from_flags, update_buffer)
+from .profile_reader import (MeasuredRates, ProfileWindow,
+                             capture_step_profile, derive_hardware_profile,
+                             hlo_census, load_profile_json,
+                             measure_collective_rates, measure_compute_rate,
+                             save_profile_json)
 from .prom import MetricsServer, PromRegistry, serve_registry
 from .step_timer import StepTimer
 from .trace import capture_spans, span, write_chrome_trace
@@ -52,6 +75,12 @@ __all__ = [
     "transformer_flops_per_token", "param_count", "mfu", "peak_flops",
     "collective_seconds", "plan_wire_bytes",
     "EventLog", "emit_event", "get_event_log", "set_event_log",
+    "merge_event_streams",
     "PromRegistry", "MetricsServer", "serve_registry",
     "span", "capture_spans", "write_chrome_trace",
+    "hlo_census", "capture_step_profile", "derive_hardware_profile",
+    "save_profile_json", "load_profile_json", "measure_compute_rate",
+    "measure_collective_rates", "MeasuredRates", "ProfileWindow",
+    "TelemetryAggregator", "detect_stragglers",
+    "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
 ]

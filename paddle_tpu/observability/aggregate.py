@@ -1,0 +1,285 @@
+"""Fleet telemetry: per-process windows gathered into rank-0 gauges,
+with straggler detection.
+
+The MLPerf TPU-pod scaling study (arXiv:1909.09756) found per-host
+step-time skew is the first thing a fleet view must surface — one slow
+host gates every synchronous collective, so the fleet runs at the
+straggler's pace while every per-chip metric still looks healthy. This
+module is that view:
+
+* every process keeps a bounded window of recent step times
+  (:meth:`TelemetryAggregator.note_step`) plus its Prometheus registry
+  snapshot, and publishes both through the distributed TCP store on a
+  step cadence (``FLAGS_telemetry_fleet_interval``);
+* rank 0 gathers the round, reduces each host's window to median/p95,
+  exports ``step_ms_p50_host<h>`` / ``step_ms_p95_host<h>`` and the
+  fleet-level ``step_time_skew`` gauges into its own registry, and flags
+  a **straggler** whenever a host's window median exceeds the fleet
+  median by ``FLAGS_telemetry_straggler_factor`` — emitting a
+  ``straggler_detected`` JSONL event per offender;
+* a host that misses a round is reported (and its last-heartbeat age
+  grows) instead of wedging the gather — the aggregate is telemetry, it
+  must never become a barrier.
+
+Wired into ``run_resilient(aggregator=)`` and the ``mp_smoke`` fleet
+dryrun leg; single-process runs (store=None, world_size=1) aggregate
+locally so the same code path is exercised everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["TelemetryAggregator", "detect_stragglers", "percentile"]
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile of a non-empty list (q in [0, 1]) — the
+    prom registry's order statistic (one shared copy): the median of 2
+    values is the LOWER one, which keeps the fleet median robust when
+    half a tiny fleet straggles."""
+    from .prom import nearest_rank
+    return nearest_rank(sorted(values), q)
+
+
+def detect_stragglers(windows: Dict[Any, List[float]], *,
+                      factor: float) -> Dict[str, Any]:
+    """The pure detector: per-host step-time windows (ms) -> per-host
+    median/p95, the fleet median (median of host medians — robust to one
+    wild host), the skew ratio (worst host median / fleet median), and
+    the hosts whose median exceeds ``fleet_median * factor``. Hosts with
+    empty windows are reported under "missing" and never flagged (no
+    data is a liveness question for the heartbeat ages, not a speed
+    verdict)."""
+    stats: Dict[Any, Dict[str, float]] = {}
+    missing: List[Any] = []
+    for h, w in windows.items():
+        if not w:
+            missing.append(h)
+            continue
+        stats[h] = {"median_ms": percentile(w, 0.5),
+                    "p95_ms": percentile(w, 0.95), "n": len(w)}
+    if not stats:
+        return {"fleet_median_ms": None, "skew": None, "hosts": {},
+                "stragglers": [], "missing": missing}
+    medians = [s["median_ms"] for s in stats.values()]
+    fleet = percentile(medians, 0.5)
+    worst = max(medians)
+    stragglers = [h for h, s in stats.items()
+                  if fleet > 0 and s["median_ms"] > fleet * factor]
+    return {"fleet_median_ms": fleet,
+            "skew": (worst / fleet) if fleet > 0 else None,
+            "hosts": stats, "stragglers": sorted(stragglers),
+            "missing": missing}
+
+
+class TelemetryAggregator:
+    """Fleet step-time/prom aggregation over the distributed store (see
+    module doc). Every rank constructs one; ``tick(step)`` drives the
+    publish/gather cadence and returns rank 0's aggregate report on the
+    rounds it lands (None otherwise)."""
+
+    def __init__(self, *, rank: int = 0, world_size: int = 1, store=None,
+                 role: str = "trainer", host: Optional[int] = None,
+                 window: Optional[int] = None,
+                 interval: Optional[int] = None,
+                 straggler_factor: Optional[float] = None,
+                 prom=None, event_log=None,
+                 key_prefix: str = "telemetry/agg",
+                 gather_timeout_s: float = 10.0):
+        from ..flags import flag
+        from .events import default_host
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.store = store
+        self.role = str(role)
+        self.host = default_host() if host is None else int(host)
+        self.window = int(window if window is not None
+                          else flag("telemetry_fleet_window"))
+        self.interval = max(int(interval if interval is not None
+                                else flag("telemetry_fleet_interval")), 1)
+        self.factor = float(straggler_factor if straggler_factor is not None
+                            else flag("telemetry_straggler_factor"))
+        self.key_prefix = key_prefix
+        self.gather_timeout_s = float(gather_timeout_s)
+        if prom is None:
+            from .prom import PromRegistry
+            prom = PromRegistry(namespace="paddle_tpu_fleet")
+        self.prom = prom
+        self._event_log = event_log
+        self._steps = deque(maxlen=max(self.window, 1))
+        self._round = 0
+        self._steps_seen = 0
+        self.last_report: Optional[Dict[str, Any]] = None
+        # rank 0's liveness view: host -> last payload wall-clock ts
+        self._last_seen: Dict[int, float] = {}
+        self._flagged: set = set()  # hosts already reported this episode
+        try:  # crash bundles include heartbeat ages + the last report
+            from .flight_recorder import register_aggregator
+            register_aggregator(self)
+        except Exception:
+            pass
+
+    # -- producer side -------------------------------------------------------
+    def note_step(self, step_ms: float) -> None:
+        """Record one step's wall time (ms); also feeds the local
+        ``step_ms`` histogram so the per-process scrape has the full
+        distribution, not just the window."""
+        self._steps.append(float(step_ms))
+        self._steps_seen += 1
+        self.prom.histogram_observe("step_ms", float(step_ms),
+                                    help="train step wall time (ms)")
+
+    def _log(self):
+        if self._event_log is not None:
+            return self._event_log
+        from .events import get_event_log
+        return get_event_log()
+
+    def _payload(self) -> Dict[str, Any]:
+        return {"host": self.host, "rank": self.rank, "role": self.role,
+                "ts": time.time(), "steps_seen": self._steps_seen,
+                "window_ms": [round(v, 4) for v in self._steps],
+                "prom": self.prom.snapshot()}
+
+    def publish(self) -> None:
+        """Ship this process's window + prom snapshot for the current
+        round (store-less single-process mode skips the wire)."""
+        if self.store is None:
+            return
+        self.store.set(f"{self.key_prefix}/{self._round}/{self.rank}",
+                       json.dumps(self._payload()))
+
+    def gather(self) -> Dict[int, Optional[Dict[str, Any]]]:
+        """Rank 0: collect every rank's payload for the current round; a
+        rank that misses the gather budget yields None (reported as
+        missing, never a hang). ``gather_timeout_s`` budgets the WHOLE
+        round, not each rank — N dead hosts must not stall rank 0's
+        training loop N times per round. Consumed keys — this round's
+        and, to catch late publishers, the previous round's — are
+        deleted so the master store stays bounded over million-step
+        runs."""
+        deadline = time.monotonic() + self.gather_timeout_s
+        out: Dict[int, Optional[Dict[str, Any]]] = {}
+        for r in range(self.world_size):
+            if r == self.rank:
+                out[r] = self._payload()
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                out[r] = None
+                continue
+            try:
+                raw = self.store.get(
+                    f"{self.key_prefix}/{self._round}/{r}",
+                    timeout=remaining)
+                out[r] = json.loads(raw.decode("utf-8"))
+            except Exception:
+                out[r] = None
+        for rnd in (self._round, self._round - 1):
+            if rnd < 0:
+                continue
+            for r in range(self.world_size):
+                try:
+                    self.store.delete_key(f"{self.key_prefix}/{rnd}/{r}")
+                except Exception:
+                    pass
+        return out
+
+    # -- rank-0 reduction ----------------------------------------------------
+    def aggregate(self, payloads: Dict[int, Optional[Dict[str, Any]]],
+                  step: Optional[int] = None) -> Dict[str, Any]:
+        """Reduce one round's payloads into the fleet report + rank-0
+        gauges, flagging stragglers (one straggler_detected event per
+        offender per episode — a host must recover below the threshold
+        before it can be flagged again)."""
+        now = time.time()
+        windows: Dict[int, List[float]] = {}
+        by_host: Dict[int, Dict[str, Any]] = {}
+        # track absent RANKS separately: host ids need not equal ranks,
+        # so a dead rank must never collide with (or shadow) a live
+        # host's window in the detector input
+        missing_ranks: List[int] = []
+        for r, p in payloads.items():
+            if p is None:
+                missing_ranks.append(r)
+                continue
+            h = int(p.get("host", r))
+            windows[h] = [float(v) for v in p.get("window_ms", ())]
+            by_host[h] = p
+            self._last_seen[h] = float(p.get("ts", now))
+        det = detect_stragglers(windows, factor=self.factor)
+        report = {"round": self._round, "step": step,
+                  "factor": self.factor, **det,
+                  "missing_ranks": sorted(missing_ranks),
+                  "heartbeat_ages_s": self.heartbeat_ages(),
+                  "roles": {h: p.get("role") for h, p in by_host.items()},
+                  "prom": {h: p.get("prom", {})
+                           for h, p in by_host.items()}}
+        for h, s in det["hosts"].items():
+            self.prom.gauge_set(f"step_ms_p50_host{h}", s["median_ms"],
+                                help="per-host window-median step ms")
+            self.prom.gauge_set(f"step_ms_p95_host{h}", s["p95_ms"],
+                                help="per-host window-p95 step ms")
+        if det["fleet_median_ms"] is not None:
+            self.prom.gauge_set("fleet_step_ms_median",
+                                det["fleet_median_ms"],
+                                help="median of per-host window medians")
+            self.prom.gauge_set("step_time_skew", det["skew"] or 1.0,
+                                help="worst host median / fleet median")
+        self.prom.gauge_set("stragglers", len(det["stragglers"]),
+                            help="hosts currently over the straggler "
+                                 "threshold")
+        log = self._log()
+        flagged_now = set(det["stragglers"])
+        for h in sorted(flagged_now - self._flagged):
+            if log is not None:
+                log.emit("straggler_detected", straggler_host=h,
+                         role=report["roles"].get(h, "?"), step=step,
+                         median_ms=det["hosts"][h]["median_ms"],
+                         p95_ms=det["hosts"][h]["p95_ms"],
+                         fleet_median_ms=det["fleet_median_ms"],
+                         factor=self.factor)
+        self._flagged = flagged_now
+        self.last_report = report
+        return report
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        """Seconds since each host's last successful payload (rank 0's
+        liveness view; own host is always fresh)."""
+        now = time.time()
+        ages = {h: round(now - t, 3) for h, t in self._last_seen.items()}
+        ages[self.host] = 0.0
+        return ages
+
+    # -- the cadence ---------------------------------------------------------
+    def tick(self, step: int) -> Optional[Dict[str, Any]]:
+        """Call once per completed step (0-based). On cadence steps every
+        rank publishes; rank 0 then gathers + aggregates and returns the
+        fleet report."""
+        if (step + 1) % self.interval != 0:
+            return None
+        report = None
+        if self.store is None and self.world_size <= 1:
+            report = (self.aggregate({self.rank: self._payload()},
+                                     step=step)
+                      if self.rank == 0 else None)
+        else:
+            self.publish()
+            if self.rank == 0:
+                report = self.aggregate(self.gather(), step=step)
+        self._round += 1
+        return report
+
+    # -- crash forensics -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Bounded state dump for the flight recorder: own window, round,
+        heartbeat ages and the last fleet report (rank 0)."""
+        return {"rank": self.rank, "host": self.host, "role": self.role,
+                "round": self._round, "steps_seen": self._steps_seen,
+                "window_ms": [round(v, 4) for v in self._steps],
+                "heartbeat_ages_s": self.heartbeat_ages(),
+                "last_report": self.last_report}

@@ -4,13 +4,27 @@ One JSON object per line, flushed per event, so a SIGKILL mid-run leaves
 every completed line readable (the same discipline as the resilience
 commit protocol's atomic writes). Schema: every event carries
 
-    {"ts": <unix seconds>, "pid": <os pid>, "event": "<kind>", ...fields}
+    {"ts": <unix seconds>, "pid": <os pid>, "host": <process index>,
+     "role": "<trainer|serving|...>", "event": "<kind>", ...fields}
+
+``host``/``role`` keep MERGED streams attributable: a fleet run (or the
+RL loop that drives a trainer and a serving engine side by side) funnels
+several processes' logs into one timeline via
+:func:`merge_event_streams`, and the reader must still know which
+process and which half of the system produced each line.
+
+Long resilient runs would otherwise grow the log without bound, so the
+file is size-capped (``FLAGS_telemetry_jsonl_max_mb``; 0 = unbounded):
+when an emit would cross the cap, the live file rotates to ``<path>.1``
+(one generation — the bound is 2x the cap) and the fresh file opens with
+a ``jsonl_rotated`` event recording what moved where.
 
 Producers: the resilient runner (resume/commit/skip/SIGTERM/abort), the
-TelemetryHost (decoded device-metric intervals), Model.fit (step reports)
-and the serving engine (admits/completions). The process-global log is
-bound to ``FLAGS_telemetry_jsonl``; pass an explicit :class:`EventLog`
-where a private file is wanted (tests, multi-run drivers).
+TelemetryHost (decoded device-metric intervals), the fleet
+TelemetryAggregator (straggler_detected), Model.fit (step reports) and
+the serving engine (admits/completions). The process-global log is bound
+to ``FLAGS_telemetry_jsonl``; pass an explicit :class:`EventLog` where a
+private file is wanted (tests, multi-run drivers).
 """
 
 from __future__ import annotations
@@ -19,28 +33,93 @@ import json
 import os
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional
 
-__all__ = ["EventLog", "get_event_log", "set_event_log"]
+__all__ = ["EventLog", "get_event_log", "set_event_log",
+           "merge_event_streams"]
+
+
+def default_host() -> int:
+    """This process's fleet index (the launcher's rank env, 0 standalone).
+    Read from env rather than jax.process_index() so emitting an event
+    can never initialize a jax backend."""
+    for var in ("PADDLE_TRAINER_ID", "JAX_PROCESS_ID"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
 
 
 class EventLog:
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, role: str = "trainer",
+                 host: Optional[int] = None, max_mb: Optional[float] = None):
         self.path = path
+        self.role = str(role)
+        self.host = default_host() if host is None else int(host)
+        if max_mb is None:
+            from ..flags import flag
+            max_mb = float(flag("telemetry_jsonl_max_mb"))
+        self.max_bytes = int(max_mb * (1 << 20)) if max_mb > 0 else 0
+        self.rotations = 0
         self._lock = threading.Lock()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        self._bytes = self._f.tell()
 
     def emit(self, event: str, **fields: Any) -> None:
         rec = {"ts": round(time.time(), 6), "pid": os.getpid(),
-               "event": str(event)}
+               "host": self.host, "role": self.role, "event": str(event)}
         rec.update(fields)
         line = json.dumps(rec, default=_jsonable) + "\n"
         with self._lock:
+            if (self.max_bytes and self._bytes
+                    and self._bytes + len(line) > self.max_bytes):
+                self._rotate_locked()
             self._f.write(line)
             self._f.flush()  # per-line durability: forensics-friendly
+            self._bytes += len(line)
+
+    def _rotate_locked(self) -> None:
+        """Size-cap rotation: the live file becomes <path>.1 (replacing
+        any previous generation — total on disk stays <= 2x the cap) and
+        a fresh file opens, announcing itself with a jsonl_rotated event
+        so a reader of the new file knows history moved."""
+        self._f.close()
+        rotated_to = self.path + ".1"
+        try:
+            os.replace(self.path, rotated_to)
+        except OSError:
+            # rotation impossible (locked/read-only target): give up on
+            # the cap for this log's lifetime rather than re-entering a
+            # close/replace/reopen + jsonl_rotated cycle on EVERY emit —
+            # the one announcement below records that capping stopped
+            rotated_to = None
+            self.max_bytes = 0
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._bytes = self._f.tell()
+        self.rotations += 1
+        rec = {"ts": round(time.time(), 6), "pid": os.getpid(),
+               "host": self.host, "role": self.role,
+               "event": "jsonl_rotated", "rotated_to": rotated_to,
+               "rotation": self.rotations,
+               "max_bytes": self.max_bytes}
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        self._f.write(line)
+        self._f.flush()
+        self._bytes += len(line)
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        """Last <= n decoded records of the CURRENT file generation, read
+        back from disk (bounded read from the end — the flight recorder
+        calls this on the crash path where the log may be huge)."""
+        with self._lock:
+            self._f.flush()
+        return read_jsonl_tail(self.path, n)
 
     def span(self, name: str):
         """Host span recorded BOTH as begin/end JSONL events and as a
@@ -86,6 +165,78 @@ def _jsonable(x):
         return float(x)
     except (TypeError, ValueError):
         return repr(x)
+
+
+def read_jsonl_tail(path: str, n: int,
+                    max_bytes: int = 1 << 20) -> List[Dict[str, Any]]:
+    """Decode the last <= n records of a JSONL file, reading at most
+    `max_bytes` from the end (a torn first line after the seek is
+    skipped). Returns [] for a missing file — the crash path must never
+    raise from here."""
+    if n <= 0:
+        return []
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+                f.readline()  # discard the (likely torn) partial line
+            lines = f.read().decode("utf-8", errors="replace").splitlines()
+    except OSError:
+        return []
+    out: List[Dict[str, Any]] = []
+    for line in lines[-n:]:
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def merge_event_streams(*logs, out_path: Optional[str] = None,
+                        roles: Optional[Dict[int, str]] = None
+                        ) -> List[Dict[str, Any]]:
+    """Merge several JSONL event streams (EventLog instances or file
+    paths) into ONE role-tagged timeline ordered by ``ts`` (stable for
+    ties, so each stream's own order is preserved).
+
+    This is the pre-work for the RL-loop scenario (ROADMAP item 5): a
+    training step loop and a ServingEngine each write their own stream;
+    the merged view is the single timeline an operator reads. Records
+    missing a ``role`` (pre-rotation history, foreign producers) get one
+    from `roles` — {stream_index: role} — defaulting to "stream<i>".
+
+    out_path: also write the merged records as JSONL. Returns the merged
+    record list.
+    """
+    merged: List[tuple] = []
+    for i, log in enumerate(logs):
+        # every emit flushes, so the on-disk file is already current
+        path = log.path if isinstance(log, EventLog) else str(log)
+        fallback = (roles or {}).get(
+            i, log.role if isinstance(log, EventLog) else f"stream{i}")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for rec_no, line in enumerate(lines):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rec.setdefault("role", fallback)
+            merged.append((float(rec.get("ts", 0.0)), i, rec_no, rec))
+    merged.sort(key=lambda t: t[:3])
+    records = [rec for _, _, _, rec in merged]
+    if out_path is not None:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as f:
+            for rec in records:
+                f.write(json.dumps(rec, default=_jsonable) + "\n")
+    return records
 
 
 _GLOBAL: Optional[EventLog] = None

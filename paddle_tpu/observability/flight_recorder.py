@@ -1,0 +1,271 @@
+"""Hang flight recorder: a silent pod hang becomes a diagnosable
+artifact.
+
+When a step wedges (watchdog timeout), the platform preempts (SIGTERM)
+or the loop aborts (non-finite escalation), whatever this process knew
+at that moment is dumped as ONE bounded bundle directory under
+``FLAGS_flight_recorder_dir``:
+
+* ``manifest.json``     — reason, wall clock, pid/host/role, watchdog
+  counters;
+* ``events_tail.jsonl`` — the last ``FLAGS_flight_recorder_events``
+  records of the JSONL event log (bounded read from the end — the log
+  may be huge, the crash path must stay cheap);
+* ``telemetry_tail.json`` — the last decoded interval of every live
+  :class:`~paddle_tpu.observability.metrics.TelemetryHost` ring buffer
+  (loss / grad-norm / comms-bytes right up to the hang);
+* ``open_spans.json``   — host RecordEvent spans still open plus the
+  watchdog's pending spans with their ages: *what* was running;
+* ``heartbeats.json``   — fleet aggregator snapshots (per-host
+  last-heartbeat ages, the last straggler report): *who else* was alive;
+* ``profile_window.json`` — the active profile-capture window, if one
+  was open when the hang hit;
+* ``report.txt``        — the watchdog's thread-stack report.
+
+The recorder is pull-based: sources register weakly (TelemetryHost,
+TelemetryAggregator) or are read at dump time (event log file, span
+registry), so an idle recorder costs nothing and a dump never blocks on
+a wedged device — everything read is host state. Dumps are rate-limited
+and the crash dir keeps only ``FLAGS_flight_recorder_keep`` bundles.
+With ``FLAGS_flight_recorder_dir`` empty the whole module is inert, and
+none of it touches compiled programs either way (host-only — the
+telemetry-off bitwise no-op contract is untouched).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import weakref
+from typing import Any, Dict, Optional
+
+__all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+           "maybe_dump", "register_telemetry_host", "register_aggregator"]
+
+_SRC_LOCK = threading.Lock()
+_TELEMETRY_HOSTS: "weakref.WeakSet" = weakref.WeakSet()
+_AGGREGATORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_telemetry_host(host) -> None:
+    """Weakly track a TelemetryHost so crash bundles can include its ring
+    tail (called by TelemetryHost.__init__; weak — the recorder never
+    keeps a dead run's host alive)."""
+    with _SRC_LOCK:
+        _TELEMETRY_HOSTS.add(host)
+
+
+def register_aggregator(agg) -> None:
+    """Weakly track a fleet TelemetryAggregator for heartbeat/straggler
+    state in crash bundles."""
+    with _SRC_LOCK:
+        _AGGREGATORS.add(agg)
+
+
+from .events import _jsonable  # one coercion for bundles AND the log
+
+
+class FlightRecorder:
+    def __init__(self, crash_dir: str, *, max_events: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 min_interval_s: float = 2.0):
+        from ..flags import flag
+        self.crash_dir = crash_dir
+        self.max_events = int(max_events if max_events is not None
+                              else flag("flight_recorder_events"))
+        self.keep = max(int(keep if keep is not None
+                            else flag("flight_recorder_keep")), 1)
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last_dump = 0.0
+        self.dump_count = 0
+        self.last_bundle: Optional[str] = None
+
+    # -- the dump ------------------------------------------------------------
+    def dump(self, reason: str, *, watchdog=None, report: Optional[str]
+             = None, extra: Optional[Dict[str, Any]] = None
+             ) -> Optional[str]:
+        """Write one bundle; returns its path, or None when rate-limited
+        or the dump failed (the crash path NEVER raises from here — a
+        broken recorder must not mask the original failure)."""
+        try:
+            return self._dump(reason, watchdog=watchdog, report=report,
+                              extra=extra)
+        except Exception as e:  # pragma: no cover - defensive
+            import sys
+            sys.stderr.write(f"[flight-recorder] dump failed: {e!r}\n")
+            return None
+
+    def _dump(self, reason, *, watchdog, report, extra):
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_dump < self.min_interval_s:
+                return None
+            self._last_dump = now
+            self.dump_count += 1
+        safe = "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                       for ch in str(reason))[:60]
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(self.crash_dir,
+                            f"flight_{stamp}_{safe}_pid{os.getpid()}")
+        suffix = 0
+        while os.path.exists(path + (f".{suffix}" if suffix else "")):
+            suffix += 1
+        if suffix:
+            path += f".{suffix}"
+        os.makedirs(path, exist_ok=True)
+
+        from .events import get_event_log
+        from ..flags import flag
+        log = get_event_log()
+        manifest = {
+            "reason": str(reason), "ts": time.time(),
+            "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "pid": os.getpid(),
+            "host": log.host if log is not None else None,
+            "role": log.role if log is not None else None,
+            "event_log": log.path if log is not None else None,
+            "telemetry_flag": bool(flag("telemetry")),
+            "dump_count": self.dump_count,
+        }
+        if watchdog is not None:
+            try:
+                manifest["watchdog"] = watchdog.stats()
+            except Exception:
+                pass
+        if extra:
+            manifest["extra"] = extra
+        self._write_json(path, "manifest.json", manifest)
+
+        # recent JSONL events (bounded tail read off disk)
+        if log is not None:
+            tail = log.tail(self.max_events)
+            with open(os.path.join(path, "events_tail.jsonl"), "w",
+                      encoding="utf-8") as f:
+                for rec in tail:
+                    f.write(json.dumps(rec, default=_jsonable) + "\n")
+
+        # telemetry ring tails of every live host
+        with _SRC_LOCK:
+            hosts = list(_TELEMETRY_HOSTS)
+            aggs = list(_AGGREGATORS)
+        tele = {}
+        for i, h in enumerate(hosts):
+            try:
+                tele[f"telemetry_host_{i}"] = h.tail()
+            except Exception:
+                continue
+        if tele:
+            self._write_json(path, "telemetry_tail.json", tele)
+
+        # what was running: open RecordEvent spans + watchdog pending
+        from ..profiler.utils import active_spans
+        spans: Dict[str, Any] = {"record_events": active_spans()}
+        if watchdog is not None:
+            try:
+                spans["watchdog_pending"] = [
+                    {"tag": tag, "age_s": round(age, 3)}
+                    for tag, age in watchdog.pending()]
+            except Exception:
+                pass
+        self._write_json(path, "open_spans.json", spans)
+
+        # who else was alive: fleet heartbeat/straggler state
+        beats = {}
+        for i, a in enumerate(aggs):
+            try:
+                beats[f"aggregator_{i}"] = a.snapshot()
+            except Exception:
+                continue
+        if beats:
+            self._write_json(path, "heartbeats.json", beats)
+
+        from .profile_reader import active_profile_window
+        win = active_profile_window()
+        if win is not None:
+            self._write_json(path, "profile_window.json", win)
+
+        if report:
+            with open(os.path.join(path, "report.txt"), "w",
+                      encoding="utf-8") as f:
+                f.write(str(report))
+
+        self._prune()
+        self.last_bundle = path
+        if log is not None:
+            try:
+                log.emit("flight_recorder_dump", reason=str(reason),
+                         bundle=path)
+            except Exception:
+                pass
+        return path
+
+    def _write_json(self, path: str, name: str, obj) -> None:
+        with open(os.path.join(path, name), "w", encoding="utf-8") as f:
+            json.dump(obj, f, indent=2, default=_jsonable)
+
+    def _prune(self) -> None:
+        """Keep the newest `keep` bundles; the crash dir stays bounded
+        even under a watchdog storm."""
+        try:
+            entries = sorted(
+                e for e in os.listdir(self.crash_dir)
+                if e.startswith("flight_")
+                and os.path.isdir(os.path.join(self.crash_dir, e)))
+        except OSError:
+            return
+        for e in entries[:-self.keep]:
+            shutil.rmtree(os.path.join(self.crash_dir, e),
+                          ignore_errors=True)
+
+
+_GLOBAL: Optional[FlightRecorder] = None
+_GLOBAL_DIR: Optional[str] = None
+_EXPLICIT = False
+_G_LOCK = threading.Lock()
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    """The process flight recorder: an explicitly installed one wins;
+    otherwise one bound to FLAGS_flight_recorder_dir (None when empty),
+    re-bound if the flag changed."""
+    global _GLOBAL, _GLOBAL_DIR
+    with _G_LOCK:
+        if _EXPLICIT:
+            return _GLOBAL
+    from ..flags import flag
+    d = str(flag("flight_recorder_dir") or "")
+    with _G_LOCK:
+        if _EXPLICIT:
+            return _GLOBAL
+        if _GLOBAL is not None and _GLOBAL_DIR == d:
+            return _GLOBAL
+        _GLOBAL = FlightRecorder(d) if d else None
+        _GLOBAL_DIR = d or None
+    return _GLOBAL
+
+
+def set_flight_recorder(rec: Optional[FlightRecorder]
+                        ) -> Optional[FlightRecorder]:
+    """Install an explicit recorder (tests/drivers), shadowing the flag
+    binding; None restores flag-driven behavior. Returns the previous."""
+    global _GLOBAL, _GLOBAL_DIR, _EXPLICIT
+    with _G_LOCK:
+        prev, _GLOBAL = _GLOBAL, rec
+        _GLOBAL_DIR = rec.crash_dir if rec is not None else None
+        _EXPLICIT = rec is not None
+    return prev
+
+
+def maybe_dump(reason: str, *, watchdog=None, report: Optional[str] = None,
+               extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Dump through the process recorder iff one is configured. The one
+    call sprinkled on crash paths (watchdog timeout, SIGTERM drain,
+    nonfinite abort) — inert and allocation-free when the flag is off."""
+    rec = get_flight_recorder()
+    if rec is None:
+        return None
+    return rec.dump(reason, watchdog=watchdog, report=report, extra=extra)

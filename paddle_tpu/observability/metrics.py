@@ -328,6 +328,24 @@ class TelemetryHost:
         self.fetch_count = 0
         self._event_log = event_log
         self._header_emitted = False
+        # crash forensics: the flight recorder includes this host's ring
+        # tail in hang bundles (weak registration — no lifetime coupling)
+        from .flight_recorder import register_telemetry_host
+        register_telemetry_host(self)
+
+    def tail(self, n: Optional[int] = None) -> Dict[str, Any]:
+        """The last <= n decoded rows (default: one interval) of every
+        series plus the static build metadata — the telemetry-ring tail
+        the flight recorder writes into crash bundles. Host-side only:
+        nothing here touches the device (a hung device must not block
+        the dump); rows not yet fetched stay on the device."""
+        n = int(n) if n else self.cfg.interval
+        return {"interval": self.cfg.interval,
+                "fetch_count": self.fetch_count,
+                "static": dict(self.cfg.static),
+                "steps": self.steps[-n:],
+                "series": {name: vals[-n:]
+                           for name, vals in self.series.items()}}
 
     def _log(self):
         """An explicit event_log (ctor arg) wins; otherwise resolve the

@@ -1,10 +1,19 @@
-"""Prometheus text-format scrape surface (serving telemetry).
+"""Prometheus text-format scrape surface (serving + fleet telemetry).
 
-A dependency-free subset of the Prometheus client: counters, gauges and
-summaries (sum+count pairs) rendered in text exposition format 0.0.4, plus
-a tiny threaded HTTP server exposing ``/metrics``. The serving engine
-keeps a :class:`PromRegistry` per process and updates it inside
-``ServingEngine.step``; ops point a scraper (or curl) at the port.
+A dependency-free subset of the Prometheus client: counters, gauges,
+summaries (sum+count pairs) and bucketed histograms rendered in text
+exposition format 0.0.4, plus a tiny threaded HTTP server exposing
+``/metrics``. The serving engine keeps a :class:`PromRegistry` per
+process and updates it inside ``ServingEngine.step``; the fleet
+:class:`~paddle_tpu.observability.aggregate.TelemetryAggregator` gathers
+per-process ``snapshot()``s into rank-0 gauges; ops point a scraper (or
+curl) at the port.
+
+Observation metrics (summaries and histograms) additionally keep a
+bounded *recent window* of raw observations so callers can read live
+quantiles (``quantile(name, 0.95)``) instead of the lifetime mean — a
+summary's mean never decays, so one slow startup wave would otherwise
+bias adaptive control (the serving TTFT/SLO mix) forever.
 
 No pull-time device work: every metric is a host float updated on the
 engine's own schedule, so a scrape can never add a TPU dispatch.
@@ -13,38 +22,77 @@ engine's own schedule, so a scrape can never add a TPU dispatch.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
-__all__ = ["PromRegistry", "MetricsServer", "serve_registry"]
+__all__ = ["PromRegistry", "MetricsServer", "serve_registry",
+           "DEFAULT_BUCKETS", "nearest_rank"]
 
-_TYPES = ("counter", "gauge", "summary")
+
+def nearest_rank(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of a non-empty SORTED sequence (q in
+    [0, 1]): the ceil(q*N)-th order statistic — the median of 2 values
+    is the LOWER one. The ONE copy shared by the registry's window
+    quantiles and the fleet straggler detector (aggregate.percentile),
+    so adaptive serving control and straggler verdicts can never compute
+    different quantiles for the same q."""
+    import math
+    q = min(max(float(q), 0.0), 1.0)
+    idx = min(max(math.ceil(q * len(sorted_vals)) - 1, 0),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+_TYPES = ("counter", "gauge", "summary", "histogram")
+
+# latency-ish default buckets (seconds or ms — caller's unit), upper
+# bounds of the cumulative le="" series; +Inf is implicit
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0)
 
 
 class _Metric:
-    __slots__ = ("name", "mtype", "help", "value", "sum", "count")
+    __slots__ = ("name", "mtype", "help", "value", "sum", "count",
+                 "buckets", "bucket_counts", "window")
 
-    def __init__(self, name: str, mtype: str, help_: str):
+    def __init__(self, name: str, mtype: str, help_: str,
+                 buckets: Optional[Sequence[float]] = None,
+                 window: int = 64):
         self.name = name
         self.mtype = mtype
         self.help = help_
         self.value = 0.0   # counter/gauge
-        self.sum = 0.0     # summary
+        self.sum = 0.0     # summary/histogram
         self.count = 0
+        self.buckets: Tuple[float, ...] = ()
+        self.bucket_counts: list = []
+        if mtype == "histogram":
+            self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+            self.bucket_counts = [0] * len(self.buckets)
+        # recent raw observations (summary/histogram) for live quantiles
+        self.window: Optional[deque] = (
+            deque(maxlen=max(int(window), 1))
+            if mtype in ("summary", "histogram") else None)
 
 
 class PromRegistry:
-    def __init__(self, namespace: str = "paddle_tpu"):
+    def __init__(self, namespace: str = "paddle_tpu", window: int = 64):
         self.namespace = namespace
+        self.window = max(int(window), 1)
         self._metrics: Dict[str, _Metric] = {}
         self._lock = threading.Lock()
 
-    def _get(self, name: str, mtype: str, help_: str) -> _Metric:
+    def _get(self, name: str, mtype: str, help_: str,
+             buckets: Optional[Sequence[float]] = None,
+             window: Optional[int] = None) -> _Metric:
         assert mtype in _TYPES, mtype
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = self._metrics[name] = _Metric(name, mtype, help_)
+                m = self._metrics[name] = _Metric(
+                    name, mtype, help_, buckets=buckets,
+                    window=window if window is not None else self.window)
             elif m.mtype != mtype:
                 raise ValueError(f"metric {name} is a {m.mtype}, "
                                  f"not {mtype}")
@@ -67,24 +115,84 @@ class PromRegistry:
         with self._lock:
             m.value = max(m.value, float(value))
 
-    def summary_observe(self, name: str, value: float, help: str = ""):
-        m = self._get(name, "summary", help)
+    def summary_observe(self, name: str, value: float, help: str = "",
+                        window: Optional[int] = None):
+        m = self._get(name, "summary", help, window=window)
         with self._lock:
             m.sum += float(value)
             m.count += 1
+            m.window.append(float(value))
 
-    def get(self, name: str) -> Optional[float]:
-        """Current value (summaries: mean of observations); None if the
-        metric was never touched. Accepts the bare or namespaced name."""
+    def histogram_observe(self, name: str, value: float, help: str = "",
+                          buckets: Optional[Sequence[float]] = None,
+                          window: Optional[int] = None):
+        """Bucketed histogram observation (cumulative le="" series in the
+        exposition). `buckets` fixes the upper bounds at first touch;
+        later calls reuse the metric's buckets."""
+        m = self._get(name, "histogram", help, buckets=buckets,
+                      window=window)
+        v = float(value)
+        with self._lock:
+            m.sum += v
+            m.count += 1
+            m.window.append(v)
+            for i, ub in enumerate(m.buckets):
+                if v <= ub:  # per-bucket count; render() cumulates
+                    m.bucket_counts[i] += 1
+                    break
+
+    def _metric(self, name: str) -> Optional[_Metric]:
         prefix = f"{self.namespace}_"
         if self.namespace and name.startswith(prefix):
             name = name[len(prefix):]
-        m = self._metrics.get(name)
+        return self._metrics.get(name)
+
+    def get(self, name: str) -> Optional[float]:
+        """Current value (summaries/histograms: mean of observations);
+        None if the metric was never touched. Accepts the bare or
+        namespaced name."""
+        m = self._metric(name)
         if m is None:
             return None
-        if m.mtype == "summary":
+        if m.mtype in ("summary", "histogram"):
             return m.sum / m.count if m.count else None
         return m.value
+
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        """Recent-window quantile of a summary/histogram (q in [0, 1],
+        nearest-rank over the last `window` raw observations). None when
+        the metric does not exist, has no observations yet, or is not an
+        observation type. This is the live-control read — the serving
+        adaptive mix and the fleet router want p95-of-recent, not the
+        lifetime mean the summary exposes."""
+        m = self._metric(name)
+        if m is None or m.window is None or not m.window:
+            return None
+        with self._lock:
+            vals = sorted(m.window)
+        return nearest_rank(vals, q)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {name: value} view for cross-process aggregation (the
+        fleet TelemetryAggregator ships this through the distributed
+        store). Counters/gauges export their value; observation metrics
+        export `<name>_count`, `<name>_mean` and recent-window
+        `<name>_p50`/`<name>_p95`."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, float] = {}
+        for m in metrics:
+            if m.mtype in ("counter", "gauge"):
+                out[m.name] = m.value
+                continue
+            out[m.name + "_count"] = float(m.count)
+            if m.count:
+                out[m.name + "_mean"] = m.sum / m.count
+            for q, tag in ((0.5, "_p50"), (0.95, "_p95")):
+                v = self.quantile(m.name, q)
+                if v is not None:
+                    out[m.name + tag] = v
+        return out
 
     # -- exposition ----------------------------------------------------------
     def render(self) -> str:
@@ -98,6 +206,14 @@ class PromRegistry:
                 lines.append(f"# HELP {full} {m.help}")
             lines.append(f"# TYPE {full} {m.mtype}")
             if m.mtype == "summary":
+                lines.append(f"{full}_sum {_fmt(m.sum)}")
+                lines.append(f"{full}_count {m.count}")
+            elif m.mtype == "histogram":
+                cum = 0
+                for ub, c in zip(m.buckets, m.bucket_counts):
+                    cum += c
+                    lines.append(f'{full}_bucket{{le="{_fmt(ub)}"}} {cum}')
+                lines.append(f'{full}_bucket{{le="+Inf"}} {m.count}')
                 lines.append(f"{full}_sum {_fmt(m.sum)}")
                 lines.append(f"{full}_count {m.count}")
             else:

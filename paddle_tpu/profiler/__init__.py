@@ -11,8 +11,8 @@ Profiler summaries and exports (see README "Observability")."""
 from .profiler import (Profiler, ProfilerState, ProfilerTarget, SummaryView,
                        export_chrome_tracing, make_scheduler)
 from .timer import Benchmark, benchmark
-from .utils import RecordEvent
+from .utils import RecordEvent, active_spans
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "SummaryView",
-           "Benchmark", "benchmark"]
+           "Benchmark", "benchmark", "active_spans"]

@@ -18,7 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-__all__ = ["RecordEvent", "HostEvent", "EventCollector", "collector", "Stat"]
+__all__ = ["RecordEvent", "HostEvent", "EventCollector", "collector", "Stat",
+           "active_spans"]
 
 
 class Stat:
@@ -84,6 +85,27 @@ class EventCollector:
 
 collector = EventCollector()
 
+# Open (begun, not yet ended) RecordEvent spans, keyed by span identity.
+# Always tracked — one dict insert/remove per span — because the hang
+# flight recorder must see what was in flight when a pod wedges, which is
+# exactly when no profiler session is active.
+_OPEN_SPANS: dict = {}
+_OPEN_LOCK = threading.Lock()
+
+
+def active_spans():
+    """Snapshot of currently-open host spans as
+    ``[{"name", "age_s", "tid", "event_type"}, ...]``, oldest first — the
+    flight recorder's 'what was running when we hung' view."""
+    now = time.perf_counter()
+    with _OPEN_LOCK:
+        spans = list(_OPEN_SPANS.values())
+    out = [{"name": name, "age_s": round(now - start, 6), "tid": tid,
+            "event_type": etype}
+           for (name, start, tid, etype) in spans]
+    out.sort(key=lambda s: -s["age_s"])
+    return out
+
 
 class RecordEvent:
     """Context manager/decorator recording one host span.
@@ -99,6 +121,9 @@ class RecordEvent:
 
     def begin(self):
         self._start = time.perf_counter()
+        with _OPEN_LOCK:
+            _OPEN_SPANS[id(self)] = (self.name, self._start,
+                                     threading.get_ident(), self.event_type)
         if collector.enabled:
             try:
                 import jax.profiler
@@ -110,6 +135,8 @@ class RecordEvent:
     def end(self):
         if self._start is None:
             return
+        with _OPEN_LOCK:
+            _OPEN_SPANS.pop(id(self), None)
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
