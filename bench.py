@@ -365,6 +365,136 @@ def _run_mp_overlap_config(jax, paddle, G, conf, iters):
     }
 
 
+def _run_flash_training_config(jax, paddle, G, conf, iters):
+    """Training-grade flash attention (FLAGS_flash_attention): hybrid
+    step time + compiled temp bytes for the composed-einsum baseline vs
+    the fused kernel on a dp x mp mesh, the analytic attention-FLOPs
+    share (einsum vs flash executed passes — flash runs MORE flops and
+    buys O(S) memory), and a long-S planner run showing the
+    activation-HBM prune delta the flash axis exists for. On the CPU
+    smoke the kernel runs in interpreter mode — step times measure the
+    interpreter, not the MXU; the memory and planner rows are the
+    meaningful CPU signals."""
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.observability import flops as FL
+
+    n_dev = len(jax.devices())
+    mp = next((m for m in (2, 4) if n_dev % m == 0
+               and conf["num_heads"] % m == 0), None)
+    if mp is None:
+        return {"skipped": f"needs an mp degree dividing devices "
+                           f"({n_dev}) and heads ({conf['num_heads']})"}
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    dp = n_dev // mp
+    mesh = dist.build_mesh({"dp": dp, "pp": 1, "mp": mp})
+    batch, seq = conf["batch"], conf["seq"]
+    batch = 2 * dp * max(1, batch // (2 * dp))
+    if on_tpu:
+        seq = max(128, (seq // 128) * 128)  # kernel lane tiles
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=max(conf["max_seq_len"], seq),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    params = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    lr = jnp.float32(1e-4)
+
+    def timed(flash):
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-4,
+            moment_dtype=jnp.bfloat16 if on_tpu else None)
+        step, shard, init = G.build_hybrid_train_step(
+            cfg, mesh, opt, num_microbatches=2, flash_attention=flash)
+        p = shard(params)
+        st = init(p)
+        tc0 = time.perf_counter()
+        compiled = step.lower(p, st, tokens, labels, lr).compile()
+        compile_s = time.perf_counter() - tc0
+        try:
+            ma = compiled.memory_analysis()
+            temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        except Exception:
+            temp = 0
+        p, st, loss = compiled(p, st, tokens, labels, lr)  # warmup
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, st, loss = compiled(p, st, tokens, labels, lr)
+        float(loss)
+        return (time.perf_counter() - t0) / iters, compile_s, temp
+
+    t_e, c_e, m_e = timed(None)
+    t_f, c_f, m_f = timed(True)
+
+    # analytic attention share: executed passes per token, einsum vs
+    # flash (observability.flops.attention_flops_per_token — the same
+    # term the planner scores the flash axis with)
+    a_e = FL.attention_flops_per_token(
+        num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+        seq_len=seq, impl="einsum", remat="full")
+    a_f = FL.attention_flops_per_token(
+        num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+        seq_len=seq, impl="flash", remat="full")
+    total = FL.gpt_flops_per_token(cfg, seq, params=params,
+                                   remat="full")["hardware"]
+
+    # planner: at long S under the v5e 16 GB budget the einsum twin's
+    # rematted-scores term OOM-prunes configs the flash estimate admits
+    from paddle_tpu.distributed.auto_tuner import planner as PL
+    pcfg = G.gpt_1p3b()
+    long_seq = 4096
+    spec = PL.ModelSpec.from_config(pcfg, "gpt")
+    cm = PL.CostModel(spec, PL.KNOWN_PROFILES["tpu-v5e"],
+                      global_batch=8, seq=long_seq)
+    c_base = PL.PlanCandidate(dp=1, mp=8)
+    c_fl = PL.PlanCandidate(dp=1, mp=8, flash_attention=True)
+    p_base, p_fl = cm.predict(c_base), cm.predict(c_fl)
+    rep = PL.plan(pcfg, world=8, global_batch=8, seq=long_seq,
+                  family="gpt", profile=PL.KNOWN_PROFILES["tpu-v5e"])
+    n_fl = sum(1 for s in rep.ranked if s.candidate.flash_attention)
+    n_es = len(rep.ranked) - n_fl
+    pruned_hbm_es = sum(
+        1 for c, r in rep.pruned
+        if "analytic HBM" in r and not c.flash_attention)
+    pruned_hbm_fl = sum(
+        1 for c, r in rep.pruned
+        if "analytic HBM" in r and c.flash_attention)
+    return {
+        "config_hash": _config_hash(conf),
+        "devices": n_dev,
+        "mesh": {"dp": dp, "pp": 1, "mp": mp},
+        "seq": seq,
+        "step_ms": {"einsum": round(t_e * 1e3, 2),
+                    "flash": round(t_f * 1e3, 2)},
+        "compile_s": {"einsum": round(c_e, 2), "flash": round(c_f, 2)},
+        "temp_bytes": {"einsum": m_e, "flash": m_f},
+        "temp_bytes_delta": m_e - m_f,
+        "attn_flops": {
+            "einsum_hw_per_token": a_e["hardware"],
+            "flash_hw_per_token": a_f["hardware"],
+            "flash_over_einsum": round(a_f["hardware"] / a_e["hardware"],
+                                       4),
+            "einsum_share_of_step": round(a_e["hardware"] / total, 4),
+        },
+        "plan_long_seq": {
+            "model": "gpt1p3b", "seq": long_seq, "hbm_gb": 16.0,
+            "act_gb": {"einsum": round(p_base.hbm["act"] / 1e9, 3),
+                       "flash": round(p_fl.hbm["act"] / 1e9, 3)},
+            "step_s": {"einsum": round(p_base.step_s, 4),
+                       "flash": round(p_fl.step_s, 4)},
+            "valid": {"einsum": n_es, "flash": n_fl},
+            "hbm_pruned": {"einsum": pruned_hbm_es,
+                           "flash": pruned_hbm_fl},
+        },
+        "cpu_smoke": not on_tpu,
+    }
+
+
 def _run_moe_config(jax, paddle, G, conf, iters):
     """GPT-MoE through the hybrid engine on a dp x ep x mp mesh
     (FLAGS_moe_index_dispatch / FLAGS_moe_quantize_a2a / FLAGS_moe_overlap):
@@ -818,6 +948,11 @@ def main():
     mp_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
     out["mp_overlap"] = _run_mp_overlap_config(jax, paddle, G, mp_conf,
                                                overlap_iters)
+    # training-grade flash attention (FLAGS_flash_attention): einsum vs
+    # fused-kernel step time + compiled temp bytes, the analytic
+    # attention-FLOPs share, and the long-S planner HBM-prune delta
+    out["flash_training"] = _run_flash_training_config(
+        jax, paddle, G, mp_conf, overlap_iters)
     # delayed-scaling fp8 GEMMs (FLAGS_fp8): bf16 vs fp8 step time +
     # 50-step loss-parity gate on the dense single-chip path
     fp8_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)

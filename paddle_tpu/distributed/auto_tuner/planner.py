@@ -224,6 +224,13 @@ class PlanCandidate:
     fp8: bool = False
     comm_bucket_mb: float = 0.0
     mp_overlap: Optional[str] = None
+    # fused Pallas flash attention in the block bodies
+    # (build_hybrid_train_step(flash_attention=...)): trades MORE executed
+    # attention flops (the two-kernel backward re-derives scores tiles)
+    # for O(S) instead of O(S²) rematted-activation HBM — the cost model
+    # scores both sides, so flash candidates win exactly where the scores
+    # memory is the binding constraint (long S under a tight HBM budget)
+    flash_attention: bool = False
     moe_index: bool = True
     moe_quantize: bool = False
     moe_overlap: bool = False
@@ -260,6 +267,7 @@ class PlanCandidate:
             "fp8": bool(self.fp8),
             "telemetry": None,
             "mp_overlap": self.mp_overlap,
+            "flash_attention": bool(self.flash_attention),
         }
         if family == "gpt":
             from ..comm_overlap import CommOverlapConfig, MoeDispatchConfig
@@ -305,6 +313,8 @@ class PlanCandidate:
             s += " " + {"seq_parallel": "sp",
                         "collective_matmul": "ring"}.get(
                 str(self.mp_overlap), str(self.mp_overlap))
+        if self.flash_attention:
+            s += " flash"
         if self.ep > 1 or self.moe_quantize or self.moe_overlap:
             s += " moe:" + ("i" if self.moe_index else "d") \
                 + ("q" if self.moe_quantize else "") \
@@ -483,6 +493,13 @@ def check_candidate(c: PlanCandidate, spec: ModelSpec, *, world: int,
                    "observations"
         if c.comm_bucket_mb > 0:
             return "fp8 is not composed with comm_overlap"
+    if c.flash_attention:
+        head_dim = spec.hidden // spec.heads
+        if head_dim > 256:
+            return f"flash kernel caps head_dim at 256 (got {head_dim})"
+        if seq % 128 != 0:
+            return f"flash attention tiles 128-lane sequence blocks: " \
+                   f"seq {seq} % 128 != 0 (pad upstream)"
     if spec.moe_on:
         if spec.moe_experts % c.ep != 0:
             return f"ep {c.ep} must divide expert count {spec.moe_experts}"
@@ -515,14 +532,18 @@ def generate_plan_candidates(
         fp8_options: Sequence[bool] = (False,),
         comm_bucket_options: Sequence[float] = (0.0, 4.0),
         mp_overlap_options: Sequence[Optional[str]] = MP_OVERLAP_MODES,
+        flash_options: Sequence[bool] = (False, True),
         moe_variants: Optional[Sequence[Dict[str, bool]]] = None,
 ) -> Tuple[List[PlanCandidate], List[Tuple[PlanCandidate, str]]]:
     """Enumerate the surface and split it into (valid, pruned-with-reason).
 
     fp8 defaults OFF in the enumeration (it changes numerics, not just
     schedule — opt in with fp8_options=(False, True) when an fp8 run is
-    acceptable). MoE variants default to index dispatch with and without
-    the overlapped/quantized exchange where legal.
+    acceptable). flash_attention defaults to BOTH (numerics-preserving:
+    the kernel computes the same softmax-attention; the search trades its
+    higher executed flops against the O(S²)→O(S) activation HBM). MoE
+    variants default to index dispatch with and without the
+    overlapped/quantized exchange where legal.
     """
     if moe_variants is None:
         if spec.moe_on:
@@ -542,17 +563,19 @@ def generate_plan_candidates(
             rem = world // (ep * dp)
             for mp in _divisors(rem):
                 pp = rem // mp
-                for (M, sched, vpp, z1, f8, bkt, mpo, moe) in \
+                for (M, sched, vpp, z1, f8, bkt, mpo, fl, moe) in \
                         itertools.product(micro_batch_options, schedules,
                                           vpp_options, zero1_options,
                                           fp8_options, comm_bucket_options,
-                                          mp_overlap_options, moe_variants):
+                                          mp_overlap_options, flash_options,
+                                          moe_variants):
                     if (sched == "interleaved") != (vpp > 1):
                         continue  # structural, not worth a prune record
                     c = PlanCandidate(
                         dp=dp, mp=mp, pp=pp, ep=ep, vpp=vpp,
                         schedule=sched, micro_batches=M, zero1=z1,
-                        fp8=f8, comm_bucket_mb=bkt, mp_overlap=mpo, **moe)
+                        fp8=f8, comm_bucket_mb=bkt, mp_overlap=mpo,
+                        flash_attention=fl, **moe)
                     if c in seen:
                         continue
                     seen.add(c)
@@ -671,6 +694,20 @@ class CostModel:
             hidden_size=sp.hidden, seq_len=self.S, remat=c.remat)
         units = (b_rank * self.S) * blk["hardware"] / (c.mp * c.pp) \
             * self._tick_ratio(c)
+        if c.flash_attention:
+            # swap the einsum attention term for the flash one: the fused
+            # kernel EXECUTES more flops (its two-kernel backward
+            # re-derives the scores tiles) — the honest compute cost the
+            # O(S²)→O(S) HBM saving below is traded against
+            a_e = F.attention_flops_per_token(
+                num_layers=sp.layers, hidden_size=sp.hidden,
+                seq_len=self.S, impl="einsum", remat=c.remat)
+            a_f = F.attention_flops_per_token(
+                num_layers=sp.layers, hidden_size=sp.hidden,
+                seq_len=self.S, impl="flash", remat=c.remat)
+            units += (b_rank * self.S) \
+                * (a_f["hardware"] - a_e["hardware"]) / (c.mp * c.pp) \
+                * self._tick_ratio(c)
         # LM head + embedding run on every pp rank (outside the remat'd
         # pipeline): 6 flops/param fwd+bwd, sharded over mp only
         units += (b_rank * self.S) * 6.0 * sp.n_head_params / c.mp
@@ -863,7 +900,13 @@ class CostModel:
         H, FF = sp.hidden, sp.ffn
         act = self._ticks(c) * mb * s_sp * H * dt          # saved inputs
         act += mb * self.S * dt * (2 * H + (4 * H + 2 * FF) / c.mp)
-        act += mb * (sp.heads / c.mp) * self.S ** 2 * dt   # attn scores
+        if c.flash_attention:
+            # the fused kernel never materializes scores in HBM — its
+            # rematted working set is the (out, lse) residual pair, O(S)
+            act += mb * self.S * ((H / c.mp) * dt
+                                  + (sp.heads / c.mp) * 4.0)
+        else:
+            act += mb * (sp.heads / c.mp) * self.S ** 2 * dt  # attn scores
         act += b_rank * self.S * (sp.vocab / c.mp) * (dt + 8)  # logits+CE
         act += 2.0 * b_rank * self.S * H * dt              # embed in/out
         if sp.moe_on:

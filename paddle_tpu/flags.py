@@ -134,6 +134,22 @@ define_flag("low_precision_op_list", 0, "Collect AMP op statistics.")
 define_flag("flash_attn_block_q", 0, "Flash attention q tile (0 = auto; "
             "consumed by the Pallas dispatch).")
 define_flag("flash_attn_block_k", 0, "Flash attention k tile (0 = auto).")
+define_flag("flash_attention", False,
+            "Training-grade Pallas flash attention in the hybrid engines: "
+            "gpt/llama build_hybrid_train_step(flash_attention='auto') "
+            "wires the fused fwd + custom_vjp bwd kernel directly into "
+            "the block bodies (no op-registry hop inside the compiled "
+            "step), composing with mp seq-parallel/ring overlap, fp8 GEMM "
+            "sites, zero1 and every pipeline schedule. Off: the composed "
+            "einsum path compiles bitwise-identically. (consumed by "
+            "kernels.pallas.flash_training.flash_from_flags)")
+define_flag("flash_sep", "",
+            "Context-parallel mode for the flash training path when the "
+            "mesh mounts a 'sep' axis: '' (off), 'ring' (K/V blocks "
+            "rotate over the axis, flash kernels per visiting block), "
+            "'ulysses' (all-to-all head<->sequence swap, flash on the "
+            "gathered sequence). Needs FLAGS_flash_attention. (consumed "
+            "by kernels.pallas.flash_training.flash_from_flags)")
 define_flag("use_autotune", False, "Compat (FLAGS_use_autotune): kernel "
             "autotuning; TPU tiles are set by the measured defaults "
             "above.")

@@ -11,6 +11,7 @@ module.
 """
 
 from . import flash_attention  # noqa: F401
+from . import flash_training  # noqa: F401
 from . import rms_norm  # noqa: F401
 from . import rope  # noqa: F401
 from . import register  # noqa: F401

@@ -54,13 +54,22 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from ...enforce import enforce
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import LANES as _LANES
 from ._common import interpret as _interpret
 
-__all__ = ["flash_attention", "supported"]
+__all__ = ["flash_attention", "supported", "FLASH_REMAT_NAMES"]
+
+# checkpoint_name tags on the differentiation residuals (the kernel output
+# and its logsumexp) — the FP8_REMAT_NAMES pattern: inert under plain
+# jax.checkpoint (the always-checkpointed pipeline stages replay the flash
+# KERNEL, O(S) HBM, never a composed einsum), but a selective-remat policy
+# (dense_forward remat_save + these names) keeps (out, lse) so the backward
+# reuses the flash forward instead of re-running it.
+FLASH_REMAT_NAMES = ("flash_out", "flash_lse")
 
 _NEG_INF = -1e30
 
@@ -864,6 +873,20 @@ def _flash_fwd(query, key, value, bias, q_seg, kv_seg, seed,
     out, res = _flash_fwd_impl(query, key, value, bias, q_seg, kv_seg, seed,
                                causal, sm_scale, block_q, block_k, window,
                                dropout_p, save_lse=True)
+    q, k, v, out_r, lse, b, h, h_kv, native = res
+    # tag the forward's residuals (FLASH_REMAT_NAMES) so a selective-remat
+    # policy can keep them: (out, lse) saved => the backward kernels run
+    # without replaying the forward kernel (q/k/v are cheap reshapes of the
+    # projection outputs the model tags itself, e.g. dense_block's "qkv")
+    out_r = checkpoint_name(out_r, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    # re-derive the public [B, Sq, H, D] output FROM the tagged residual:
+    # downstream primal uses and the backward then both hang off the SAVED
+    # value — reshaping the untagged kernel output instead would leave the
+    # recompute side needing the original var and re-running the kernel
+    out = (out_r.reshape(b, out_r.shape[1], h, -1) if native
+           else _unprep(out_r, b, h))
+    res = (q, k, v, out_r, lse, b, h, h_kv, native)
     return out, res + (bias, q_seg, kv_seg, seed)
 
 

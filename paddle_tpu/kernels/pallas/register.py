@@ -52,7 +52,7 @@ def _dropout_seed(p, training):
     import warnings
 
     from ...random import next_key
-    from jax._src.core import trace_state_clean
+    from ...utils.compat import trace_state_clean
     key = next_key()
     if not isinstance(key, jax.core.Tracer) and not trace_state_clean():
         warnings.warn(

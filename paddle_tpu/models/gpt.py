@@ -313,11 +313,18 @@ def _attention(q, k, v):
 
 
 def _attn_sublayer(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None,
-                   sp=None):
+                   sp=None, flash=None, sep_axis=None):
     """ln1 + Megatron-TP causal attention + residual — the shared first
     half of the dense and MoE hybrid blocks (reads the ln1_*/qkv_*/proj_*
     keys; sp callers must have pre-wrapped the replicated-but-SP params,
-    see _block_fn)."""
+    see _block_fn).
+
+    flash: None (the registry scaled_dot_product_attention — composed
+    einsum off-TPU, bitwise-unchanged legacy path) or a
+    kernels.pallas.flash_training.FlashAttentionConfig: the fused flash
+    fwd + custom_vjp bwd kernel wired DIRECTLY into the block (no
+    registry hop), optionally with sep ring/Ulysses context parallelism
+    over `sep_axis` (x then carries this rank's sequence shard)."""
     mp = lax.axis_size(mp_axis)
     heads_local = cfg.num_heads // mp
     B = x.shape[0]
@@ -340,13 +347,24 @@ def _attn_sublayer(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None,
             mm=None if fp8 is None else _fp8_mm(fp8, "qkv"))
             + p["qkv_b"].astype(cfg.dtype))  # [B, S, 3H/mp]
     qkv = qkv.reshape(B, S, heads_local, 3, cfg.head_dim)
-    # registry op: Pallas flash on TPU (the engine's shard_map runs with
-    # check_vma=False, so the kernel traces inside it); composed O(S^2)
-    # fallback elsewhere — heads are fully local under TP, so per-shard
-    # attention is the whole computation (always over the FULL sequence;
-    # only the between-block residual stream is seq-sharded under sp)
-    attn = F.scaled_dot_product_attention(
-        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2], is_causal=True)
+    # heads are fully local under TP, so per-shard attention is the whole
+    # computation (over the FULL sequence under sp — only the
+    # between-block residual stream is seq-sharded there; over this
+    # rank's sequence SHARD under a sep-mode flash plan)
+    if flash is not None:
+        # training-grade path: the fused kernel (interpreter mode on CPU
+        # tier-1) wired directly, bypassing the registry hop — with
+        # flash.sep, ring/Ulysses context parallelism over sep_axis
+        from ..kernels.pallas import flash_training as _ft
+        attn = _ft.attention(qkv[:, :, :, 0], qkv[:, :, :, 1],
+                             qkv[:, :, :, 2], flash, sep_axis=sep_axis)
+    else:
+        # registry op: Pallas flash on TPU (the engine's shard_map runs
+        # with check_vma=False, so the kernel traces inside it); composed
+        # O(S^2) fallback elsewhere
+        attn = F.scaled_dot_product_attention(
+            qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
+            is_causal=True)
     attn = attn.reshape(B, S, H // mp)
     if sp is None:
         out = _fp8_mm(fp8, "proj")(attn, p["proj_w"].astype(cfg.dtype))
@@ -360,7 +378,8 @@ def _attn_sublayer(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None,
     return x + out
 
 
-def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
+def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None,
+              flash=None, sep_axis=None):
     """One transformer block, explicit Megatron TP (runs inside shard_map;
     degenerates correctly at mp degree 1).
 
@@ -384,7 +403,10 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
     saved between-block activations), and sp.ring additionally decomposes
     those collectives into ppermute rings interleaved with the GEMM
     partial products (collective matmul; fp8 must be off — per-chunk
-    fp8_dot calls would sum partial amax observations)."""
+    fp8_dot calls would sum partial amax observations).
+
+    flash/sep_axis: see _attn_sublayer — the attention implementation is
+    the ONLY thing they change; every TP/sp collective stays as-is."""
     mp = lax.axis_size(mp_axis)
     from ..distributed.fleet.layers.mpu import mp_ops
 
@@ -400,7 +422,8 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
         p = dict(p)
         for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "proj_b", "fc2_b"):
             p[k] = mp_ops.c_identity(p[k], mp_axis)
-    x = _attn_sublayer(p, x, cfg, mp_axis, fp8=fp8, sp=sp)
+    x = _attn_sublayer(p, x, cfg, mp_axis, fp8=fp8, sp=sp, flash=flash,
+                       sep_axis=sep_axis)
 
     h = _ln(x, p["ln2_g"], p["ln2_b"])
     if sp is None:
@@ -427,7 +450,7 @@ def _block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp", fp8=None, sp=None):
 
 
 def _moe_block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp",
-                  ep_axis: str = "ep", mcfg=None, ef=None):
+                  ep_axis: str = "ep", mcfg=None, ef=None, flash=None):
     """One MoE transformer block of the hybrid path: the shared TP
     attention sublayer, then a switch-routed (top-1, capacity-bounded)
     expert FFN dispatched over the 'ep' mesh axis.
@@ -451,7 +474,7 @@ def _moe_block_fn(p, x, cfg: GPTConfig, mp_axis: str = "mp",
         _index_combine, _index_scatter)
     from ..distributed.comm_overlap import a2a as _a2a
 
-    x = _attn_sublayer(p, x, cfg, mp_axis)
+    x = _attn_sublayer(p, x, cfg, mp_axis, flash=flash)
     h = _ln(x, p["ln2_g"], p["ln2_b"])
     B, S, H = h.shape
     T = B * S
@@ -545,12 +568,14 @@ def dense_embed(params, tokens, cfg: GPTConfig):
     return x.astype(cfg.dtype)
 
 
-def dense_block(p, x, cfg: GPTConfig, fp8=None):
+def dense_block(p, x, cfg: GPTConfig, fp8=None, flash=None):
     """One transformer block on an UNstacked per-layer param tree — shared
     by the scan in dense_forward and the param-streaming trainer. fp8:
     this layer's {site: {x, w, g}} delayed scales — the qkv/proj/fc1/fc2
     GEMMs route through quantization.fp8.fp8_dot (None = plain bf16/f32
-    path, bitwise-unchanged)."""
+    path, bitwise-unchanged). flash: None or a FlashAttentionConfig —
+    the fused kernel instead of the registry attention (sep does not
+    apply to the single-device dense path)."""
     from jax.ad_checkpoint import checkpoint_name
     B, S, H = x.shape
     h = _ln(x, p["ln1_g"], p["ln1_b"])
@@ -561,11 +586,19 @@ def dense_block(p, x, cfg: GPTConfig, fp8=None):
     # selective remat policy (dense_forward remat_save=) keys on them
     qkv = checkpoint_name(qkv, "qkv")
     qkv = qkv.reshape(B, S, cfg.num_heads, 3, cfg.head_dim)
-    # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
-    # composition elsewhere — same math as the hybrid engine's
-    attn = F.scaled_dot_product_attention(
-        qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
-        is_causal=True)
+    if flash is not None:
+        # direct fused path; its (out, lse) residuals carry the
+        # FLASH_REMAT_NAMES tags, so selective remat reuses the flash
+        # forward instead of re-running the kernel
+        from ..kernels.pallas import flash_training as _ft
+        attn = _ft.attention(qkv[:, :, :, 0], qkv[:, :, :, 1],
+                             qkv[:, :, :, 2], flash)
+    else:
+        # registry op: Pallas flash kernel on TPU (O(S) VMEM), XLA
+        # composition elsewhere — same math as the hybrid engine's
+        attn = F.scaled_dot_product_attention(
+            qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2],
+            is_causal=True)
     attn = checkpoint_name(attn, "attn_out")
     out = _fp8_mm(fp8, "proj")(attn.reshape(B, S, H),
                                p["proj_w"].astype(cfg.dtype))
@@ -602,7 +635,7 @@ def dense_head_loss(params, x, labels, cfg: GPTConfig):
 
 
 def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
-                  remat_save=("attn_out", "qkv"), fp8=None):
+                  remat_save=("attn_out", "qkv"), fp8=None, flash=None):
     """Single-device forward over the stacked-parameter pytree (no
     collectives). Same math/layout as the hybrid engine — head-major QKV.
     remat=True checkpoints each block (recompute in backward) — the memory/
@@ -618,16 +651,28 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
     quantization.fp8.init_fp8_meta) — they ride the same scan, so each
     layer's amax observation comes back separately instead of summed. The
     selective-remat policy additionally saves the quantized operands
-    (FP8_REMAT_NAMES) so backward reuses them instead of re-quantizing."""
+    (FP8_REMAT_NAMES) so backward reuses them instead of re-quantizing.
+
+    flash: None or a FlashAttentionConfig — the fused attention kernel in
+    every block; selective remat then also saves the kernel's (out, lse)
+    residuals (FLASH_REMAT_NAMES) so the backward reuses the flash
+    forward, while full remat (remat_save=()) replays the KERNEL."""
     x = dense_embed(params, tokens, cfg)
 
     def block(p, x, f=None):
-        return dense_block(p, x, cfg, fp8=f)
+        return dense_block(p, x, cfg, fp8=f, flash=flash)
 
     if remat and remat_save:
         if fp8 is not None:
             from ..quantization.fp8 import FP8_REMAT_NAMES
             remat_save = tuple(remat_save) + tuple(FP8_REMAT_NAMES)
+        if flash is not None:
+            from ..kernels.pallas.flash_attention import FLASH_REMAT_NAMES
+            # "attn_out" is a pure reshape of the kernel's "flash_out"
+            # residual — saving both would store the attention output
+            # twice per block and erode the O(S) win
+            remat_save = tuple(n for n in remat_save
+                               if n != "attn_out") + tuple(FLASH_REMAT_NAMES)
         blk = jax.checkpoint(
             block,
             policy=jax.checkpoint_policies.save_only_these_names(
@@ -651,13 +696,13 @@ def dense_forward(params, tokens, cfg: GPTConfig, remat: bool = True,
 
 
 def dense_loss(params, tokens, labels, cfg: GPTConfig, remat: bool = True,
-               remat_save=("attn_out", "qkv"), fp8=None):
+               remat_save=("attn_out", "qkv"), fp8=None, flash=None):
     """remat_save threads through to dense_forward — bigger-than-HBM
     callers (benchmarks/offload_bench.py moments tier) pass () for the
-    minimum-memory full-remat form. fp8: per-layer delayed scales (see
-    dense_forward)."""
+    minimum-memory full-remat form. fp8: per-layer delayed scales; flash:
+    fused-attention plan (see dense_forward)."""
     logits = dense_forward(params, tokens, cfg, remat=remat,
-                           remat_save=remat_save, fp8=fp8)
+                           remat_save=remat_save, fp8=fp8, flash=flash)
     return lm_logsumexp_ce(logits, labels)
 
 
@@ -764,7 +809,7 @@ def _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, num_microbatches,
 
 
 def _moe_pipeline(params, x_mb, cfg: GPTConfig, M: int, pp_axis, mp_axis,
-                  ep_axis, mcfg, moe_ef):
+                  ep_axis, mcfg, moe_ef, flash=None):
     """1F1B pipeline over (dense, MoE) layer pairs with the aux side
     channel (spmd_pipeline with_aux): returns (out [M, mb, s, H], stats
     summed over every (layer, microbatch) execution and psum'd over pp,
@@ -801,9 +846,10 @@ def _moe_pipeline(params, x_mb, cfg: GPTConfig, M: int, pp_axis, mp_axis,
 
             def body(carry, xs):
                 pdl, pml, efll = xs
-                hh = _block_fn(pdl, carry, cfg, mp_axis)
+                hh = _block_fn(pdl, carry, cfg, mp_axis, flash=flash)
                 hh, st, nef = _moe_block_fn(pml, hh, cfg, mp_axis,
-                                            ep_axis, mcfg, efll)
+                                            ep_axis, mcfg, efll,
+                                            flash=flash)
                 return hh, (st, nef)
             out, (st, nef) = lax.scan(body, h, (pd, pm, efl))
         else:
@@ -811,9 +857,10 @@ def _moe_pipeline(params, x_mb, cfg: GPTConfig, M: int, pp_axis, mp_axis,
 
             def body(carry, xs):
                 pdl, pml = xs
-                hh = _block_fn(pdl, carry, cfg, mp_axis)
+                hh = _block_fn(pdl, carry, cfg, mp_axis, flash=flash)
                 hh, st, _ = _moe_block_fn(pml, hh, cfg, mp_axis,
-                                          ep_axis, mcfg, None)
+                                          ep_axis, mcfg, None,
+                                          flash=flash)
                 return hh, st
             out, st = lax.scan(body, h, (pd, pm))
             nef = ()
@@ -867,7 +914,8 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1,
                    schedule: str = "1F1B", fp8=None, sp=None,
-                   ep_axis="ep", moe=None, moe_ef=None):
+                   ep_axis="ep", moe=None, moe_ef=None, flash=None,
+                   sep_axis="sep"):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
@@ -895,6 +943,15 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     value then becomes (loss, new_moe_ef). 1F1B only; not composed with
     fp8 or sequence parallelism (the MoE block runs the
     replicated-activation TP path).
+
+    flash: None (composed-einsum attention, bitwise-unchanged) or a
+    kernels.pallas.flash_training.FlashAttentionConfig — the fused flash
+    kernel in every block. With flash.sep set, tokens/labels arrive
+    SEQUENCE-SHARDED over `sep_axis` ([b_local, S/sep] per rank): the
+    position embedding reads this rank's global slice, attention runs
+    ring/Ulysses context parallelism per shard, and the loss mean spans
+    (dp, sep). Not composed with sp (both shard the sequence dim) or
+    MoE (enforced at build).
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -916,9 +973,34 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                 "and expert stacking follow the plain pipeline layout)",
                 op="gpt.hybrid_loss_fn", virtual_pp=virtual_pp,
                 schedule=schedule)
+    sep_on = flash is not None and flash.sep is not None
+    if sep_on:
+        enforce(sp is None and not moe_on,
+                "sep context parallelism shards the sequence dim — not "
+                "composed with mp sequence parallelism (which also "
+                "shards it) or the MoE batch layout",
+                op="gpt.hybrid_loss_fn")
     from ..distributed.comm_overlap import collective_matmul as _cm
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
-    x = x + params["wpe"][None, :S]
+    if sep_on:
+        # tokens are this rank's sequence shard: position embedding reads
+        # the rank's GLOBAL slice (causal masking inside ring/Ulysses
+        # likewise uses global positions). The GLOBAL length must fit the
+        # table — dynamic_slice CLAMPS an out-of-range start, so an
+        # oversized sequence would silently hand later ranks the first
+        # ranks' position rows instead of erroring
+        n_sep = lax.axis_size(sep_axis)
+        enforce(S * n_sep <= cfg.max_seq_len,
+                "sep context parallelism: the global sequence "
+                "(per-rank S x sep degree) must fit max_seq_len — the "
+                "position table is sliced per rank",
+                op="gpt.hybrid_loss_fn", seq_local=S, sep=n_sep,
+                max_seq_len=cfg.max_seq_len)
+        off = lax.axis_index(sep_axis) * S
+        x = x + lax.dynamic_slice_in_dim(params["wpe"], off, S,
+                                         axis=0)[None]
+    else:
+        x = x + params["wpe"][None, :S]
     x = x.astype(cfg.dtype)
     if sp is not None:
         enforce(S % lax.axis_size(mp_axis) == 0,
@@ -931,7 +1013,8 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     moe_stats = None
     if moe_on:
         out, moe_stats, new_moe_ef = _moe_pipeline(
-            params, x_mb, cfg, M, pp_axis, mp_axis, ep_axis, moe, moe_ef)
+            params, x_mb, cfg, M, pp_axis, mp_axis, ep_axis, moe, moe_ef,
+            flash=flash)
     else:
         def stage_fn(block_params, h):
             if fp8 is not None:
@@ -940,12 +1023,14 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                 def body(carry, pf):
                     p, f = pf
                     return _block_fn(p, carry, cfg, mp_axis, fp8=f,
-                                     sp=sp), None
+                                     sp=sp, flash=flash,
+                                     sep_axis=sep_axis), None
                 out, _ = lax.scan(body, h, (blocks, scales))
                 return out
 
             def body(carry, p):
-                return _block_fn(p, carry, cfg, mp_axis, sp=sp), None
+                return _block_fn(p, carry, cfg, mp_axis, sp=sp,
+                                 flash=flash, sep_axis=sep_axis), None
             out, _ = lax.scan(body, h, block_params)
             return out
 
@@ -1008,6 +1093,12 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         if moe_ef is not None:
             return total, new_moe_ef
         return total
+    if sep_on:
+        # sequence shards are equal-size (and every position valid), so
+        # the mean of per-shard means IS the global mean; sep grads are
+        # genuinely partial and combine through the engine's
+        # extra_grad_axes pmean — the same convention as dp
+        return lax.pmean(total, (dp_axis, sep_axis))
     return lax.pmean(total, dp_axis)
 
 
@@ -1034,7 +1125,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             zero1_dp: bool = False, comm_overlap="auto",
                             fp8="auto", telemetry="auto",
                             mp_overlap="auto", ep_axis="ep",
-                            moe_dispatch="auto", moe_ef_tokens=None):
+                            moe_dispatch="auto", moe_ef_tokens=None,
+                            flash_attention="auto", sep_axis="sep"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -1081,14 +1173,61 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     opt_state["moe_ef"] and needs moe_ef_tokens=(per-rank batch, seq)
     to size them at build time (pp degree 1, one pipeline microbatch).
     Not composed with fp8, sequence parallelism, VPP or ZBH1.
+
+    flash_attention: "auto" (FLAGS_flash_attention / FLAGS_flash_sep,
+    default off) / None / bool / "ring" / "ulysses" /
+    FlashAttentionConfig — the fused Pallas flash fwd + custom_vjp bwd
+    kernel wired directly into every block (no registry hop). Off: the
+    composed einsum path compiles BITWISE-identically. Composes with
+    mp_overlap (attention consumes the gathered full sequence; heads
+    stay local under TP), fp8 (the surrounding qkv/proj GEMMs keep their
+    site_mm routing — attention itself stays bf16/f32), zero1,
+    comm_overlap and every pipeline schedule. A sep mode additionally
+    mounts `sep_axis` as a context-parallel mesh axis: the batch shards
+    over dp AND the sequence over sep (data_spec P(dp, sep)), sep joins
+    extra_grad_axes, and attention runs ring/Ulysses per shard with
+    flash as the inner kernel — requires the axis on the mesh, S
+    divisible by its degree (trace-time), no mp sequence parallelism
+    and no MoE; "ulysses" further needs heads/mp divisible by sep.
     """
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
     from ..distributed.comm_overlap.collective_matmul import \
         resolve_mp_overlap
     from ..distributed.comm_overlap.a2a import resolve_moe_dispatch
+    from ..kernels.pallas.flash_training import resolve_flash_attention
 
     sp = resolve_mp_overlap(mp_overlap)
+    flash = resolve_flash_attention(flash_attention)
+    sep_on = flash is not None and flash.sep is not None
+    if sep_on:
+        enforce(sep_axis in mesh.axis_names,
+                "a sep-mode flash plan mounts context parallelism on a "
+                f"mesh axis: add '{sep_axis}' (degree >= 1) to the mesh",
+                op="gpt.build_hybrid_train_step",
+                axes=tuple(mesh.axis_names))
+        enforce(sp is None,
+                "sep context parallelism and mp sequence parallelism "
+                "both shard the sequence dim — disable "
+                "FLAGS_mp_seq_parallel / mp_overlap or the flash sep "
+                "mode", op="gpt.build_hybrid_train_step")
+        enforce(not cfg.moe_on,
+                "sep context parallelism is not composed with the "
+                "GPT-MoE batch layout (batch shards over dp x ep)",
+                op="gpt.build_hybrid_train_step")
+        sep_n = int(mesh.shape[sep_axis])
+        if flash.sep == "ulysses" and sep_n > 1:
+            heads_local = cfg.num_heads // int(mesh.shape[mp_axis])
+            enforce(heads_local % sep_n == 0,
+                    "ulysses trades the sequence shard for a head shard: "
+                    "local heads (num_heads / mp) must divide by the sep "
+                    "degree — use ring attention otherwise",
+                    op="gpt.build_hybrid_train_step",
+                    heads_local=heads_local, sep=sep_n)
+        # sep grads are genuinely partial (each rank saw a sequence
+        # shard) — combine them exactly as the engine combines any
+        # context-parallel axis
+        extra_grad_axes = tuple(extra_grad_axes) + (sep_axis,)
     fp8_plan = _f8.resolve_fp8_plan(
         fp8, GPT_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
         amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
@@ -1185,29 +1324,39 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
                                   sp=sp, ep_axis=ep_axis, moe=mcfg,
-                                  moe_ef=moe_ef)
+                                  moe_ef=moe_ef, flash=flash,
+                                  sep_axis=sep_axis)
     elif fp8_plan is not None:
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
-                                  fp8=scales, sp=sp)
+                                  fp8=scales, sp=sp, flash=flash,
+                                  sep_axis=sep_axis)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
-                                  sp=sp, ep_axis=ep_axis, moe=mcfg)
+                                  sp=sp, ep_axis=ep_axis, moe=mcfg,
+                                  flash=flash, sep_axis=sep_axis)
 
+    if moe_on:
+        data_spec = P((dp_axis, ep_axis))
+    elif sep_on:
+        # batch over dp, sequence over the context-parallel axis
+        data_spec = P(dp_axis, sep_axis)
+    else:
+        data_spec = None
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
-        data_spec=(P((dp_axis, ep_axis)) if moe_on else None),
+        data_spec=data_spec,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
         comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry,
-        mp_overlap=sp, moe=moe_plan)
+        mp_overlap=sp, moe=moe_plan, flash=flash)
     # elastic-checkpoint hint (checkpoint.reshard): the stacked-[L] block
     # leaves' STORAGE order is (pp, vpp)-dependent under the interleaved
     # schedule; resume onto a different layout permutes them (fp8_meta's

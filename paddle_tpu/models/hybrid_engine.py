@@ -199,7 +199,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
                      comm_overlap="auto", fp8=None, telemetry="auto",
-                     mp_overlap=None, moe=None, donate: bool = False):
+                     mp_overlap=None, moe=None, flash=None,
+                     donate: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
 
@@ -302,7 +303,16 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     so expert leaves count once per distinct element automatically.
     Not composed with fp8; the "ef" form is not composed with
     comm_overlap (the overlap scan calls the loss once per comm
-    microbatch — residual slots are per step)."""
+    microbatch — residual slots are per step).
+
+    flash: metadata describing the fused-attention plan the LOSS
+    FUNCTION implements (a kernels.pallas.flash_training
+    FlashAttentionConfig or None) — like mp_overlap, the engine cannot
+    inject the path (it lives in the model's block bodies; gpt/llama
+    thread it via their own flash_attention="auto"); here it lands in
+    the telemetry JSONL header as static["flash"]. A sep-mode plan's
+    context-parallel gradients arrive through extra_grad_axes like any
+    other partial-grad axis — no engine special-casing."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -413,12 +423,14 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         tcfg.static["host"] = default_host()
         tcfg.static["role"] = "trainer"
         for k in ("comm_buckets_bytes", "comm_quantize",
-                  "comm_microbatches", "mp_mode", "moe"):
+                  "comm_microbatches", "mp_mode", "moe", "flash"):
             tcfg.static.pop(k, None)
         if mp_mode is not None:
             tcfg.static["mp_mode"] = mp_mode
         if moe_plan is not None:
             tcfg.static["moe"] = dict(moe_plan.get("meta", {}))
+        if flash is not None:
+            tcfg.static["flash"] = dict(flash.meta())
         if ocfg is not None and example_params is not None:
             # per-bucket wire bytes from the bucket plan over the LOCAL
             # grad shapes (the int8 path's residual plan IS this plan)

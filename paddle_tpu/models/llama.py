@@ -281,7 +281,7 @@ def _rms(x, g, eps):
 
 
 def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp",
-              fp8=None, sp=None):
+              fp8=None, sp=None, flash=None, sep_axis=None):
     """One decoder layer with explicit Megatron TP (inside shard_map).
     Column shards hold complete heads: q_w's out dim is head-major [hq·D],
     k_w/v_w's is [hkv·D] — contiguous mp shards keep q-head↔kv-head groups
@@ -295,7 +295,13 @@ def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp",
     all-gather: fused mode gathers h once and feeds the site GEMMs; ring
     mode concatenates the local weight shards so one collective matmul
     produces q|k|v (resp. gate|up) — otherwise each ring would move the
-    same chunks again, tripling the wire."""
+    same chunks again, tripling the wire.
+
+    flash: None (registry attention, bitwise-unchanged) or a
+    kernels.pallas.flash_training.FlashAttentionConfig — the fused flash
+    kernel (GQA native: KV heads indexed per query group), optionally
+    with sep ring/Ulysses context parallelism over `sep_axis` (x and
+    cos/sin then carry this rank's sequence shard)."""
     mp = lax.axis_size(mp_axis)
     hq, hkv = cfg.num_heads // mp, cfg.num_kv_heads // mp
     B = x.shape[0]
@@ -335,11 +341,19 @@ def _block_fn(p, x, cos, sin, cfg: LlamaConfig, mp_axis: str = "mp",
     kk = kk.reshape(B, S, hkv, cfg.head_dim)
     vv = vv.reshape(B, S, hkv, cfg.head_dim)
     q, kk = _rope(q, cos, sin), _rope(kk, cos, sin)
-    # registry attention (Pallas flash with native GQA on TPU — the
-    # engine's shard_map runs check_vma=False so the kernel traces inside
-    # it; composed fallback elsewhere). Heads are rank-local under TP and
-    # always see the FULL sequence; only the residual stream is sharded.
-    attn = _flash_gqa(q, kk, vv).reshape(B, S, H // mp)
+    # heads are rank-local under TP; under sp they see the FULL sequence
+    # (only the residual stream is sharded), under a sep-mode flash plan
+    # this rank's sequence shard (RoPE already used global positions)
+    if flash is not None:
+        # training-grade fused path (no registry hop); GQA native
+        from ..kernels.pallas import flash_training as _ft
+        attn = _ft.attention(q, kk, vv, flash,
+                             sep_axis=sep_axis).reshape(B, S, H // mp)
+    else:
+        # registry attention (Pallas flash with native GQA on TPU — the
+        # engine's shard_map runs check_vma=False so the kernel traces
+        # inside it; composed fallback elsewhere)
+        attn = _flash_gqa(q, kk, vv).reshape(B, S, H // mp)
     if sp is None:
         out = _fp8_mm(fp8, "o")(attn, p["o_w"].astype(cd))  # row-parallel
         x = x + mp_ops.mp_allreduce(out, mp_axis)
@@ -502,13 +516,18 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True,
 
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
-                   mp_axis="mp", virtual_pp: int = 1, fp8=None, sp=None):
+                   mp_axis="mp", virtual_pp: int = 1, fp8=None, sp=None,
+                   flash=None, sep_axis="sep"):
     """Per-device loss of the full hybrid Llama (inside shard_map). fp8:
     this pp rank's stacked [L/pp] delayed scales (1F1B only — see
     gpt.hybrid_loss_fn). sp: None or comm_overlap.MpOverlapConfig —
     sequence-parallel TP over mp (see gpt.hybrid_loss_fn); RoPE tables
     stay full-sequence (attention always runs on the gathered sequence),
-    requires S % mp == 0."""
+    requires S % mp == 0. flash: None or a FlashAttentionConfig (see
+    gpt.hybrid_loss_fn) — with flash.sep, tokens arrive sequence-sharded
+    over `sep_axis` and the RoPE tables become this rank's GLOBAL
+    position slice (ring rotation / the Ulysses gather both preserve the
+    already-rotated K blocks)."""
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
@@ -517,9 +536,23 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     enforce(fp8 is None or virtual_pp == 1,
             "fp8 delayed scaling supports the 1F1B schedule only",
             op="llama.hybrid_loss_fn", virtual_pp=virtual_pp)
+    sep_on = flash is not None and flash.sep is not None
+    if sep_on:
+        enforce(sp is None,
+                "sep context parallelism and mp sequence parallelism "
+                "both shard the sequence dim", op="llama.hybrid_loss_fn")
     from ..distributed.comm_overlap import collective_matmul as _cm
     from ..distributed.fleet.layers.mpu import mp_ops
-    cos, sin = rope_tables(cfg, S)
+    if sep_on:
+        # this rank's slice of the GLOBAL rotation tables — K blocks
+        # carry their rotated values around the ring
+        n_sep = lax.axis_size(sep_axis)
+        cos_g, sin_g = rope_tables(cfg, S * n_sep)
+        off = lax.axis_index(sep_axis) * S
+        cos = lax.dynamic_slice_in_dim(cos_g, off, S, axis=0)
+        sin = lax.dynamic_slice_in_dim(sin_g, off, S, axis=0)
+    else:
+        cos, sin = rope_tables(cfg, S)
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x.astype(cfg.dtype)
     if sp is not None:
@@ -537,12 +570,14 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
             def body(carry, pf):
                 p, f = pf
                 return _block_fn(p, carry, cos, sin, cfg, mp_axis,
-                                 fp8=f, sp=sp), None
+                                 fp8=f, sp=sp, flash=flash,
+                                 sep_axis=sep_axis), None
             out, _ = lax.scan(body, h, (blocks, scales))
             return out
 
         def body(carry, p):
-            return _block_fn(p, carry, cos, sin, cfg, mp_axis, sp=sp), None
+            return _block_fn(p, carry, cos, sin, cfg, mp_axis, sp=sp,
+                             flash=flash, sep_axis=sep_axis), None
         out, _ = lax.scan(body, h, block_params)
         return out
 
@@ -575,6 +610,10 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                   virtual_pp=virtual_pp)
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    if sep_on:
+        # equal-size sequence shards: mean of per-shard means IS the
+        # global mean (see gpt.hybrid_loss_fn)
+        return lax.pmean(total, (dp_axis, sep_axis))
     return lax.pmean(total, dp_axis)
 
 
@@ -583,18 +622,51 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, grad_reduce_dtype="auto",
                             zero1_dp: bool = False, fp8="auto",
-                            telemetry="auto", mp_overlap="auto"):
+                            telemetry="auto", mp_overlap="auto",
+                            flash_attention="auto", sep_axis="sep"):
     """mp_overlap: "auto" (FLAGS_mp_seq_parallel / FLAGS_mp_collective_
     matmul) / None / mode string / MpOverlapConfig — sequence-parallel TP
     with optional ring collective matmul; see gpt.build_hybrid_train_step
     (off: the allreduce path is bitwise unchanged; collective_matmul
-    refuses fp8)."""
+    refuses fp8).
+
+    flash_attention: "auto" (flags, default off) / None / bool / sep-mode
+    string / FlashAttentionConfig — the fused flash kernel (GQA native)
+    in every decoder layer; see gpt.build_hybrid_train_step. A sep mode
+    mounts `sep_axis` as a context-parallel axis ("ulysses" needs BOTH
+    heads/mp and kv_heads/mp divisible by the sep degree — the
+    all-to-all trades seq for heads on q and kv alike)."""
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
     from ..distributed.comm_overlap.collective_matmul import \
         resolve_mp_overlap
+    from ..kernels.pallas.flash_training import resolve_flash_attention
 
     sp = resolve_mp_overlap(mp_overlap)
+    flash = resolve_flash_attention(flash_attention)
+    sep_on = flash is not None and flash.sep is not None
+    if sep_on:
+        enforce(sep_axis in mesh.axis_names,
+                "a sep-mode flash plan mounts context parallelism on a "
+                f"mesh axis: add '{sep_axis}' (degree >= 1) to the mesh",
+                op="llama.build_hybrid_train_step",
+                axes=tuple(mesh.axis_names))
+        enforce(sp is None,
+                "sep context parallelism and mp sequence parallelism "
+                "both shard the sequence dim",
+                op="llama.build_hybrid_train_step")
+        sep_n = int(mesh.shape[sep_axis])
+        if flash.sep == "ulysses" and sep_n > 1:
+            mp_n = int(mesh.shape[mp_axis])
+            enforce((cfg.num_heads // mp_n) % sep_n == 0
+                    and (cfg.num_kv_heads // max(mp_n, 1)) % sep_n == 0,
+                    "ulysses trades the sequence shard for a head shard "
+                    "on q AND kv: both heads/mp and kv_heads/mp must "
+                    "divide by the sep degree — use ring attention "
+                    "otherwise", op="llama.build_hybrid_train_step",
+                    heads=cfg.num_heads, kv_heads=cfg.num_kv_heads,
+                    mp=mp_n, sep=sep_n)
+        extra_grad_axes = tuple(extra_grad_axes) + (sep_axis,)
     fp8_plan = _f8.resolve_fp8_plan(
         fp8, LLAMA_FP8_SITES, cfg.num_layers, stacked_axis=pp_axis,
         amax_axes=(dp_axis, mp_axis) + tuple(extra_grad_axes))
@@ -608,20 +680,23 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
-                                  virtual_pp=virtual_pp, fp8=scales, sp=sp)
+                                  virtual_pp=virtual_pp, fp8=scales, sp=sp,
+                                  flash=flash, sep_axis=sep_axis)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
-                                  virtual_pp=virtual_pp, sp=sp)
+                                  virtual_pp=virtual_pp, sp=sp,
+                                  flash=flash, sep_axis=sep_axis)
 
     example = jax.eval_shape(
         lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
         loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
+        data_spec=(P(dp_axis, sep_axis) if sep_on else None),
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
-        fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp)
+        fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp, flash=flash)
     # elastic-checkpoint hint: see gpt.build_hybrid_train_step
     init_state.layout_extra["pp"] = {
         "num_layers": int(cfg.num_layers), "pp": int(mesh.shape[pp_axis]),

@@ -22,7 +22,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-__all__ = ["transformer_flops_per_token", "gpt_flops_per_token",
+__all__ = ["transformer_flops_per_token", "attention_flops_per_token",
+           "gpt_flops_per_token",
            "llama_flops_per_token", "gpt_moe_flops_per_token",
            "param_count", "mfu", "peak_flops",
            "collective_seconds", "plan_wire_bytes"]
@@ -60,6 +61,38 @@ def transformer_flops_per_token(*, n_params: int, num_layers: int,
     elif remat == "selective":
         hardware = model + 0.5 * fwd    # half the forward recomputed
     return {"model": model, "hardware": hardware}
+
+
+def attention_flops_per_token(*, num_layers: int, hidden_size: int,
+                              seq_len: int, impl: str = "einsum",
+                              remat: str = "full") -> Dict[str, float]:
+    """Attention-only executed-FLOPs model, in matmul PASSES of
+    ``2 * L * H * S`` flops/token each (QK^T and PV/AV are one pass
+    apiece — the 12·L·H·S model term is 6 passes: 2 fwd + 4 bwd).
+
+    impl="einsum" (the composed path): fwd 2 passes, bwd 4; full remat
+    re-runs the fwd (+2), selective (attn_out/qkv saved) skips the PV
+    re-run (+1).
+
+    impl="flash" (the fused kernel): fwd 2; the two-kernel
+    FlashAttention-2 backward re-derives the scores tile inside each
+    kernel — dkv = {s, dp, dv, dk} (4 passes), dq = {s, dp, dq} (3) — so
+    bwd is 7; full remat replays the fwd KERNEL (+2, still O(S) HBM),
+    selective (FLASH_REMAT_NAMES: out+lse saved) skips the replay.
+    Flash thus EXECUTES more attention flops than the composed path
+    (11 vs 8 passes under full remat) — the win is the O(S²)→O(S) HBM
+    traffic and residency, which is why the planner scores it honestly
+    as a compute cost and a memory saving."""
+    if remat not in _REMAT_MODES:
+        raise ValueError(f"remat must be one of {_REMAT_MODES}, got {remat}")
+    passes = {
+        "einsum": {"none": 6.0, "selective": 7.0, "full": 8.0},
+        "flash": {"none": 9.0, "selective": 9.0, "full": 11.0},
+    }.get(impl)
+    if passes is None:
+        raise ValueError(f"impl must be 'einsum' or 'flash', got {impl!r}")
+    unit = 2.0 * num_layers * hidden_size * seq_len
+    return {"model": 6.0 * unit, "hardware": passes[remat] * unit}
 
 
 def _gpt_matmul_params(cfg) -> int:

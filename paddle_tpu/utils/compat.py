@@ -6,7 +6,27 @@ import functools
 
 import jax
 
-__all__ = ["shard_map", "axis_size"]
+__all__ = ["shard_map", "axis_size", "trace_state_clean"]
+
+
+def trace_state_clean() -> bool:
+    """jax's trace_state_clean across versions (True = not inside any
+    trace). It only ever lived under private paths (jax._src.core on
+    0.4.x, jax.core before the _src split), so a jax upgrade can drop it
+    without notice — degrade to True ("not tracing"), which callers use
+    as the no-warning/no-guard-needed direction (the lax.axis_size shim
+    pattern: one guarded lookup here instead of a private import at every
+    dispatch site)."""
+    for mod in ("jax._src.core", "jax.core"):
+        try:
+            import importlib
+            fn = getattr(importlib.import_module(mod),
+                         "trace_state_clean", None)
+        except ImportError:
+            fn = None
+        if fn is not None:
+            return bool(fn())
+    return True
 
 
 def axis_size(axis_name):

@@ -41,3 +41,86 @@ def collective_counts(hlo_text: str) -> dict:
 def lowered_collective_counts(jitted, *args, **kwargs) -> dict:
     """collective_counts of ``jitted.lower(*args, **kwargs).as_text()``."""
     return collective_counts(jitted.lower(*args, **kwargs).as_text())
+
+
+# ---------------------------------------------------------------------------
+# Pallas-kernel presence (flash-attention mode assertions).
+#
+# On a real TPU a pallas_call lowers to a ``tpu_custom_call`` custom-call
+# (pallas_custom_call_count greps compiled text for it), but interpreter
+# mode — what CPU tier-1 runs — lowers to plain HLO with NO custom-call
+# marker. So presence/absence assertions count primitives in the TRACED
+# JAXPR instead (backend-independent, pre-lowering): `pallas_call_count`
+# finds the kernel eqns anywhere in the program (through pjit/shard_map/
+# scan/remat/custom_vjp sub-jaxprs — after AD the backward kernels are
+# ordinary eqns too), and `attention_scores_dots` finds the composed
+# path's O(S²) signature — a dot_general whose OUTPUT carries a trailing
+# (seq, seq) scores block. Flash on ⇒ pallas_call present AND scores
+# dots absent; a silent fallback to the composed path fails both.
+# ---------------------------------------------------------------------------
+
+def _walk_eqns(jaxpr, *, skip_pallas_bodies=False):
+    """Yield every eqn in `jaxpr` and (recursively) in any sub-jaxpr
+    carried by eqn params (pjit jaxpr=, scan/while bodies, cond
+    branches=, shard_map, remat, pallas_call grids...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if skip_pallas_bodies and eqn.primitive.name == "pallas_call":
+            continue  # in-kernel [block, block] dots are tiles, not scores
+        for v in eqn.params.values():
+            subs = v if isinstance(v, (tuple, list)) else (v,)
+            for s in subs:
+                inner = getattr(s, "jaxpr", s)  # ClosedJaxpr -> Jaxpr
+                if hasattr(inner, "eqns"):
+                    yield from _walk_eqns(
+                        inner, skip_pallas_bodies=skip_pallas_bodies)
+
+
+def _traced(fn, *args, **kwargs):
+    import jax
+    return jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args).jaxpr
+
+
+def _dce(jaxpr):
+    """Dead-code-eliminate before counting: remat partial-eval leaves
+    hoisted-but-replaced eqns in the raw trace (e.g. the forward kernel
+    both saved AND inside the recompute body), which XLA prunes at
+    lowering — counts should reflect what actually runs. Best-effort:
+    the DCE helper is jax-internal, fall back to the raw jaxpr."""
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        dced, _ = pe.dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
+        return dced
+    except Exception:
+        return jaxpr
+
+
+def pallas_call_count(fn, *args, **kwargs) -> int:
+    """Number of live pallas_call eqns in the traced jaxpr of fn(*args)."""
+    return sum(1 for e in _walk_eqns(_dce(_traced(fn, *args, **kwargs)))
+               if e.primitive.name == "pallas_call")
+
+
+def attention_scores_dots(fn, *args, seq: int, **kwargs) -> int:
+    """dot_general eqns (outside pallas kernel bodies) whose output is a
+    rank>=4 tensor with trailing (seq, seq) dims — the composed
+    attention's materialized per-head scores/probs matmuls ([B, h, S, S],
+    GQA [B, h, g, S, S]). Rank >= 4 keeps ordinary rank-3 GEMMs whose
+    feature dim happens to equal seq (fc1 at FF/mp == S) out of the
+    count."""
+    n = 0
+    for e in _walk_eqns(_traced(fn, *args, **kwargs),
+                        skip_pallas_bodies=True):
+        if e.primitive.name != "dot_general":
+            continue
+        shape = tuple(getattr(e.outvars[0].aval, "shape", ()))
+        if len(shape) >= 4 and shape[-2:] == (seq, seq):
+            n += 1
+    return n
+
+
+def pallas_custom_call_count(hlo_text: str) -> int:
+    """Compiled-TPU-text spelling of kernel presence: Mosaic kernels land
+    as ``tpu_custom_call`` custom-calls (zero in interpreter-mode CPU
+    lowering — use pallas_call_count there)."""
+    return len(re.findall(r"tpu_custom_call", hlo_text))
