@@ -859,7 +859,8 @@ def _run_serving_config(jax, G):
     (CPU: the 8-request smoke; TPU: the 64-request 125M-shape workload),
     so BENCH_r0N rows carry the single-dispatch numbers the standalone
     `benchmarks/serving_bench.py` measures."""
-    from benchmarks.serving_bench import (run_single_dispatch_comparison,
+    from benchmarks.serving_bench import (run_overload_comparison,
+                                          run_single_dispatch_comparison,
                                           scenario)
 
     on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
@@ -874,6 +875,10 @@ def _run_serving_config(jax, G):
     report["config"] = (f"{n_req} reqs, prompts {plens} mixed, outputs "
                         f"U[8,{out_hi}], batch 8, chunk {mk['chunk']}, "
                         f"decode burst {mk['decode_burst']}, fixed mix")
+    # ISSUE 13: offered load at ~2x measured capacity, shedding on vs
+    # off — admitted p99 TTFT vs SLO, shed rate, goodput
+    report["overload"] = run_overload_comparison(
+        params, cfg, mk, 8, n_req=(64 if on_tpu else 48))
     return report
 
 

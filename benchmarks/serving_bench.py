@@ -156,6 +156,106 @@ def run_single_dispatch_comparison(params, cfg, prompts, news, mk,
     }
 
 
+def run_overload_comparison(params, cfg, mk, batch, *, n_req: int = 64,
+                            load_factor: float = 2.0,
+                            slo_factor: float = 3.0, seed: int = 0):
+    """Overload section (ISSUE 13): offered load ~``load_factor``x the
+    engine's measured capacity, shedding ON (bounded queue + SLO-driven
+    shed) vs OFF — admitted-request TTFT percentiles, shed rate and
+    goodput. The point the numbers make: without shedding EVERY request's
+    TTFT grows with the backlog (p99 collapses), with shedding the engine
+    sacrifices a counted fraction of arrivals so the ADMITTED requests
+    keep meeting the SLO.
+
+    Calibration: one closed wave of exactly ``batch`` requests (all slots
+    busy, no queue) measures the per-wave service time T_req ->
+    capacity ~ batch/T_req req/s, SLO = ``slo_factor`` x T_req. The shed
+    engine runs the PURE SLO policy (queue_max=0 — no static bound): the
+    TTFT window-p95 crossing the SLO headroom is what trims the queue,
+    so the mechanism under test is the one doing the work."""
+    import jax
+    from paddle_tpu.inference.serving import ServingEngine
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(rng.choice((8, 16))),))
+               for _ in range(n_req)]
+    news = rng.randint(8, 17, (n_req,)).tolist()
+
+    def make_engine(**kw):
+        return ServingEngine(params, cfg, max_batch=batch,
+                             adaptive_mix=False, **mk, **kw)
+
+    # calibrate: warm the programs, then time one full-batch closed wave
+    eng = make_engine()
+    for p, n in zip(prompts[:batch], news[:batch]):
+        eng.add_request(p, n)
+    eng.run()                                   # compile wave
+    t0 = time.perf_counter()
+    for p, n in zip(prompts[:batch], news[:batch]):
+        eng.add_request(p, n)
+    eng.run()
+    t_req = max(time.perf_counter() - t0, 1e-6)
+    slo_s = slo_factor * t_req
+    interval = t_req / (load_factor * batch)    # 2x offered request rate
+
+    def open_loop(**kw):
+        eng = make_engine(**kw)
+        for p, n in zip(prompts[:batch], news[:batch]):
+            eng.add_request(p, n)
+        eng.run()                               # fresh-engine compile wave
+        reported = {}
+        t0 = time.perf_counter()
+        i = 0
+        while i < n_req or eng.has_work():
+            now = time.perf_counter() - t0
+            while i < n_req and now >= i * interval:
+                eng.add_request(prompts[i], news[i])
+                i += 1
+                now = time.perf_counter() - t0
+            if eng.has_work():
+                for r in eng.step():
+                    reported[r.rid] = r
+            elif i < n_req:
+                time.sleep(max(i * interval - now, 0.0))
+        wall = max(time.perf_counter() - t0, 1e-9)
+        admitted = [r for r in reported.values() if r.status == "ok"]
+        shed = [r for r in reported.values() if r.status == "shed"]
+        ttfts = [r.ttft_s for r in admitted if r.ttft_s is not None]
+        # SLO-goodput: tokens of requests that MET the TTFT SLO — the
+        # number a latency-bound service actually sells. An unbounded
+        # queue "completes everything" but past the SLO, which counts
+        # for nothing here.
+        in_slo = [r for r in admitted
+                  if r.ttft_s is not None and r.ttft_s <= slo_s]
+        out = {"admitted": len(admitted), "shed": len(shed),
+               "shed_rate": round(len(shed) / max(len(reported), 1), 3),
+               "goodput_tokens_per_sec": round(
+                   sum(len(r.output) for r in admitted) / wall, 1),
+               "slo_goodput_tokens_per_sec": round(
+                   sum(len(r.output) for r in in_slo) / wall, 1),
+               "requests_meeting_slo": len(in_slo),
+               "wall_s": round(wall, 3)}
+        if ttfts:
+            out["ttft_s"] = _lat_stats(ttfts)
+            out["p99_within_slo"] = bool(_pct(ttfts, 99) <= slo_s)
+        return out
+
+    shed_on = open_loop(shed=True, ttft_slo_s=slo_s)
+    shed_off = open_loop()
+    return {
+        "offered_load_x_capacity": load_factor,
+        "t_req_s": round(t_req, 3),
+        "ttft_slo_s": round(slo_s, 3),
+        "config": f"{n_req} reqs, arrival interval {interval * 1e3:.1f} "
+                  f"ms ({load_factor}x the measured {batch}-slot "
+                  "capacity), shed policy: TTFT window-p95 vs SLO "
+                  f"({slo_factor}x T_req, headroom 0.5, queue trimmed "
+                  "to the newest max_batch)",
+        "shed_on": shed_on,
+        "shed_off": shed_off,
+    }
+
+
 def scenario(on_tpu: bool, big: bool = False, shape: str = "auto"):
     """Workload + engine geometry per platform/shape. Returns
     (cfg, n_req, plens, out_hi, mk) — shared by main() and bench.py's
@@ -302,6 +402,11 @@ def main(big: bool = False, shape: str = "auto"):
         "single_dispatch": run_single_dispatch_comparison(
             params, cfg, prompts, news, mk, batch,
             int8_weights=(shape == "gpt1p3b" and on_tpu)),
+        # ISSUE 13: offered load at ~2x capacity, shedding on vs off —
+        # admitted-request TTFT percentiles, shed rate, goodput
+        "overload": run_overload_comparison(
+            params, cfg, mk, batch,
+            n_req=(64 if on_tpu else 48)),
     }
     if shape == "gpt1p3b":
         out["metric"] = "serving_single_dispatch_gpt1p3b"

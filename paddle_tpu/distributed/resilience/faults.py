@@ -40,6 +40,16 @@ Sites currently planted (grep for ``maybe_fail`` /
   the step runs: arm with a ``hangN`` clause to wedge the step past its
   budget so the watchdog fires and the flight recorder dumps, then let
   the run continue (the hang is a stall, not a crash)
+* ``serving/step``            — first thing in ``ServingEngine.step()``:
+  the kill-and-replay leg arms ``serving/step:3:kill`` to hard-kill the
+  serving process mid-workload (the ``run_serving_resilient`` driver
+  must rebuild + replay), and a ``hangN`` clause wedges the engine like
+  a stuck device would
+* ``serving/dispatch``        — immediately before each compiled serving
+  program is invoked (prefill / decode burst / unified ragged step)
+* ``serving/pool_exhausted``  — the admission loop found the queue head
+  pool-blocked (no free KV pages): fires each blocked attempt, so tests
+  can prove head-of-line pressure (and the preempt path) actually ran
 """
 
 from __future__ import annotations

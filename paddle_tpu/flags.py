@@ -633,6 +633,33 @@ define_flag("serving_kv_cache_dtype", "auto",
             "scales and dequantize in-kernel — half the decode HBM "
             "bytes, ~2x the sequences per pool byte budget; requires "
             "the ragged path (serving_ragged).")
+define_flag("serving_queue_max", 0,
+            "Admission control for the serving engine: max requests "
+            "waiting in the queue — arrivals beyond it are SHED at "
+            "submit (status='shed', serving_shed event, "
+            "requests_shed_total) so overload keeps the backlog (and "
+            "every queued request's TTFT) bounded. 0 = unbounded "
+            "(byte-identical to the pre-resilience scheduler; consumed "
+            "by inference.serving.ServingEngine).")
+define_flag("serving_shed", False,
+            "SLO-driven load shedding: when the engine's own prom TTFT "
+            "recent-window p95 crosses the ttft_slo_s headroom "
+            "(shed_headroom, default 0.5 — TTFT moves in engine-step "
+            "quanta, so waiting for p95 > SLO admits violators first) "
+            "and the queue exceeds twice the slot horizon, the queue is "
+            "trimmed to the NEWEST max_batch arrivals (the aged head "
+            "has already burned its latency budget) so ADMITTED "
+            "requests keep meeting the SLO instead of every request "
+            "missing it (consumed by inference.serving.ServingEngine; "
+            "needs ttft_slo_s).")
+define_flag("serving_preempt", False,
+            "Preempt-and-requeue under pool exhaustion: when the queue "
+            "head cannot get KV pages, evict a decode victim (pages "
+            "freed, request re-enqueued with prompt+generated-prefix "
+            "for recompute — greedy replay is token-identical) so pool "
+            "pressure never head-of-line-blocks an urgent request "
+            "behind a long decode (consumed by "
+            "inference.serving.ServingEngine).")
 define_flag("serving_adaptive_mix", True,
             "Adapt the per-step prefill/decode mix on the ragged path "
             "from the queue-depth and TTFT telemetry series: admission "

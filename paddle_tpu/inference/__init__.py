@@ -7,8 +7,34 @@ XLA's job here. A deploy artifact is the StableHLO export from jit.save
 (params baked in); Predictor AOT-compiles it once at construction and
 runs with device-resident input handles — the zero-copy surface
 (copy_from_cpu / copy_to_cpu) maps to device_put / device_get.
+
+The serving engine and its resilience driver (ISSUE 13) are exported
+lazily (PEP 562): a predictor-only consumer must not pay the
+serving + models.gpt import at package import time.
 """
 
 from .predictor import Config, Predictor, PredictorTensor, create_predictor
 
-__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+# lazy exports: name -> submodule (resolved on first attribute access)
+_LAZY = {
+    "NonFiniteSampleError": ".serving",
+    "Request": ".serving",
+    "RunResult": ".serving",
+    "ServingEngine": ".serving",
+    "ServingJournal": ".resilient",
+    "run_serving_resilient": ".resilient",
+}
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           *sorted(_LAZY)]
+
+
+def __getattr__(name):
+    sub = _LAZY.get(name)
+    if sub is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+    val = getattr(import_module(sub, __name__), name)
+    globals()[name] = val  # cache: later accesses skip this hook
+    return val

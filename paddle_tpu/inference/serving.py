@@ -42,6 +42,38 @@ TPU shape discipline, two engine modes:
 
 All cache state is functional jax arrays threaded through the programs;
 sampling happens in-program on both paths.
+
+Resilience layer (ISSUE 13) — all host-side scheduler state, no compiled
+program changes (flags-off the step behavior is byte-identical and the
+programs lower to the same HLO):
+
+* **Deadlines + cancellation** — ``add_request(deadline_s=)`` stamps an
+  absolute expiry; every step sheds stale QUEUED requests and cancels
+  expired IN-FLIGHT ones mid-generation (their pool pages freed and
+  re-admittable the same step). ``Request.status`` carries the lifecycle
+  (``ok | shed | cancelled | failed``).
+* **Admission control + load shedding** — ``queue_max``
+  (FLAGS_serving_queue_max) bounds the queue: overflow arrivals are shed
+  at submit instead of growing an unbounded backlog; with deadlines
+  present the queue admits earliest-deadline-first; with ``shed=True``
+  (FLAGS_serving_shed) the engine watches its OWN prom TTFT recent-window
+  p95 against ``ttft_slo_s`` headroom and, once the queue exceeds twice
+  the slot horizon, trims it to the NEWEST ``max_batch`` arrivals — so
+  overload degrades admitted-request p99 gracefully instead of
+  collapsing everyone's.
+* **Preempt-and-requeue** — ``preempt=True`` (FLAGS_serving_preempt):
+  when the queue head cannot get pages, a decode victim is evicted
+  (pages freed, request re-enqueued with prompt+generated-prefix for
+  recompute; greedy replay is token-identical), so pool pressure can
+  never head-of-line-block an urgent request behind a long decode.
+* **Forensics** — fault-injection sites ``serving/step`` /
+  ``serving/dispatch`` / ``serving/pool_exhausted`` (faults.py grammar,
+  incl. hang/kill clauses), a flight-recorder serving snapshot
+  (slots/queue/pool/request statuses), and a ``/healthz`` readiness
+  state (``loading/ready/draining/degraded``) on the metrics server.
+
+The crash-recovering request-replay driver lives in
+:mod:`inference.resilient` (``run_serving_resilient``).
 """
 
 from __future__ import annotations
@@ -54,18 +86,50 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from ..enforce import InvalidArgumentError
 from jax import lax
 
 from ..models import gpt as G
 from ..profiler.utils import RecordEvent
 
-__all__ = ["Request", "ServingEngine", "generate_static_batch"]
+__all__ = ["Request", "ServingEngine", "RunResult", "NonFiniteSampleError",
+           "generate_static_batch"]
+
+# Request.status lifecycle (terminal states besides plain completion):
+#   ok        — queued / running / finished normally
+#   shed      — dropped having delivered NOTHING (deadline expired in
+#               queue, queue_max overflow, overload shed, draining
+#               engine); a resubmission elsewhere starts from scratch
+#   cancelled — dropped after delivering tokens (expired mid-generation,
+#               or a preempted-and-requeued victim dropped from the
+#               queue); pages freed, partial output kept
+#   failed    — rejected (can never fit) or its on_token callback raised
+REQUEST_STATUSES = ("ok", "shed", "cancelled", "failed")
+
+
+class NonFiniteSampleError(RuntimeError):
+    """The compiled step handed back a token outside [0, vocab) — the
+    signature of a poisoned sampling path (nonfinite logits / corrupted
+    state). Carries the rid so the resilient driver's circuit breaker can
+    fail THAT request instead of retrying the whole engine forever."""
+
+    def __init__(self, rid: int, token: int):
+        super().__init__(
+            f"request {rid} sampled out-of-range token {token} — "
+            "nonfinite/poisoned sampling state")
+        self.rid = rid
+        self.token = token
 
 
 def _dispatch_rtt_ms() -> float:
     from ..utils.timing import dispatch_rtt_s
     return dispatch_rtt_s() * 1e3
+
+
+def _faults():
+    # lazy: the injection registry is stdlib-only, but its package pulls
+    # the checkpoint/driver stack — don't pay that at serving import
+    from ..distributed.resilience import faults
+    return faults
 
 
 @dataclasses.dataclass
@@ -81,9 +145,32 @@ class Request:
     prefill_done: int = 0
     output: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # resilience (ISSUE 13): lifecycle status + absolute deadline.
+    # `prompt` may GROW on preemption (emitted prefix appended for
+    # recompute); `output` keeps every token ever emitted, so
+    # remaining-to-emit is always max_new_tokens - len(output).
+    status: str = "ok"
+    error: Optional[str] = None
+    deadline: Optional[float] = None    # absolute time.perf_counter()
+    preemptions: int = 0
+    folded: int = 0                     # output tokens already folded
+    #                                     into prompt by past preemptions
     # telemetry (observability): submit wall clock + time-to-first-token
     submit_time: float = 0.0
     ttft_s: Optional[float] = None
+
+
+class RunResult(dict):
+    """``ServingEngine.run`` return value: a plain ``{rid: output}`` dict
+    plus the resilience markers — ``statuses`` ({rid: Request.status} for
+    every request the run reported) and ``leftover`` (rids still queued/
+    in-flight when the step budget ran out, instead of silently dropping
+    them)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.statuses: Dict[int, str] = {}
+        self.leftover: List[int] = []
 
 
 def _embed(params, tokens, pos, cfg):
@@ -314,7 +401,9 @@ class ServingEngine:
                  int8: bool = False, ragged=None, kv_cache_dtype=None,
                  kv_pool_bytes: Optional[int] = None,
                  token_budget: Optional[int] = None, adaptive_mix=None,
-                 ttft_slo_s: Optional[float] = None):
+                 ttft_slo_s: Optional[float] = None, queue_max=None,
+                 shed=None, shed_headroom: float = 0.5, preempt=None,
+                 preempt_wait_steps: int = 2):
         from ..flags import flag
         from ..enforce import enforce
         block_size = (int(flag("paged_block_size")) if block_size is None
@@ -385,6 +474,28 @@ class ServingEngine:
         self._c_att = max(1, min(chunk, self.token_budget))
         self.adaptive_mix = adaptive_mix
         self.ttft_slo_s = ttft_slo_s
+        # -- resilience (ISSUE 13): admission control + shed/preempt policy.
+        # All host-side scheduler state; flags-off none of it changes the
+        # compiled programs or the step-for-step behavior.
+        if queue_max is None or queue_max == "auto":
+            queue_max = int(flag("serving_queue_max"))
+        self.queue_max = int(queue_max)          # 0 = unbounded
+        if shed is None or shed == "auto":
+            shed = bool(flag("serving_shed"))
+        self.shed_on_overload = bool(shed)
+        self.shed_headroom = float(shed_headroom)
+        if preempt is None or preempt == "auto":
+            preempt = bool(flag("serving_preempt"))
+        self.preempt = bool(preempt)
+        self.preempt_wait_steps = max(int(preempt_wait_steps), 1)
+        self._hol_wait_steps = 0   # consecutive steps the queue head was
+        #                            pool-blocked (preemption trigger)
+        self.draining = False
+        self._health = "loading"
+        # terminal transitions that happen OUTSIDE a step (shed at submit)
+        # are queued here and reported by the next step()/run() so no
+        # request ever silently vanishes
+        self._notify: List[Request] = []
         # SLO pressure reads the prom registry's recent-window p95 (16
         # samples), not the exported summary's lifetime mean — one
         # compile-heavy startup wave must not pin the adaptive mix at
@@ -418,6 +529,11 @@ class ServingEngine:
         self._metrics_server = None
         self._t_first_step: Optional[float] = None
         self._tokens_total = 0
+        # crash forensics: flight-recorder bundles include a serving
+        # snapshot (slots/queue/pool/request statuses) of every live
+        # engine — weak registration, same contract as TelemetryHost
+        from ..observability.flight_recorder import register_serving_engine
+        register_serving_engine(self)
 
         # params ride as ARGUMENTS (a closure would bake 4 bytes/param
         # into the serialized HLO — megabytes that also defeat donation)
@@ -693,70 +809,244 @@ class ServingEngine:
 
     # -- public --------------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int, temperature=0.0,
-                    eos_id=None, on_token=None) -> int:
+                    eos_id=None, on_token=None,
+                    deadline_s: Optional[float] = None) -> int:
+        """Submit a request. deadline_s: seconds from NOW the caller is
+        willing to wait for completion — past it the scheduler sheds the
+        request from the queue or cancels it mid-generation (pages
+        freed). A draining or full-queue engine sheds at submit; the shed
+        request is still reported by the next step()/run() with
+        ``status='shed'``."""
         rid = self._next_rid
         self._next_rid += 1
         r = Request(rid, np.asarray(prompt, np.int32),
                     int(max_new_tokens), temperature, eos_id, on_token)
         r.submit_time = time.perf_counter()
-        self.queue.append(r)
+        if deadline_s is not None:
+            r.deadline = r.submit_time + float(deadline_s)
         self._prom.counter_inc("requests_total",
                                help="requests ever submitted")
+        if self.draining:
+            self._shed(r, "draining")
+            self._notify.append(r)
+            return rid
+        if self.queue_max and len(self.queue) >= self.queue_max:
+            # bounded queue: shedding the ARRIVAL keeps the backlog (and
+            # every queued request's TTFT) bounded under overload
+            self._shed(r, "queue_full")
+            self._notify.append(r)
+            return rid
+        self.queue.append(r)
         self._prom.gauge_set("queue_depth", len(self.queue),
                              help="requests waiting for a slot")
-        from ..observability import get_event_log
-        log = get_event_log()
-        if log is not None:
-            # role override: serving events stay attributable after
-            # merge_event_streams folds them into the trainer timeline
-            log.emit("serving_admit", role="serving", rid=rid,
-                     prompt_len=len(r.prompt),
-                     max_new_tokens=r.max_new_tokens,
-                     queue_depth=len(self.queue))
+        self._emit_event("serving_admit", rid=rid,
+                         prompt_len=len(r.prompt),
+                         max_new_tokens=r.max_new_tokens,
+                         deadline_s=deadline_s,
+                         queue_depth=len(self.queue))
         return rid
 
     def has_work(self) -> bool:
         return bool(self.queue) or any(s is not None for s in self.slots)
 
-    def run(self, max_steps: int = 100000) -> Dict[int, List[int]]:
-        """Drive to completion; returns {rid: output token ids}."""
-        results: Dict[int, List[int]] = {}
+    def run(self, max_steps: int = 100000) -> "RunResult":
+        """Drive to completion; returns {rid: output token ids} (a
+        :class:`RunResult`: ``.statuses`` maps each reported rid to its
+        lifecycle status, and when the step budget runs out with work
+        left the survivors land in ``.leftover`` — reported loudly
+        (``serving_steps_exhausted`` event + counter) instead of being
+        silently dropped)."""
+        results = RunResult()
+
+        def take(reqs):
+            for r in reqs:
+                results[r.rid] = r.output
+                results.statuses[r.rid] = r.status
+        take(self._take_notifications())
         for _ in range(max_steps):
             if not self.has_work():
                 break
-            for r in self.step():
-                results[r.rid] = r.output
+            take(self.step())
+        if self.has_work():
+            leftover = ([r.rid for r in self.queue]
+                        + [s.rid for s in self.slots if s is not None])
+            results.leftover = sorted(leftover)
+            self._prom.counter_inc(
+                "run_steps_exhausted_total",
+                help="run() budgets that ran out with work left")
+            self._emit_event("serving_steps_exhausted",
+                             max_steps=max_steps,
+                             leftover=results.leftover)
         return results
+
+    # -- resilience surface (ISSUE 13) ---------------------------------------
+    @property
+    def health(self) -> str:
+        """Readiness state for /healthz: ``loading`` (no completed step
+        yet), ``ready``, ``draining`` (SIGTERM drain — finishing, not
+        admitting), ``degraded`` (driver-set during rebuild/overload)."""
+        return self._health
+
+    def set_health(self, state: str) -> None:
+        from ..enforce import enforce
+        enforce(state in ("loading", "ready", "draining", "degraded"),
+                f"unknown health state {state!r}", op="ServingEngine")
+        self._health = state
+
+    def drain(self) -> None:
+        """Enter drain mode (the SIGTERM endgame): stop admitting — both
+        from the queue and at submit — and let in-flight requests finish.
+        The resilient driver pairs this with :meth:`shed_queue` and, at
+        grace expiry, :meth:`cancel_all`."""
+        if not self.draining:
+            self.draining = True
+            self._health = "draining"
+            self._emit_event("serving_drain", queue_depth=len(self.queue),
+                             running=sum(s is not None
+                                         for s in self.slots))
+
+    def shed_queue(self, reason: str = "draining") -> List[Request]:
+        """Shed every queued (not yet started) request; returns them so a
+        driver can requeue elsewhere. In-flight requests are untouched."""
+        out, self.queue = self.queue, []
+        for r in out:
+            self._shed(r, reason)
+        self._notify.extend(out)
+        self._prom.gauge_set("queue_depth", 0)
+        return out
+
+    def cancel(self, rid: int, reason: str = "cancelled"
+               ) -> Optional[Request]:
+        """Cancel one request wherever it is (queued -> shed, in-flight ->
+        pages freed); returns the Request, or None if unknown/finished."""
+        for r in list(self.queue):
+            if r.rid == rid:
+                self.queue.remove(r)
+                self._shed(r, reason)
+                self._notify.append(r)
+                return r
+        for r in self.slots:
+            if r is not None and r.rid == rid:
+                self._cancel(r, reason)
+                self._notify.append(r)
+                return r
+        return None
+
+    def cancel_all(self, reason: str = "cancelled") -> List[Request]:
+        """Cancel everything (queued + in-flight); returns the requests.
+        The drain-deadline endgame: pages all return to the pool."""
+        out = self.shed_queue(reason)
+        for r in list(self.slots):
+            if r is not None:
+                self._cancel(r, reason)
+                self._notify.append(r)
+                out.append(r)
+        return out
+
+    def snapshot(self) -> Dict:
+        """Host-state serving snapshot for flight-recorder bundles:
+        slots, queue, pool utilization, health — cheap, never touches
+        the device."""
+        total = self._num_blocks - 1
+
+        def req(r):
+            return {"rid": r.rid, "status": r.status,
+                    "prompt_len": int(len(r.prompt)),
+                    "emitted": len(r.output),
+                    "prefill_done": int(r.prefill_done),
+                    "max_new_tokens": int(r.max_new_tokens),
+                    "deadline_in_s": (
+                        None if r.deadline is None
+                        else round(r.deadline - time.perf_counter(), 3)),
+                    "preemptions": r.preemptions}
+        return {
+            "health": self._health, "draining": self.draining,
+            "engine_steps": self.engine_steps,
+            "dispatches": self.dispatches,
+            "free_blocks": len(self.free_blocks),
+            "pool_utilization": (1.0 - len(self.free_blocks) / total
+                                 if total else 0.0),
+            "slots": [None if s is None else req(s) for s in self.slots],
+            "queue": [req(r) for r in self.queue],
+        }
+
+    def _take_notifications(self) -> List[Request]:
+        out, self._notify = self._notify, []
+        return out
+
+    def _emit_event(self, event: str, **fields):
+        from ..observability import get_event_log
+        log = get_event_log()
+        if log is not None:
+            # role override: serving events stay attributable after
+            # merge_event_streams folds them into the trainer timeline
+            log.emit(event, role="serving", **fields)
 
     # -- scheduler -----------------------------------------------------------
     def _blocks_needed(self, r: Request) -> int:
-        return -(-(len(r.prompt) + r.max_new_tokens) // self.bs)
+        # total sequence = prompt + NOT-yet-folded generation: a
+        # preempted request's prompt already holds its first `folded`
+        # emitted tokens, so the request's footprint is invariant across
+        # preemptions
+        return -(-(len(r.prompt) + r.max_new_tokens - r.folded)
+                 // self.bs)
 
     def _admit(self) -> List[int]:
         """Admit queued requests into free slots while the pool has
         pages; returns the freshly-admitted slot ids (the ragged path
         resets those slots' page scales in-program). When free pages run
-        out the head of the queue WAITS (no starvation); a request that
-        could never fit even in an empty pool is rejected loudly."""
+        out the head of the queue WAITS (no starvation) — unless
+        ``preempt`` lets it evict a decode victim; a request that could
+        never fit even in an empty pool is rejected PER-REQUEST
+        (status='failed' + serving_reject event naming the binding cap)
+        while its siblings keep admitting. With any deadline present the
+        queue admits earliest-deadline-first (stable: no-deadline
+        requests keep FIFO order among themselves)."""
         fresh: List[int] = []
         usable = self._num_blocks - 1  # block 0 is reserved scratch
-        for i in range(self.max_batch):
-            if self.slots[i] is not None or not self.queue:
-                continue
+        if self.draining or not self.queue:
+            return fresh
+        if any(r.deadline is not None for r in self.queue):
+            big = float("inf")
+            self.queue.sort(key=lambda r: (r.deadline if r.deadline
+                                           is not None else big))
+        while self.queue:
+            try:
+                i = self.slots.index(None)
+            except ValueError:
+                break  # no free slot
             r = self.queue[0]
             need = self._blocks_needed(r)
             if need > self.tables.shape[1] or need > usable:
+                # can never fit, even in an empty pool: reject THIS
+                # request and keep admitting — raising here aborted the
+                # whole engine step and stranded every sibling
                 self.queue.pop(0)
-                r.done = True  # cannot ever fit; reject loudly
                 cap = (f"max_blocks_per_seq {self.tables.shape[1]}"
                        if need > self.tables.shape[1]
                        else f"pool capacity {usable}")
-                raise InvalidArgumentError(
-                    f"request {r.rid} needs {need} blocks > {cap} — it "
-                    "can never be admitted")
+                r.done = True
+                r.status = "failed"
+                r.error = (f"needs {need} blocks > {cap} — can never be "
+                           "admitted")
+                self._prom.counter_inc(
+                    "requests_rejected_total",
+                    help="requests that could never fit (failed at "
+                         "admission)")
+                self._emit_event("serving_reject", rid=r.rid,
+                                 blocks_needed=need, binding_cap=cap)
+                self._notify.append(r)
+                continue
             if need > len(self.free_blocks):
-                break  # head-of-line waits for evictions (no starvation)
+                # pool exhaustion: the injected-fault site the resilience
+                # tests arm, then either preempt a decode victim or wait
+                _faults().maybe_fail("serving/pool_exhausted")
+                self._hol_wait_steps += 1
+                if self._try_preempt(r, need):
+                    continue  # retry the head against the freed pages
+                break  # head-of-line waits for finishes (no starvation)
             self.queue.pop(0)
+            self._hol_wait_steps = 0
             blocks = [self.free_blocks.pop() for _ in range(need)]
             self.tables[i, :] = 0
             self.tables[i, :need] = blocks
@@ -767,18 +1057,190 @@ class ServingEngine:
             fresh.append(i)
         return fresh
 
-    def _finish(self, r: Request):
+    def _try_preempt(self, head: Request, need: int) -> bool:
+        """Preempt-and-requeue (ISSUE 13c): evict a decode-phase victim so
+        the pool-blocked queue head can make progress — its pages free,
+        and the victim re-enqueues with prompt+generated-prefix for
+        recompute (greedy replay is token-identical). Victim choice:
+        prefer requests without deadlines, then latest deadline, then most
+        remaining work. Fires only after the head has been blocked
+        ``preempt_wait_steps`` consecutive admission attempts, and never
+        preempts a victim that would not actually unblock the head or one
+        already preempted 3 times (anti-thrash)."""
+        if not self.preempt:
+            return False
+        if self._hol_wait_steps < self.preempt_wait_steps:
+            return False
+        big = float("inf")
+        victims = [r for r in self.slots
+                   if r is not None and r.prefill_done >= len(r.prompt)
+                   and r.preemptions < 3]
+        # urgency: with deadlines, only preempt a victim LESS urgent than
+        # the head; without deadlines any decode victim unblocks the line
+        if head.deadline is not None:
+            victims = [r for r in victims
+                       if (r.deadline or big) > head.deadline]
+        victims.sort(key=lambda r: (r.deadline is not None,
+                                    -(r.deadline or big) if r.deadline
+                                    else 0.0,
+                                    -(r.max_new_tokens - len(r.output))))
+        for v in victims:
+            held = sum(1 for b in self.tables[v.slot] if b != 0)
+            if need <= len(self.free_blocks) + held:
+                self._preempt(v)
+                return True
+        return False
+
+    def _preempt(self, r: Request):
+        """Evict a running decode request: free its pages and re-enqueue
+        it with its emitted tokens folded into the prompt, so re-admission
+        re-prefills prompt+prefix and decoding continues where it left
+        off (`output` keeps the emitted tokens — remaining budget and the
+        finish condition are unchanged)."""
+        slot = r.slot
+        self._release_slot(r)
+        fresh = r.output[r.folded:]  # only tokens NOT already folded by
+        #                              an earlier preemption
+        if fresh:
+            r.prompt = np.concatenate(
+                [r.prompt, np.asarray(fresh, np.int32)])
+        r.folded = len(r.output)
+        r.prefill_done = 0
+        r.preemptions += 1
+        self.queue.append(r)
+        self._prom.counter_inc("requests_preempted_total",
+                               help="decode victims evicted-and-requeued "
+                                    "under pool exhaustion")
+        self._emit_event("serving_preempt", rid=r.rid, slot=slot,
+                         emitted=len(r.output),
+                         preemptions=r.preemptions)
+
+    def _release_slot(self, r: Request):
+        """Return a running request's pages + slot to the pool (shared by
+        finish/cancel/preempt)."""
         i = r.slot
         used = {int(b) for b in self.tables[i] if b != 0}
         self.free_blocks.extend(sorted(used))
         self.tables[i, :] = 0
         self.lens[i] = 0
         self.slots[i] = None
-        r.done = True
+        self._pending_tok[i] = 0
         r.slot = -1
 
+    def _finish(self, r: Request):
+        self._release_slot(r)
+        r.done = True
+
+    def _shed(self, r: Request, reason: str):
+        """Drop a queued request. status='shed' means it NEVER delivered
+        anything; a preempted-and-requeued victim that already emitted
+        tokens reports 'cancelled' instead (partial output kept) — a
+        consumer resubmitting a 'shed' request verbatim must never
+        double-deliver a prefix."""
+        r.done = True
+        r.error = reason
+        if r.output:
+            self._mark_cancelled(r, reason)
+            return
+        r.status = "shed"
+        self._prom.counter_inc("requests_shed_total",
+                               help="requests shed before running "
+                                    "(deadline/queue_full/overload/"
+                                    "draining)")
+        self._emit_event("serving_shed", rid=r.rid, reason=reason,
+                         queue_depth=len(self.queue))
+
+    def _mark_cancelled(self, r: Request, reason: str):
+        """The ONE copy of cancellation bookkeeping (shared by _cancel
+        and _shed's delivered-prefix branch)."""
+        r.done = True
+        r.status = "cancelled"
+        r.error = reason
+        self._prom.counter_inc("requests_cancelled_total",
+                               help="requests cancelled after delivering "
+                                    "tokens (deadline expiry / drain "
+                                    "endgame / dropped requeued victim)")
+        self._emit_event("serving_cancelled", rid=r.rid, reason=reason,
+                         emitted=len(r.output))
+
+    def _cancel(self, r: Request, reason: str):
+        """Cancel an IN-FLIGHT request mid-generation: pages freed and
+        accounted, partial output kept, status='cancelled'."""
+        self._release_slot(r)
+        self._mark_cancelled(r, reason)
+
+    def _expire(self) -> List[Request]:
+        """Deadline enforcement, both ends: shed stale QUEUED requests and
+        cancel expired IN-FLIGHT ones (their pages free before this
+        step's admission runs). No-deadline requests cost one comparison
+        each — behavior is untouched."""
+        if (not self.queue or all(r.deadline is None for r in self.queue)) \
+                and all(s is None or s.deadline is None
+                        for s in self.slots):
+            return []
+        now = time.perf_counter()
+        out: List[Request] = []
+        keep: List[Request] = []
+        for r in self.queue:
+            if r.deadline is not None and now > r.deadline:
+                self._shed(r, "deadline")
+                out.append(r)
+            else:
+                keep.append(r)
+        self.queue = keep
+        for r in list(self.slots):
+            if (r is not None and r.deadline is not None
+                    and now > r.deadline):
+                self._cancel(r, "deadline")
+                out.append(r)
+        return out
+
+    def _shed_overload(self) -> List[Request]:
+        """SLO-driven load shedding (ISSUE 13b): when the prom TTFT
+        recent-window p95 crosses ``shed_headroom`` of ``ttft_slo_s``
+        the engine is not keeping up — trim the queue to what the slots
+        can absorb in about one wave (``max_batch``), keeping the NEWEST
+        arrivals: the aged head has already burned most of its latency
+        budget (with deadlines, ``_expire`` would shortly shed it
+        anyway), so admitting fresh requests is what keeps ADMITTED p99
+        inside the SLO instead of every request missing it. The headroom
+        factor (default 0.5) triggers BEFORE the first violation —
+        TTFT moves in whole engine-step quanta, so a policy that waits
+        for p95 > SLO has already admitted violators by the time it
+        reacts. Hysteresis: trim only once the queue exceeds TWICE the
+        slot horizon — the 16-sample window's p95 (its max) is sticky, so
+        trimming on every step while it decays would shed far past the
+        overload fraction (measured 73% shed at 2x load without the depth
+        gate vs ~50% ideal)."""
+        if (not self.shed_on_overload or self.ttft_slo_s is None
+                or len(self.queue) <= 2 * self.max_batch):
+            return []
+        p95 = self._prom.quantile("ttft_seconds", 0.95)
+        if p95 is None or p95 <= self.shed_headroom * self.ttft_slo_s:
+            return []
+        if any(r.deadline is not None for r in self.queue):
+            # _admit's in-place EDF sort persists in the queue, so
+            # "newest arrivals" is not the tail here — with deadlines the
+            # most-urgent (earliest-deadline) requests are the ones worth
+            # keeping, consistent with EDF admission
+            big = float("inf")
+            self.queue.sort(key=lambda r: (r.deadline if r.deadline
+                                           is not None else big))
+            shed, self.queue = (self.queue[self.max_batch:],
+                                self.queue[:self.max_batch])
+        else:
+            shed, self.queue = (self.queue[:-self.max_batch],
+                                self.queue[-self.max_batch:])
+        for r in shed:
+            self._shed(r, "overload")
+        self._prom.gauge_set("queue_depth", len(self.queue))
+        return shed
+
     def _emit(self, r: Request, tok: int) -> bool:
-        """Record a sampled token; True if the request just finished."""
+        """Record a sampled token; True if the request just finished. A
+        raising user ``on_token`` callback fails ONLY this request
+        (status='failed', serving_callback_error event) — it must never
+        kill the engine step and strand every co-scheduled sibling."""
         r.output.append(tok)
         self._tokens_total += 1
         if len(r.output) == 1:
@@ -791,7 +1253,18 @@ class ServingEngine:
                 "ttft_seconds_hist", r.ttft_s,
                 help="submit-to-first-token latency distribution")
         if r.on_token is not None:
-            r.on_token(r.rid, tok)
+            try:
+                r.on_token(r.rid, tok)
+            except Exception as e:
+                r.status = "failed"
+                r.error = f"on_token callback raised: {e!r}"
+                r.on_token = None
+                self._prom.counter_inc(
+                    "callback_errors_total",
+                    help="requests failed by a raising on_token callback")
+                self._emit_event("serving_callback_error", rid=r.rid,
+                                 error=repr(e), emitted=len(r.output))
+                return True  # finish (and free) the poisoned request
         return (len(r.output) >= r.max_new_tokens
                 or (r.eos_id is not None and tok == r.eos_id))
 
@@ -799,17 +1272,34 @@ class ServingEngine:
         """One engine iteration. Ragged path: admit -> ONE compiled
         program (prefill chunks + decode burst fused over a packed
         ragged batch). Two-program path: admit -> one prefill chunk ->
-        one decode burst. Returns requests finished this step.
+        one decode burst. Returns every request that reached a TERMINAL
+        state this step — finished, plus deadline-shed/cancelled,
+        overload-shed, rejected, and submit-time sheds queued since the
+        last step (check ``Request.status``).
 
         The whole step runs inside a ``serving_step`` RecordEvent span
         (dispatches get their own nested spans), so serving lands on the
         SAME host timeline as training: Profiler summaries, chrome-trace
-        exports and observability.capture_spans all see it."""
+        exports and observability.capture_spans all see it. The
+        ``serving/step`` fault-injection site fires FIRST — a kill/hang
+        clause takes the whole step down exactly as a wedged device
+        would."""
         self.engine_steps += 1
+        _faults().maybe_fail("serving/step")
         with RecordEvent("serving_step"):
+            terminal = self._take_notifications()
+            terminal += self._expire()
+            terminal += self._shed_overload()
             if self.ragged:
-                return self._step_ragged()
-            return self._step_two_program()
+                out = self._step_ragged()
+            else:
+                out = self._step_two_program()
+            if self._health == "loading":
+                self._health = "ready"
+            # admission-time rejections land in _notify DURING the path
+            # body — drain them now so a run that ends this step still
+            # reports them
+            return terminal + out + self._take_notifications()
 
     def _step_two_program(self) -> List[Request]:
         """The frozen parity baseline: one batched prefill-chunk dispatch
@@ -848,6 +1338,7 @@ class ServingEngine:
             self._key, sub = jax.random.split(self._key)
             self.dispatches += 1
             with RecordEvent("serving_prefill_dispatch"):
+                _faults().maybe_fail("serving/dispatch")
                 tok_dev, self.k_pools, self.v_pools = self._prefill(
                     self.params, jnp.asarray(buf), jnp.asarray(pos0),
                     jnp.asarray(tables_pre), jnp.asarray(last_idx),
@@ -862,7 +1353,7 @@ class ServingEngine:
                 r.prefill_done = his[r.slot]
                 self.lens[r.slot] = his[r.slot]
             for r in completing:
-                tok = int(tok_np[r.slot])
+                tok = self._check_tok(r, int(tok_np[r.slot]))
                 self._pending_tok[r.slot] = tok
                 if self._emit(r, tok):
                     finished.append(r)
@@ -895,6 +1386,7 @@ class ServingEngine:
             self.decode_microsteps += K
             self.dispatches += 1
             with RecordEvent("serving_decode_dispatch"):
+                _faults().maybe_fail("serving/dispatch")
                 toks, self.k_pools, self.v_pools, lens = self._decode_k[K](
                     self.params, jnp.asarray(self._pending_tok),
                     self.k_pools, self.v_pools, jnp.asarray(self.tables),
@@ -906,7 +1398,7 @@ class ServingEngine:
                 for t in range(toks.shape[0]):
                     if r.done:
                         break
-                    tok = int(toks[t, r.slot])
+                    tok = self._check_tok(r, int(toks[t, r.slot]))
                     self._pending_tok[r.slot] = tok
                     if self._emit(r, tok):
                         finished.append(r)
@@ -916,6 +1408,16 @@ class ServingEngine:
         self._step_metrics(t_step0, tokens_before, len(pre), len(dec),
                            finished)
         return finished
+
+    def _check_tok(self, r: Request, tok: int) -> int:
+        """Sampled-token sanity gate: an out-of-range token means the
+        sampling path is poisoned (nonfinite logits, corrupted pool) —
+        raise with the rid attached so the resilient driver's circuit
+        breaker fails THAT request instead of retrying the engine
+        forever. Two comparisons per token; valid tokens untouched."""
+        if tok < 0 or tok >= self.cfg.vocab_size:
+            raise NonFiniteSampleError(r.rid, tok)
+        return tok
 
     def _step_ragged(self) -> List[Request]:
         """The single-dispatch step: admit, pack ONE ragged token batch
@@ -981,7 +1483,10 @@ class ServingEngine:
             q_lens[i] = grant
             completing = lo + grant >= len(r.prompt)
             sample0[i] = completing
-            remaining[i] = r.max_new_tokens if completing else 0
+            # remaining-to-EMIT: a preempted-and-requeued request's
+            # emitted prefix lives in both prompt and output
+            remaining[i] = (r.max_new_tokens - len(r.output)
+                            if completing else 0)
             if r.eos_id is not None:
                 eos_ids[i] = r.eos_id
             temps[i] = r.temperature
@@ -1010,6 +1515,7 @@ class ServingEngine:
             args = args + (self.k_scales, self.v_scales)
         self.dispatches += 1
         with RecordEvent("serving_unified_dispatch"):
+            _faults().maybe_fail("serving/dispatch")
             (toks, self.k_pools, self.v_pools, self.k_scales,
              self.v_scales, lens) = self._unified(K)(*args)
             toks = np.asarray(toks)          # [K, R] — ONE host fetch
@@ -1021,7 +1527,7 @@ class ServingEngine:
             for t in range(toks.shape[0]):
                 if r.done:
                     break
-                tok = int(toks[t, r.slot])
+                tok = self._check_tok(r, int(toks[t, r.slot]))
                 self._pending_tok[r.slot] = tok
                 if self._emit(r, tok):
                     finished.append(r)
@@ -1084,12 +1590,17 @@ class ServingEngine:
         prom.gauge_set("tokens_per_sec", self._tokens_total / elapsed,
                        help="tokens emitted since the first engine step / "
                             "elapsed wall time")
-        prom.counter_inc("requests_completed_total", len(finished),
-                         help="requests finished")
-        if finished:
+        # completed == finished SUCCESSFULLY: a request failed by its
+        # own callback rides `finished` for page accounting but must not
+        # count as a completion (it already counted in
+        # callback_errors_total / serving_callback_error)
+        ok = [r for r in finished if r.status == "ok"]
+        prom.counter_inc("requests_completed_total", len(ok),
+                         help="requests finished successfully")
+        if ok:
             from ..observability import get_event_log
             log = get_event_log()
-            for r in finished:
+            for r in ok:
                 prom.summary_observe(
                     "request_seconds",
                     time.perf_counter() - r.submit_time,
@@ -1109,12 +1620,22 @@ class ServingEngine:
         return self._prom
 
     def serve_metrics(self, port: Optional[int] = None):
-        """Start (or return) the /metrics HTTP endpoint. port None reads
-        FLAGS_telemetry_prometheus_port (0 there = disabled -> None);
-        port=0 binds an ephemeral port (read it from .port)."""
+        """Start (or return) the /metrics HTTP endpoint — which also
+        serves ``/healthz`` (200 {"state": "ready"} when the engine is
+        ready, 503 with the state otherwise: loading/draining/degraded).
+        port None reads FLAGS_telemetry_prometheus_port (0 there =
+        disabled -> None); port=0 binds an ephemeral port (read it from
+        .port)."""
         if self._metrics_server is None:
+            import weakref
             from ..observability import serve_registry
-            self._metrics_server = serve_registry(self._prom, port)
+            # weak: the server thread outlives discarded engines — a
+            # strong closure would pin the params + device KV pools of
+            # every dead engine for the server's lifetime
+            ref = weakref.ref(self)
+            self._metrics_server = serve_registry(
+                self._prom, port,
+                health_fn=lambda: getattr(ref(), "health", "degraded"))
         return self._metrics_server
 
 

@@ -43,11 +43,13 @@ import weakref
 from typing import Any, Dict, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
-           "maybe_dump", "register_telemetry_host", "register_aggregator"]
+           "maybe_dump", "register_telemetry_host", "register_aggregator",
+           "register_serving_engine"]
 
 _SRC_LOCK = threading.Lock()
 _TELEMETRY_HOSTS: "weakref.WeakSet" = weakref.WeakSet()
 _AGGREGATORS: "weakref.WeakSet" = weakref.WeakSet()
+_SERVING_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_telemetry_host(host) -> None:
@@ -63,6 +65,14 @@ def register_aggregator(agg) -> None:
     state in crash bundles."""
     with _SRC_LOCK:
         _AGGREGATORS.add(agg)
+
+
+def register_serving_engine(engine) -> None:
+    """Weakly track a ServingEngine so crash bundles include its host
+    snapshot — slots, queue, pool utilization, per-request status
+    (called by ServingEngine.__init__; ISSUE 13)."""
+    with _SRC_LOCK:
+        _SERVING_ENGINES.add(engine)
 
 
 from .events import _jsonable  # one coercion for bundles AND the log
@@ -152,6 +162,7 @@ class FlightRecorder:
         with _SRC_LOCK:
             hosts = list(_TELEMETRY_HOSTS)
             aggs = list(_AGGREGATORS)
+            engines = list(_SERVING_ENGINES)
         tele = {}
         for i, h in enumerate(hosts):
             try:
@@ -182,6 +193,17 @@ class FlightRecorder:
                 continue
         if beats:
             self._write_json(path, "heartbeats.json", beats)
+
+        # serving state: what every live engine was doing — slots, queue,
+        # pool utilization, per-request status (host dicts only)
+        serving = {}
+        for i, e in enumerate(engines):
+            try:
+                serving[f"serving_engine_{i}"] = e.snapshot()
+            except Exception:
+                continue
+        if serving:
+            self._write_json(path, "serving.json", serving)
 
         from .profile_reader import active_profile_window
         win = active_profile_window()

@@ -228,14 +228,34 @@ def _fmt(v: float) -> str:
 
 class MetricsServer:
     """Threaded /metrics endpoint over a registry (or any render()-able).
-    port=0 binds an ephemeral port; read it back from ``.port``."""
+    port=0 binds an ephemeral port; read it back from ``.port``.
+
+    health_fn: optional zero-arg callable returning a readiness state
+    string (the serving engine's ``loading/ready/draining/degraded``) —
+    when set, the server also answers ``/healthz`` with a JSON body
+    ``{"state": ...}``: HTTP 200 iff the state is ``ready``, 503
+    otherwise, so a fleet router/load-balancer can gate traffic on it
+    without parsing metrics."""
 
     def __init__(self, registry: PromRegistry, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", health_fn=None):
         reg = registry
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
+                if health_fn is not None and \
+                        self.path.rstrip("/") == "/healthz":
+                    try:
+                        state = str(health_fn())
+                    except Exception:  # readiness must never 500 opaquely
+                        state = "degraded"
+                    body = ('{"state": "%s"}' % state).encode("utf-8")
+                    self.send_response(200 if state == "ready" else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path.rstrip("/") not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
@@ -272,12 +292,14 @@ class MetricsServer:
 
 
 def serve_registry(registry: PromRegistry,
-                   port: Optional[int] = None) -> Optional[MetricsServer]:
+                   port: Optional[int] = None,
+                   health_fn=None) -> Optional[MetricsServer]:
     """Start a scrape endpoint; port None reads
-    FLAGS_telemetry_prometheus_port (0 = disabled -> None)."""
+    FLAGS_telemetry_prometheus_port (0 = disabled -> None). health_fn
+    adds the /healthz readiness route (see MetricsServer)."""
     if port is None:
         from ..flags import flag
         port = int(flag("telemetry_prometheus_port"))
         if port <= 0:
             return None
-    return MetricsServer(registry, port=max(port, 0))
+    return MetricsServer(registry, port=max(port, 0), health_fn=health_fn)

@@ -128,6 +128,14 @@ _SLOW_TESTS = {
     "test_elastic_hybrid_fp8_carries_rescaled",
     "test_two_process_elastic_restart",
     "test_reshard_1b_checkpoint_throughput",
+    # round 8: serving-resilience heavies — the ragged kill-and-replay
+    # spawn (3 fresh processes each recompiling the interpret-mode
+    # unified program; the two-program spawn stays fast-tier) and the
+    # wall-clock overload/SLO acceptance (open-loop arrival schedule,
+    # ~30 s of timed waves). The fast tier keeps the deterministic
+    # deadline/shed/preempt/replay coverage on both engine paths.
+    "test_spawned_kill_and_replay_ragged",
+    "test_overload_shedding_preserves_admitted_slo",
 }
 
 

@@ -338,10 +338,22 @@ def test_blocks_freed_and_reused_after_finish(params):
 
 
 def test_request_larger_than_pool_refused(params):
+    """never-fits on the ragged path: rejected per-request (naming the
+    pool cap in Request.error), sibling completes in the same run
+    (ISSUE 13 satellite)."""
+    rng = np.random.RandomState(22)
+    sib = rng.randint(0, CFG.vocab_size, (8,))
     eng = mk(params, ragged=True, num_blocks=3, max_blocks_per_seq=8)
-    eng.add_request(np.zeros(20, np.int32), 10)  # needs 4 > 2 usable
-    with pytest.raises(ValueError, match="blocks"):
-        eng.run()
+    bad = eng.add_request(np.zeros(20, np.int32), 10)  # needs 4 > 2 usable
+    good = eng.add_request(sib, 4)                     # needs 2: fits
+    reported = {}
+    while eng.has_work():
+        for r in eng.step():
+            reported[r.rid] = r
+    bad_r, good_r = reported[bad], reported[good]
+    assert bad_r.status == "failed" and "pool capacity" in bad_r.error
+    assert good_r.status == "ok"
+    assert good_r.output == golden(params, sib, 4)
 
 
 # ---------------------------------------------------------------------------

@@ -90,12 +90,21 @@ def test_eos_stops_early(params):
     assert res[rid] == g[:4]
 
 
-def test_oversized_request_rejected(params):
+def test_oversized_request_rejected_per_request(params):
+    """A request that can NEVER fit is rejected per-request
+    (status='failed', naming the binding cap) — it must not abort the
+    engine step and strand its queued siblings (ISSUE 13 satellite)."""
+    rng = np.random.RandomState(21)
+    sib = rng.randint(0, CFG.vocab_size, (9,))
     eng = ServingEngine(params, CFG, max_batch=1, block_size=8,
                         num_blocks=16, max_blocks_per_seq=2, chunk=8)
-    eng.add_request(np.zeros(20, np.int32), 10)
-    with pytest.raises(ValueError, match="blocks"):
-        eng.run()
+    bad = eng.add_request(np.zeros(20, np.int32), 10)
+    good = eng.add_request(sib, 5)
+    res = eng.run()
+    assert res.statuses[bad] == "failed"
+    assert res[bad] == []
+    assert res.statuses[good] == "ok"
+    assert res[good] == golden(params, sib, 5)  # sibling unharmed
 
 
 def test_tp_sharded_decode_matches_generate(params):
