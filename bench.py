@@ -664,6 +664,98 @@ def _run_telemetry_config(jax, paddle, G, conf, iters,
     return report
 
 
+def _run_zero_stages_config(jax, paddle, G, conf, iters):
+    """ZeRO stage axis (FLAGS_zero_stage): per-stage hybrid step time on
+    the dp4 x mp2 smoke mesh, the spec-derived per-chip params/opt bytes
+    (grads are transient in the fused program; stage 2's dp-sharded
+    accounting shows up in the planner's HBM rule), and the analytic
+    per-step zero3 param-AG wire bytes fp32 vs int8 — the structural
+    unlock this section tracks is params/chip scaling ~1/dp at rest."""
+    import time
+
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.hbm_audit import per_device_bytes
+    from paddle_tpu.models.hybrid_engine import zero_dims
+    from paddle_tpu.observability.metrics import zero3_ag_wire_bytes
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    batch, seq = conf["batch"], conf["seq"]
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=conf["max_seq_len"],
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    mesh = dist.build_mesh({"dp": 4, "pp": 1, "mp": 2})
+    params0 = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    pshape = jax.eval_shape(
+        lambda: G.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    out = {"config_hash": _config_hash(conf),
+           "mesh": {"dp": 4, "mp": 2}, "stages": {}}
+    losses = {}
+    for stage in (0, 1, 2, 3):
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        step, shard_params, init_state = G.build_hybrid_train_step(
+            cfg, mesh, opt, num_microbatches=1, telemetry=None,
+            zero_stage=stage)
+        p = shard_params(params0)
+        s = init_state(p)
+        p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-3))
+        float(loss)  # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-3))
+        losses[stage] = float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        param_b = per_device_bytes(pshape, init_state.param_specs, mesh)
+        sshape = jax.eval_shape(opt.init_state, pshape)
+        opt_b = per_device_bytes(sshape, init_state.state_specs, mesh)
+        out["stages"][f"zero{stage}"] = {
+            "step_ms": round(dt * 1e3, 2),
+            "per_chip_param_bytes": int(param_b),
+            "per_chip_opt_bytes": int(opt_b),
+        }
+    # stage-3 parity gate: the bench never reports a broken program
+    assert abs(losses[3] - losses[0]) < 5e-4 * max(abs(losses[0]), 1), \
+        losses
+    r0 = out["stages"]["zero0"]
+    r3 = out["stages"]["zero3"]
+    out["param_bytes_ratio_zero3_vs_plain"] = round(
+        r3["per_chip_param_bytes"] / r0["per_chip_param_bytes"], 4)
+
+    # analytic per-step zero3 AG wire, fp vs int8 (the EQuARX ~2x-vs-bf16
+    # operating point applied to the param gather)
+    specs = G.hybrid_param_specs(cfg)
+    zd = zero_dims(specs, pshape, mesh, "dp")
+    item = jnp.dtype(cfg.param_dtype).itemsize
+    # the ONE shard-product rule (hbm_audit) applied per dp-shardable
+    # leaf: bytes local to the mp/pp shards, full over dp
+    blk = sum(per_device_bytes(l, sp, mesh)
+              for l, sp, z in zip(jax.tree.leaves(pshape["blocks"]),
+                                  jax.tree.leaves(specs["blocks"]),
+                                  jax.tree.leaves(zd["blocks"]))
+              if z >= 0)
+    other = sum(per_device_bytes(pshape[k], specs[k], mesh)
+                for k in ("wte", "wpe", "lnf_g", "lnf_b", "head_w")
+                if zd[k] >= 0)
+    out["zero3_ag_wire_bytes_per_step"] = {
+        "fp": int(zero3_ag_wire_bytes(4, block_param_bytes=blk,
+                                      n_stage_executions=1.0,
+                                      other_param_bytes=other)),
+        "int8": int(zero3_ag_wire_bytes(4, block_param_bytes=blk,
+                                        n_stage_executions=1.0,
+                                        other_param_bytes=other,
+                                        quantize=True,
+                                        param_itemsize=item)),
+    }
+    return out
+
+
 def _run_planner_config(jax, G, conf):
     """Auto-parallel planner end-to-end (distributed.auto_tuner): plan the
     bench shape over the local mesh, then run a 4-point measured sweep —
@@ -970,6 +1062,12 @@ def main():
     # the analytic dispatch-flop delta and a2a wire bytes
     moe_conf = dict(SECONDARY) if on_tpu else dict(overlap_conf)
     out["moe"] = _run_moe_config(jax, paddle, G, moe_conf, overlap_iters)
+    # ZeRO stage axis (FLAGS_zero_stage): per-stage hybrid step time,
+    # per-chip param/opt bytes (stage 3 params scale ~1/dp at rest) and
+    # the analytic zero3 param-AG wire fp32 vs int8
+    out["zero_stages"] = _run_zero_stages_config(
+        jax, paddle, G, dict(SECONDARY) if on_tpu else dict(overlap_conf),
+        overlap_iters)
     # step accounting (observability.StepTimer): compile/steady split,
     # data-vs-step phase breakdown, analytic-FLOPs MFU and the measured
     # comms_fraction — where the step time goes, round over round

@@ -70,6 +70,11 @@ _HIDE_MP = {None: 0.2, "allreduce": 0.2,
 # hide under later backward compute (PR 2's measured win).
 _HIDE_DP_MONOLITHIC = 0.0
 _HIDE_DP_BUCKETED = 0.7
+# zero3 per-block param all-gather: the scan_gather prefetch issues block
+# i+1's transfer beside block i's GEMMs (the engine's default,
+# FLAGS_zero3_overlap_ag) — most of the wire hides; without the prefetch
+# the gather sits at the top of each block's critical path.
+_HIDE_DP_ZERO3_AG = {True: 0.8, False: 0.3}
 # ep all-to-alls: chunk-overlapped exchange (FLAGS_moe_overlap) hides
 # chunk j+1's transfer behind chunk j's expert GEMM.
 _HIDE_EP = {False: 0.1, True: 0.6}
@@ -78,8 +83,8 @@ _HIDE_PP = 0.0  # pipeline ppermutes sit on the critical path
 # the hide-override vocabulary a measured profile may carry (profile
 # capture labels its windows with these; CostModel consults them)
 HIDE_KEYS = ("mp:allreduce", "mp:seq_parallel", "mp:collective_matmul",
-             "dp:monolithic", "dp:bucketed", "ep:plain", "ep:overlap",
-             "pp")
+             "dp:monolithic", "dp:bucketed", "dp:zero3_ag", "ep:plain",
+             "ep:overlap", "pp")
 
 
 # ---------------------------------------------------------------------------
@@ -219,7 +224,13 @@ class PlanCandidate:
     vpp: int = 1
     schedule: str = "1f1b"
     micro_batches: int = 1
-    zero1: bool = False
+    # ZeRO stage over dp (replaces the old boolean `zero1`): 0 off;
+    # 1 dp-sharded optimizer state; 2 additionally accounts the grad
+    # buffer dp-sharded (same collectives as 1 in the fused engine — an
+    # HBM-rule axis); 3 params dp-sharded at rest, per-block all-gather
+    # on use (the exposed-comm term below prices that AG, discounted by
+    # the scan_gather prefetch's hidable fraction)
+    zero_stage: int = 0
     remat: str = "full"
     fp8: bool = False
     comm_bucket_mb: float = 0.0
@@ -263,12 +274,19 @@ class PlanCandidate:
         kw: Dict[str, Any] = {
             "num_microbatches": self.micro_batches,
             "virtual_pp": self.vpp,
-            "zero1_dp": self.zero1,
+            "zero_stage": int(self.zero_stage),
             "fp8": bool(self.fp8),
             "telemetry": None,
             "mp_overlap": self.mp_overlap,
             "flash_attention": bool(self.flash_attention),
         }
+        if self.zero_stage >= 3:
+            # pin the gather knobs the cost model scored (prefetch on,
+            # unquantized) so a plan is reproducible regardless of
+            # ambient FLAGS_zero3_* — the same explicitness rule as
+            # every other kwarg here (both builders accept zero3=)
+            from ..comm_overlap import Zero3Config
+            kw["zero3"] = Zero3Config()
         if family == "gpt":
             from ..comm_overlap import CommOverlapConfig, MoeDispatchConfig
             kw["schedule"] = "ZBH1" if self.schedule == "zbh1" else "1F1B"
@@ -303,8 +321,8 @@ class PlanCandidate:
         if self.vpp > 1:
             s += f"v{self.vpp}"
         s += f" M{self.micro_batches}"
-        if self.zero1:
-            s += " zero1"
+        if self.zero_stage:
+            s += f" zero{self.zero_stage}"
         if self.fp8:
             s += " fp8"
         if self.comm_bucket_mb > 0:
@@ -415,9 +433,11 @@ def _shard_product(spec_axes, sizes: Dict[str, int]) -> int:
 
 
 def _leaf_dp_shardable(shape, spec_axes, dp: int) -> bool:
-    """Mirror of hybrid_engine._zero1_dims: the first dim with no mesh
-    axis whose extent divides dp (and is >= dp) shards the optimizer
-    state over dp under zero1_dp."""
+    """Mirror of hybrid_engine.zero_dims (the ONE per-leaf
+    dp-shardability rule): the first dim with no mesh axis whose extent
+    divides dp (and is >= dp) shards the optimizer state (stage >= 1),
+    the grad buffer (stage >= 2) and the params themselves (stage 3)
+    over dp."""
     sharded_dims = {d for d, _ in spec_axes}
     for d, extent in enumerate(shape):
         if d in sharded_dims:
@@ -445,6 +465,13 @@ def check_candidate(c: PlanCandidate, spec: ModelSpec, *, world: int,
         return f"needs {c.world} devices, mesh has {world}"
     if c.schedule not in SCHEDULES:
         return f"unknown schedule {c.schedule!r}"
+    if c.zero_stage not in (0, 1, 2, 3):
+        return f"zero_stage must be 0/1/2/3, got {c.zero_stage}"
+    if c.zero_stage and c.dp <= 1:
+        # the engine degenerates fine at dp=1, but a size-1 shard axis
+        # buys nothing — pruning it keeps the ranked list free of
+        # score-tied duplicates (the launcher trial-runs only top-k)
+        return "zero_stage shards over dp: needs dp > 1"
     if c.mp_overlap not in MP_OVERLAP_MODES:
         return f"unknown mp_overlap mode {c.mp_overlap!r} " \
                f"(one of {MP_OVERLAP_MODES})"
@@ -528,7 +555,13 @@ def generate_plan_candidates(
         micro_batch_options: Sequence[int] = (1, 2, 4, 8),
         schedules: Sequence[str] = SCHEDULES,
         vpp_options: Sequence[int] = (1, 2),
-        zero1_options: Sequence[bool] = (False, True),
+        # stage 1 is deliberately absent from the default enumeration:
+        # stages 1 and 2 compile the SAME program (tier-1 asserted) and
+        # score identically, but stage 2's HBM accounting dominates —
+        # enumerating both just fills the ranked list with score-tied
+        # twins that burn launcher trial slots. Stage 1 remains fully
+        # constructible/checkable for explicit candidates.
+        zero_stage_options: Sequence[int] = (0, 2, 3),
         fp8_options: Sequence[bool] = (False,),
         comm_bucket_options: Sequence[float] = (0.0, 4.0),
         mp_overlap_options: Sequence[Optional[str]] = MP_OVERLAP_MODES,
@@ -563,9 +596,9 @@ def generate_plan_candidates(
             rem = world // (ep * dp)
             for mp in _divisors(rem):
                 pp = rem // mp
-                for (M, sched, vpp, z1, f8, bkt, mpo, fl, moe) in \
+                for (M, sched, vpp, zs, f8, bkt, mpo, fl, moe) in \
                         itertools.product(micro_batch_options, schedules,
-                                          vpp_options, zero1_options,
+                                          vpp_options, zero_stage_options,
                                           fp8_options, comm_bucket_options,
                                           mp_overlap_options, flash_options,
                                           moe_variants):
@@ -573,7 +606,7 @@ def generate_plan_candidates(
                         continue  # structural, not worth a prune record
                     c = PlanCandidate(
                         dp=dp, mp=mp, pp=pp, ep=ep, vpp=vpp,
-                        schedule=sched, micro_batches=M, zero1=z1,
+                        schedule=sched, micro_batches=M, zero_stage=zs,
                         fp8=f8, comm_bucket_mb=bkt, mp_overlap=mpo,
                         flash_attention=fl, **moe)
                     if c in seen:
@@ -753,7 +786,8 @@ class CostModel:
         a_blk = mb * self.S * sp.hidden * dt
         a_full = b_rank * self.S * sp.hidden * dt
         M, P, V = c.micro_batches, c.pp, c.vpp
-        out: Dict[str, float] = {"mp": 0.0, "dp": 0.0, "ep": 0.0, "pp": 0.0}
+        out: Dict[str, float] = {"mp": 0.0, "dp": 0.0, "ep": 0.0,
+                                 "pp": 0.0, "z3ag": 0.0}
         if c.mp > 1:
             if sp.moe_on:
                 n_pairs_local = (sp.layers // 2) / c.pp
@@ -780,8 +814,23 @@ class CostModel:
                                      + 4.0 * b_rank * self.S * 4),
                     scatter_bytes=a_full)
         if c.dp > 1:
-            grad_local = self._grad_local_bytes(c)
-            out["dp"] = 2.0 * (c.dp - 1) / c.dp * grad_local
+            if c.zero_stage >= 3:
+                # sharded leaves reduce inside the loss's AD transpose —
+                # their 2·AG + 1·RS are the z3ag term (the validated
+                # observability model); only the replicated-leaf grads
+                # still all-reduce on the classic dp path
+                from ...observability.metrics import zero3_ag_wire_bytes
+                blk, oth, repl, _ = self._z3_leaf_split(c)
+                out["dp"] = 2.0 * (c.dp - 1) / c.dp * repl
+                out["z3ag"] = zero3_ag_wire_bytes(
+                    c.dp, block_param_bytes=blk / V,
+                    n_stage_executions=self._ticks(c),
+                    other_param_bytes=oth, quantize=False)
+            else:
+                # stages 0/1/2 move the same dp bytes: one all-reduce, or
+                # the RS + closing param AG pair (each f·G)
+                grad_local = self._grad_local_bytes(c)
+                out["dp"] = 2.0 * (c.dp - 1) / c.dp * grad_local
         if c.ep > 1:
             from ...incubate.distributed.models.moe.gate import \
                 compute_capacity
@@ -805,6 +854,30 @@ class CostModel:
             total += n * item / _shard_product(spec_axes, sizes)
         return total
 
+    def _z3_leaf_split(self, c: PlanCandidate):
+        """(block_bytes, other_bytes, repl_bytes, per_layer_bytes) for the
+        zero3 wire/HBM model — param bytes LOCAL to the mp/pp/ep shards,
+        full over dp. `block` = dp-shardable stacked pipeline leaves
+        (spec carries 'pp'): gathered per layer per tick. `other` =
+        dp-shardable once-per-step leaves (embeddings/head/final LN).
+        `repl` = leaves with no dp-shardable dim (stay replicated; their
+        grads still pmean). per_layer_bytes = one LOCAL layer's gathered
+        block params (the stage-3 live-working-set unit: the scan carry
+        holds ~2 of these under the prefetch)."""
+        sizes = c.mesh_dims()
+        blk = oth = repl = per_layer = 0.0
+        for n, item, spec_axes, shape in self.spec.leaves:
+            local = n * item / _shard_product(spec_axes, sizes)
+            if not _leaf_dp_shardable(shape, spec_axes, c.dp):
+                repl += local
+                continue
+            if any("pp" in axes for _, axes in spec_axes):
+                blk += local
+                per_layer += local * c.pp / max(shape[0], 1)
+            else:
+                oth += local
+        return blk, oth, repl, per_layer
+
     def _hide(self, key: str, table: float) -> float:
         """Hidable fraction for one wire term: a measured override in the
         profile's ``hide`` dict wins outright (it came from attributing a
@@ -827,6 +900,12 @@ class CostModel:
             "dp": (self._hide("dp:bucketed", _HIDE_DP_BUCKETED)
                    if c.comm_bucket_mb > 0
                    else self._hide("dp:monolithic", _HIDE_DP_MONOLITHIC)),
+            # engine_kwargs PINS the prefetched unquantized gather
+            # (Zero3Config()), so candidates are scored with the
+            # overlapped fraction they will actually run; the [False]
+            # table entry prices a hand-built no-prefetch engine, and a
+            # measured dp:zero3_ag profile override wins over both
+            "z3ag": self._hide("dp:zero3_ag", _HIDE_DP_ZERO3_AG[True]),
             "ep": self._hide("ep:overlap" if c.moe_overlap else "ep:plain",
                              _HIDE_EP[bool(c.moe_overlap)]),
             "pp": self._hide("pp", _HIDE_PP),
@@ -838,7 +917,7 @@ class CostModel:
         bw = self.profile.ici_gbs * 1e9
         hide = self.hide_fractions(c)
         exp = {ax: wire[ax] / bw * (1 - hide[ax])
-               for ax in ("mp", "dp", "ep", "pp")}
+               for ax in ("mp", "dp", "ep", "pp", "z3ag")}
         return sum(exp.values()), wire
 
     # -- (c) collective dispatch count --------------------------------------
@@ -863,7 +942,24 @@ class CostModel:
                     / (c.comm_bucket_mb * (1 << 20))))
             else:
                 n_buckets = 1.0  # XLA fuses the monolithic pmean
-            n += n_buckets * (2 if c.zero1 else 1)
+            if c.zero_stage >= 3:
+                # replicated-leaf pmean only; the sharded leaves' AG/RS
+                # execute per (tick, layer, leaf kind): 2 gathers (fwd +
+                # remat replay) + 1 cotangent reduce-scatter each, plus
+                # the once-per-step pairs for embeddings/head
+                n += n_buckets
+                sizes = c.mesh_dims()
+                for _, _, spec_axes, shape in sp.leaves:
+                    if not _leaf_dp_shardable(shape, spec_axes, c.dp):
+                        continue
+                    if any("pp" in axes for _, axes in spec_axes):
+                        layers_exec = self._ticks(c) * shape[0] \
+                            / (c.pp * c.vpp)
+                        n += 3.0 * layers_exec
+                    else:
+                        n += 2.0
+            else:
+                n += n_buckets * (2 if c.zero_stage else 1)
         if c.pp > 1:
             n += 2.0 * self._ticks(c)
         if c.ep > 1:
@@ -887,10 +983,17 @@ class CostModel:
         params = grads = opt = 0.0
         for n, item, spec_axes, shape in sp.leaves:
             local = n / _shard_product(spec_axes, sizes)
-            params += local * item
-            grads += local * item
+            shardable = _leaf_dp_shardable(shape, spec_axes, c.dp)
+            pb = local * item
+            # the zero stage axis: stage >= 1 shards the slots, stage
+            # >= 2 the grad buffer, stage 3 the resident params (each by
+            # the SAME per-leaf rule the engine's zero_dims applies)
+            params += pb / c.dp if (c.zero_stage >= 3 and shardable) \
+                else pb
+            grads += pb / c.dp if (c.zero_stage >= 2 and shardable) \
+                else pb
             slot = local * moment_itemsize * optimizer_slots
-            if c.zero1 and _leaf_dp_shardable(shape, spec_axes, c.dp):
+            if c.zero_stage >= 1 and shardable:
                 slot /= c.dp
             opt += slot
         dt = sp.act_itemsize
@@ -916,6 +1019,11 @@ class CostModel:
             C = compute_capacity(mb * self.S, E, 1,
                                  sp.cfg.moe_capacity_factor)
             act += 4.0 * E * C * H * dt                    # a2a buffers
+        if c.zero_stage >= 3:
+            # stage-3 live working set: the scan carry holds the current
+            # block's gathered params plus the prefetched next block's
+            _, _, _, per_layer = self._z3_leaf_split(c)
+            act += 2.0 * per_layer
         parts = {"params": params, "grads": grads, "opt": opt, "act": act}
         return 1.10 * sum(parts.values()), parts
 

@@ -74,7 +74,9 @@ class SavedLayout:
     # number of saving processes (files 0_0.distcp .. n-1_0.distcp)
     process_count: int = 1
     # caller hints: {"pp": {...}, "comm_plan": {...}, "carries": {...},
-    # "zero1": bool, ...}
+    # "zero1": bool, "zero_stage": int (ZeRO stage 0-3 of the saving
+    # build; stage-3 checkpoints hold dp-sharded params whose chunks
+    # reassemble onto any stage), ...}
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -56,6 +56,11 @@ logger = logging.getLogger("paddle_tpu")
 # path-component name -> remap policy (see module docstring)
 DEFAULT_CARRY_POLICIES: Dict[str, str] = {
     "comm_ef": "reset_on_mismatch",
+    "moe_ef": "reset_on_mismatch",
+    # ZeRO-3 int8-AG error-feedback residuals: each dp rank's rounding
+    # error for ITS param shard — a mesh/stage change reassigns shards,
+    # so they reset with the comm_ef discipline (JSONL event included)
+    "zero3_ef": "reset_on_mismatch",
     "telemetry": "reinit",
     "fp8_meta": "follow",
 }
@@ -161,6 +166,17 @@ def layout_mismatch(md: Metadata, state_dict: Dict,
             layout_extra is not None and "zero1" in layout_extra):
         reasons["zero1"] = {"saved": saved.extra.get("zero1"),
                             "target": layout_extra.get("zero1")}
+    # stage axis (PR 14): zero{1,2,3} on<->off and cross-stage resumes
+    # all reshard through the chunk index — stage 3's dp-sharded params
+    # reassemble from their shard chunks exactly like the zero1 moments.
+    # Only flag a reason when BOTH sides recorded a stage (old
+    # checkpoints/templates predate the field) and they differ.
+    src_zs = saved.extra.get("zero_stage")
+    dst_zs = (layout_extra or {}).get("zero_stage")
+    if (src_zs is not None and dst_zs is not None
+            and int(src_zs) != int(dst_zs)):
+        reasons["zero_stage"] = {"saved": int(src_zs),
+                                 "target": int(dst_zs)}
     return reasons or None
 
 

@@ -43,6 +43,9 @@ from .overlap import (CommOverlapConfig, config_from_flags,  # noqa: F401
                       reduce_scatter_tree)
 from .quantize import (dequantize_int8, ef_quantized_psum,  # noqa: F401
                        quantize_int8)
+from .zero3 import (Zero3Config, all_gather_param,  # noqa: F401
+                    ef_quantized_all_gather, gather_tree, resolve_zero3,
+                    resolve_zero_stage, scan_gather, zero3_from_flags)
 from .xla_flags import (OVERLAP_XLA_FLAGS,  # noqa: F401
                         apply_xla_overlap_flags)
 
@@ -59,6 +62,9 @@ __all__ = [
     "scatter_seq",
     "MoeDispatchConfig", "moe_dispatch_from_flags", "resolve_moe_dispatch",
     "expert_exchange", "qa2a_scatter", "qa2a_gather", "moe_ef_local_shapes",
+    "Zero3Config", "zero3_from_flags", "resolve_zero3",
+    "resolve_zero_stage", "all_gather_param",
+    "ef_quantized_all_gather", "gather_tree", "scan_gather",
 ]
 
 

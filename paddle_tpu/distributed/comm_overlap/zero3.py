@@ -1,0 +1,270 @@
+"""ZeRO-3 param-gather primitives: dp-sharded params gathered on use.
+
+The hybrid engine's ZeRO stage 3 keeps every dp-shardable parameter leaf
+RESIDENT as a 1/dp shard (its PartitionSpec grows the dp axis on the
+``zero_dims`` dim) and materializes the full leaf only at its use site
+inside the loss:
+
+* :func:`all_gather_param` — one ``lax.all_gather(tiled=True)`` whose AD
+  transpose is ``psum_scatter``: the backward delivers each rank's
+  gradient SHARD already dp-summed, so the engine's stage-3 update never
+  re-forms (or re-reduces) a full gradient;
+* :func:`scan_gather` — the layer scan with gather-on-use: block i+1's
+  all-gather is issued beside block i's compute (the PR 5 ring / PR 8
+  chunked-scan discipline applied to the param AG), and the gathered
+  params live in the scan CARRY so at most one block's full params are
+  alive per stage. Because the pipeline checkpoints each stage body, the
+  backward replays the gathers instead of saving full params;
+* :func:`ef_quantized_all_gather` — optional int8 wire format for the
+  param AG (EQuARX, arXiv:2506.17615 — ~2x effective bandwidth): each
+  rank quantizes its (residual-corrected) shard onto a per-shard scale
+  grid, int8 codes + fp32 scales travel, destinations dequantize each
+  arriving shard with its SOURCE's scale, and the rounding error stays
+  on the owner as an error-feedback residual (the quantize.py
+  vocabulary). The backward cotangent reduce-scatters in FULL precision
+  — weights travel quantized, gradients do not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .quantize import dequantize_int8, quantize_int8
+
+__all__ = ["Zero3Config", "zero3_from_flags", "resolve_zero3",
+           "resolve_zero_stage", "all_gather_param",
+           "ef_quantized_all_gather", "scan_gather", "gather_tree"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3Config:
+    """Resolved ZeRO-3 gather behavior for the hybrid engines.
+
+    overlap: prefetch — inside the layer scan, issue block i+1's
+        all-gather next to block i's compute (carried gathered params;
+        the latency-hiding scheduler overlaps the transfer). Off: gather
+        in the scan body right before use.
+    quantize: int8 error-feedback wire format for the BLOCK param
+        all-gathers (embeddings / LM head / final-LN leaves stay full
+        precision — they are used once per step and are the
+        precision-sensitive ends of the model). Residual state rides
+        ``opt_state["zero3_ef"]``; pp degree 1 / one pipeline microbatch
+        only (one residual slot per step), not composed with fp8 or
+        comm_overlap (both already own the loss arity/accumulation).
+    ef: error feedback for the quantized gather. False is the ablation
+        arm of the EF-beats-no-EF test — never flag-reachable.
+    """
+    overlap: bool = True
+    quantize: bool = False
+    ef: bool = True
+
+    def meta(self):
+        return {"overlap": bool(self.overlap),
+                "quantize": bool(self.quantize), "ef": bool(self.ef)}
+
+
+def zero3_from_flags() -> Zero3Config:
+    from ...flags import flag
+    return Zero3Config(overlap=bool(flag("zero3_overlap_ag")),
+                       quantize=bool(flag("zero3_quantize_ag")))
+
+
+def resolve_zero3(arg) -> Zero3Config:
+    """ONE resolution of a model builder's zero3= argument. "auto" reads
+    FLAGS_zero3_overlap_ag / FLAGS_zero3_quantize_ag; None = defaults; a
+    Zero3Config forces."""
+    if arg == "auto":
+        return zero3_from_flags()
+    if arg is None:
+        return Zero3Config()
+    return arg
+
+
+def resolve_zero_stage(zero_stage, zero1_dp: bool = False, *,
+                       op: str = "build_hybrid_train_step") -> int:
+    """ONE resolution of a model builder's zero_stage= argument (shared
+    by the gpt and llama builders): "auto" reads FLAGS_zero_stage, None
+    means 0, and the legacy ``zero1_dp=True`` spelling maps to stage 1 —
+    refusing a conflicting explicit stage."""
+    stage = zero_stage
+    if stage == "auto":
+        from ...flags import flag
+        stage = int(flag("zero_stage"))
+    stage = 0 if stage is None else int(stage)
+    if zero1_dp:
+        from ...enforce import enforce
+        enforce(stage in (0, 1),
+                "zero1_dp is the legacy spelling of zero_stage=1 — do not "
+                "combine it with a different explicit stage", op=op,
+                zero_stage=stage)
+        stage = 1
+    return stage
+
+
+def all_gather_param(x: jax.Array, dim: int, axis) -> jax.Array:
+    """Full leaf from this rank's dp shard (differentiable: the transpose
+    is ``psum_scatter`` over `axis` on `dim` — grads arrive dp-SUMMED at
+    the shard; the engine folds the 1/dp of the loss mean)."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# int8 error-feedback quantized all-gather (straight-through backward)
+# ---------------------------------------------------------------------------
+def _qag_fwd_impl(x, res, dim, axis):
+    n = lax.axis_size(axis)
+    xr = x.astype(jnp.float32) + res.astype(jnp.float32)
+    # PER-SHARD scale: an all-gather only concatenates (codes are never
+    # summed), so each destination can dequantize each arriving shard
+    # with its source's own grid — one fp32 scalar per rank on the wire
+    scale = jnp.maximum(jnp.max(jnp.abs(xr)),
+                        jnp.finfo(jnp.float32).tiny) / 127.0
+    q = quantize_int8(xr, scale)
+    new_res = xr - dequantize_int8(q, scale)
+    qg = lax.all_gather(q, axis, tiled=False)        # [n, *shard]
+    sg = lax.all_gather(scale, axis, tiled=False)    # [n]
+    full = qg.astype(jnp.float32) * sg.reshape((n,) + (1,) * x.ndim)
+    # [n, ...] -> concatenated along `dim` (the tiled layout)
+    full = jnp.moveaxis(full, 0, dim)
+    shp = list(x.shape)
+    shp[dim] = shp[dim] * n
+    return full.reshape(shp).astype(x.dtype), new_res.astype(res.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ef_quantized_all_gather(x, res, dim, axis):
+    """(full_leaf ~ all_gather(x + res), new_residual). int8 codes + one
+    fp32 scale per source shard on the wire; the cotangent of the full
+    leaf reduce-scatters in full precision exactly like the unquantized
+    gather's transpose (straight-through), and the residual path carries
+    no gradient (it is forward-side EF state)."""
+    return _qag_fwd_impl(x, res, dim, axis)
+
+
+def _qag_fwd(x, res, dim, axis):
+    return _qag_fwd_impl(x, res, dim, axis), None
+
+
+def _qag_bwd(dim, axis, _saved, ct):
+    ct_full, ct_res = ct
+    g = lax.psum_scatter(ct_full, axis, scatter_dimension=dim, tiled=True)
+    return g.astype(ct_full.dtype), jnp.zeros_like(ct_res)
+
+
+ef_quantized_all_gather.defvjp(_qag_fwd, _qag_bwd)
+
+
+def _gather_leaf(x, zd, axis, *, res=None, ef=True):
+    """One leaf's gather on its PER-LAYER shard: `zd` is the STACKED
+    zero_dims index (>= 1: gather dim zd-1 of the layer slice; < 0:
+    replicated leaf, pass through). Returns (full, new_res)."""
+    if zd < 0 or (hasattr(x, "shape") and x.ndim > 0 and x.size == 0):
+        return x, res
+    if res is not None:
+        if ef:
+            return ef_quantized_all_gather(x, res, zd - 1, axis)
+        full, _ = ef_quantized_all_gather(x, jnp.zeros_like(res), zd - 1,
+                                          axis)
+        return full, jnp.zeros_like(res)
+    return all_gather_param(x, zd - 1, axis), None
+
+
+def gather_tree(shards, zdims, axis):
+    """Plain (unquantized) gather of one LAYER's param subtree: `shards`
+    holds per-layer slices of the stacked block leaves, `zdims` the
+    matching STACKED zero_dims (computed on the ``[L, ...]`` shapes, so
+    each slice gathers dim ``zd - 1``; zd < 1 leaves pass through)."""
+    def one(x, zd):
+        if zd < 1:
+            return x
+        return all_gather_param(x, zd - 1, axis)
+    return jax.tree.map(one, shards, zdims)
+
+
+def scan_gather(fn, carry, stacked, zdims, axis, *,
+                extras=(), cfg: Optional[Zero3Config] = None,
+                residuals=None):
+    """Layer scan with ZeRO-3 gather-on-use.
+
+    fn(p_full, carry, *extra_layer) -> (new_carry, y). `stacked` is the
+    pytree of stacked ``[L_local, ...]`` dp-SHARDED leaves; `zdims` the
+    matching STACKED zero_dims tree (>= 1 leaves gather dim zd-1 of each
+    layer slice over `axis`, -1 leaves pass through); `extras` are
+    additional per-layer scanned trees (fp8 scale stacks, MoE EF slices)
+    handed to fn un-gathered.
+
+    With cfg.overlap (and no quantization) the gathered params ride the
+    scan CARRY: iteration i computes block i from the carried full params
+    while issuing block i+1's all-gather — the transfers hide under the
+    block GEMMs, the last layer runs outside the scan so no gather is
+    wasted, and live full params stay O(1 block).
+
+    cfg.quantize threads `residuals` (stacked like `stacked`, fp32; 0-col
+    leaves mark not-quantized) through the int8-EF gather and returns the
+    refreshed stack as the 3rd element; the quantized form gathers in the
+    body (the residual update orders the scan, so prefetch would tangle
+    the carry) — the wire is ~2x cheaper instead.
+
+    Returns (carry, ys, new_residuals)."""
+    cfg = cfg if cfg is not None else Zero3Config()
+    L = jax.tree.leaves(stacked)[0].shape[0]
+
+    if cfg.quantize:
+        def body(c, xs):
+            pl, rl, ex = xs
+            full_res = jax.tree.map(
+                lambda x, zd, r: _gather_leaf(x, zd, axis, res=r,
+                                              ef=cfg.ef),
+                pl, zdims, rl)
+            p_full = jax.tree.map(lambda t: t[0], full_res,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+            new_r = jax.tree.map(lambda t: t[1], full_res,
+                                 is_leaf=lambda t: isinstance(t, tuple))
+            c2, y = fn(p_full, c, *ex)
+            return c2, (y, new_r)
+        carry, (ys, new_res) = lax.scan(body, carry,
+                                        (stacked, residuals, extras))
+        return carry, ys, new_res
+
+    gather = lambda pl: gather_tree(pl, zdims, axis)
+
+    if not cfg.overlap or L == 1:
+        def body(c, xs):
+            pl, ex = xs
+            c2, y = fn(gather(pl), c, *ex)
+            return c2, y
+        carry, ys = lax.scan(body, carry, (stacked, extras))
+        return carry, ys, None
+
+    # prefetch: carry block i's FULL params, issue block i+1's gather
+    # beside block i's compute; the final layer runs outside the scan so
+    # the last gather is never wasted
+    first = jax.tree.map(lambda a: a[0], stacked)
+    rest = jax.tree.map(lambda a: a[1:], stacked)
+    ex_head = jax.tree.map(lambda a: a[:-1], extras)
+    ex_last = jax.tree.map(lambda a: a[-1], extras)
+
+    def body(c, xs):
+        h, p_full = c
+        nxt_sh, ex = xs
+        p_next = gather(nxt_sh)  # independent of fn -> overlappable
+        h2, y = fn(p_full, h, *ex)
+        return (h2, p_next), y
+
+    (carry, p_last), ys = lax.scan(body, (carry, gather(first)),
+                                   (rest, ex_head))
+    carry, y_last = fn(p_last, carry, *ex_last)
+    return carry, _append_y(ys, y_last), None
+
+
+def _append_y(ys, y_last):
+    if y_last is None and ys is None:
+        return None
+    return jax.tree.map(lambda s, l: jnp.concatenate([s, l[None]], axis=0),
+                        ys, y_last)

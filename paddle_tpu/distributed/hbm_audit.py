@@ -65,7 +65,8 @@ def _mem_stats(compiled) -> Dict[str, int]:
 def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
                          microbatches: int = 2,
                          moment_dtype=jnp.bfloat16,
-                         zero1_dp: bool = False) -> Dict[str, Any]:
+                         zero1_dp: bool = False,
+                         zero_stage: int = None) -> Dict[str, Any]:
     """Compile the full dp x pp x mp hybrid train step (1F1B pipeline,
     vocab-parallel CE, dp grad pmean, fused AdamW update) at the REAL
     GPT-3 6.7B shape (H=4096, L=32, heads=32, vocab 50304) and return
@@ -73,7 +74,9 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
 
     Asserts the spec-derived per-device param bytes against the analytic
     expectation: matrix params shard over pp x mp; embeddings shard over
-    mp (vocab-parallel) but not pp; LN vectors replicate.
+    mp (vocab-parallel) but not pp; LN vectors replicate (under
+    zero_stage=3 the dp-shardable leaves additionally divide by dp —
+    the zero_param_specs rule).
     """
     import time
 
@@ -81,18 +84,23 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
     from ..models import gpt as G
     from ..models.hybrid_engine import state_specs_for
 
+    stage = (1 if zero1_dp else 0) if zero_stage is None else int(zero_stage)
     cfg = G.gpt_6p7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  moment_dtype=moment_dtype)
-    step, _, _ = G.build_hybrid_train_step(
-        cfg, mesh, opt, num_microbatches=microbatches, zero1_dp=zero1_dp)
+    step, _, init_state = G.build_hybrid_train_step(
+        cfg, mesh, opt, num_microbatches=microbatches, zero_stage=stage)
 
-    specs = G.hybrid_param_specs(cfg)
     pshape = jax.eval_shape(
         lambda: G.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
-    if zero1_dp:
-        from ..models.hybrid_engine import zero1_state_specs
-        _, sspec = zero1_state_specs(opt, specs, pshape, mesh, "dp")
+    # the engine's published AOT layout: param specs grow dp under
+    # stage 3, state specs grow dp under any stage (the ONE zero_dims
+    # rule) — reading them off init_state keeps this audit honest
+    specs = init_state.param_specs
+    if stage:
+        from ..models.hybrid_engine import zero_state_specs
+        base_specs = G.hybrid_param_specs(cfg)
+        _, sspec = zero_state_specs(opt, base_specs, pshape, mesh, "dp")
     else:
         sspec = state_specs_for(opt, specs, pshape)
     sshape = jax.eval_shape(opt.init_state, pshape)
@@ -130,10 +138,17 @@ def audit_hybrid_compile(mesh: Mesh, *, seq: int = 2048, batch: int = 4,
         + (6 * L * H) // pp
         + 2 * (V * H) // mp
         + cfg.max_seq_len * H + 2 * H)
+    if stage >= 3:
+        # every one of the leaves above has a dp-shardable free dim at
+        # the 6.7B shape, so resident params divide by dp exactly
+        expect = expect // mesh.shape["dp"]
     assert abs(param_b - expect) / expect < 0.001, (param_b, expect)
 
+    stage_note = {0: "", 1: " + zero1 dp-sharded state",
+                  2: " + zero2 dp-sharded state+grads",
+                  3: " + zero3 dp-sharded params"}[stage]
     out = {"config": "gpt3_6p7b H=4096 L=32 heads=32 vocab=50304"
-                     + (" + zero1 dp-sharded state" if zero1_dp else ""),
+                     + stage_note,
            "mesh": dict(mesh.shape), "seq": seq, "batch": batch,
            "microbatches": microbatches,
            "n_params": n_params,
@@ -168,7 +183,9 @@ def audit_plan_compile(cand, cfg, *, family: str = "gpt",
                             seq=seq)
     step, _, init_state = M.build_hybrid_train_step(cfg, mesh, opt, **kw)
 
-    specs = M.hybrid_param_specs(cfg)
+    # the engine's PUBLISHED layout, not the raw model table: under
+    # zero_stage=3 the param specs grow the dp axis (zero_param_specs)
+    specs = init_state.param_specs
     pshape = jax.eval_shape(
         lambda: M.init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     sshape = init_state.abstract(pshape)

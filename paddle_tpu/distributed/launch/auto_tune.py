@@ -5,7 +5,7 @@ with the winner).
 
 The candidate vocabulary is the auto_tuner planner's
 :class:`PlanCandidate` — the REAL hybrid-engine surface (dp/mp/pp/ep,
-schedule, vpp, micro_batches, zero1, comm_bucket_mb, mp_overlap, ...).
+schedule, vpp, micro_batches, zero_stage, comm_bucket_mb, mp_overlap, ...).
 With ``FLAGS_auto_parallel_plan`` (default on) and a model named in the
 tuner json, the analytic planner generates, HBM-prunes and RANKS the
 candidates first, so only the top ``FLAGS_auto_parallel_topk`` pay for a

@@ -142,7 +142,7 @@ def spawn_cluster(argv, nproc: int, devices_per_proc: int,
 def run_training(mesh, steps: int = 4, return_params: bool = False,
                  num_microbatches: int = 1, schedule: str = "1F1B",
                  zero1: bool = False, virtual_pp: int = 1,
-                 moe: bool = False):
+                 moe: bool = False, zero_stage: int = 0):
     """Seed-deterministic tiny-GPT hybrid train loop over `mesh` (axes dp /
     pp / mp, plus ep for the MoE leg); every process computes identical
     host inputs. The ONE copy of the parity workload — the launcher
@@ -172,14 +172,16 @@ def run_training(mesh, steps: int = 4, return_params: bool = False,
     # cross-process parity covers the whole round-5 stage-1 path
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-2,
-        grad_clip=(paddle.nn.ClipGradByGlobalNorm(0.5) if zero1 else None))
+        grad_clip=(paddle.nn.ClipGradByGlobalNorm(0.5)
+                   if (zero1 or zero_stage) else None))
     kw = {}
     if moe:
         from .comm_overlap import MoeDispatchConfig
         kw["moe_dispatch"] = MoeDispatchConfig(index=True)
+    stage = int(zero_stage) if zero_stage else (1 if zero1 else 0)
     step, shard_params, init_state = G.build_hybrid_train_step(
         cfg, mesh, opt, num_microbatches=num_microbatches,
-        schedule=schedule, zero1_dp=zero1, virtual_pp=virtual_pp, **kw)
+        schedule=schedule, zero_stage=stage, virtual_pp=virtual_pp, **kw)
     params = shard_params(params)
     state = init_state(params)
     rng = np.random.RandomState(0)
@@ -410,6 +412,11 @@ _MODES = {
     # grad reduce-scatter and param all-gather hops cross the boundary
     "z1dpmp": dict(dims=lambda n: {"dp": 2, "pp": 1, "mp": n // 2},
                    zero1=True),
+    # zero3 over the dp axis that SPANS the two processes: the per-block
+    # param all-gathers (and their reduce-scatter transposes) cross the
+    # boundary every layer of every step
+    "z3dpmp": dict(dims=lambda n: {"dp": 2, "pp": 1, "mp": n // 2},
+                   zero_stage=3),
     "pp1f1b": dict(dims=lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, m=4),
     "ppzbh1": dict(dims=lambda n: {"pp": 2, "dp": 1, "mp": n // 2}, m=4,
                    schedule="ZBH1"),
@@ -425,6 +432,7 @@ def _mode_training_kwargs(mode_cfg):
     return dict(num_microbatches=mode_cfg.get("m", 1),
                 schedule=mode_cfg.get("schedule", "1F1B"),
                 zero1=mode_cfg.get("zero1", False),
+                zero_stage=mode_cfg.get("zero_stage", 0),
                 virtual_pp=mode_cfg.get("vpp", 1),
                 moe=mode_cfg.get("moe", False))
 

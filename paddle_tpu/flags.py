@@ -437,6 +437,43 @@ define_flag("moe_overlap_chunks", 2,
             "Capacity-dim chunks for the overlapped MoE all-to-all "
             "(FLAGS_moe_overlap); must divide the per-microbatch expert "
             "capacity (consumed by comm_overlap.a2a).")
+define_flag("zero_stage", 0,
+            "ZeRO sharding stage over the hybrid engines' dp axis "
+            "(models gpt/llama build_hybrid_train_step(zero_stage="
+            "'auto')): 0 = off (replicated params/grads/opt, compiles "
+            "bitwise-identically to a build without the argument); "
+            "1 = dp-sharded optimizer state, grads reduce-scatter, each "
+            "rank updates its param shard and all-gathers (the "
+            "pre-existing zero1_dp); 2 = stage 1 with the gradient "
+            "reduce-scatter hoisted to the backward epilogue so the "
+            "scattered shards are the only dp-synchronized grad buffer "
+            "(in this one-program engine stages 1 and 2 issue the SAME "
+            "collectives — the stage exists for the planner's HBM model "
+            "and the checkpoint layout); 3 = params dp-sharded AT REST, "
+            "each block's leaves all-gathered on use inside the layer "
+            "scan (prefetched per FLAGS_zero3_overlap_ag) and re-gathered "
+            "by the backward's remat replay — live full params stay O(1 "
+            "block), params/grads/opt state all scale ~1/dp (consumed by "
+            "models.hybrid_engine.build_train_step).")
+define_flag("zero3_overlap_ag", True,
+            "Prefetch the ZeRO-3 param all-gather: inside the layer scan "
+            "block i+1's gather issues beside block i's compute (the "
+            "gathered params ride the scan carry), so the AG wire hides "
+            "under the block GEMMs. Off: gather in the body right before "
+            "use (consumed by comm_overlap.zero3.zero3_from_flags).")
+define_flag("zero3_quantize_ag", False,
+            "int8-quantize the ZeRO-3 BLOCK param all-gathers with error "
+            "feedback (EQuARX-style): each rank's shard travels as int8 "
+            "codes + one fp32 scale (~4x fewer fp32 wire bytes / ~2x vs "
+            "bf16), destinations dequantize with the source's grid, and "
+            "the rounding error rides opt_state['zero3_ef'] into the "
+            "next step's gather exactly as the dp-gradient residuals "
+            "ride opt_state['comm_ef']. Backward cotangent "
+            "reduce-scatters stay full precision; embeddings/LM head "
+            "stay unquantized. Requires zero_stage=3, pp degree 1, one "
+            "pipeline microbatch; not composed with fp8, comm_overlap or "
+            "moe_quantize_a2a (consumed by "
+            "comm_overlap.zero3.zero3_from_flags).")
 define_flag("mp_seq_parallel", False,
             "Megatron-style sequence parallelism on the tensor-parallel "
             "'mp' axis of the hybrid engines: between transformer blocks "

@@ -21,7 +21,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import shard_map as _shard_map
 
 __all__ = ["build_train_step", "state_specs_for",
-           "zero1_state_specs"]
+           "zero_dims", "zero_extend_spec", "zero_state_specs",
+           "zero_param_specs", "zero1_state_specs"]
 
 
 def state_specs_for(optimizer, specs, example_params=None):
@@ -67,10 +68,12 @@ def state_specs_for(optimizer, specs, example_params=None):
     return jax.tree_util.tree_map_with_path(spec_for, state_shape)
 
 
-def _zero1_dims(specs, example_params, mesh: Mesh, dp_axis: str):
-    """Per-param-leaf dim index to shard optimizer state (and the update)
-    over the dp axis — ZeRO stage 1 composed with the hybrid mesh
-    (reference: DygraphShardingOptimizer stage-1 partitioning,
+def zero_dims(specs, example_params, mesh: Mesh, dp_axis: str):
+    """Per-param-leaf dim index to shard over the dp axis — the ONE copy
+    of the per-leaf dp-shardability rule shared by every ZeRO stage
+    (stage 1/2: optimizer state + the update; stage 3: the params
+    themselves) and mirrored by the planner's HBM math (reference:
+    DygraphShardingOptimizer stage-1 partitioning,
     fleet/meta_parallel/dygraph_optimizer/dygraph_sharding_optimizer.py:44
     `_partition_parameters`, running under HybridParallelOptimizer).
     Picks the first dim with no existing mesh axis whose LOCAL extent
@@ -94,7 +97,7 @@ def _zero1_dims(specs, example_params, mesh: Mesh, dp_axis: str):
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def _zero1_extend_spec(spec: P, zd, dp_axis: str, ndim: int) -> P:
+def zero_extend_spec(spec: P, zd, dp_axis: str, ndim: int) -> P:
     if zd < 0:
         return spec
     entries = list(spec) + [None] * (ndim - len(spec))
@@ -102,18 +105,38 @@ def _zero1_extend_spec(spec: P, zd, dp_axis: str, ndim: int) -> P:
     return P(*entries)
 
 
-def zero1_state_specs(optimizer, specs, example_params, mesh: Mesh,
-                      dp_axis: str = "dp"):
-    """(zdims, state_specs) for ZeRO-1-over-dp: the ONE derivation of the
-    dp-sharded optimizer-state layout, shared by build_train_step, the
-    hbm_audit 6.7B compile and the byte-shrink test (three call sites
-    must agree or audited bytes stop matching the real program)."""
-    zdims = _zero1_dims(specs, example_params, mesh, dp_axis)
-    ext = jax.tree.map(
-        lambda s, zd, p: _zero1_extend_spec(s, zd, dp_axis, p.ndim),
+def zero_param_specs(specs, zdims, example_params, dp_axis: str = "dp"):
+    """Stage-3 PARAM specs: every dp-shardable leaf's PartitionSpec grows
+    the dp axis on its zero_dims dim (params dp-sharded AT REST); -1
+    leaves keep their spec (replicated over dp)."""
+    return jax.tree.map(
+        lambda s, zd, p: zero_extend_spec(s, zd, dp_axis, p.ndim),
         specs, zdims, example_params,
         is_leaf=lambda x: isinstance(x, P))
+
+
+def zero_state_specs(optimizer, specs, example_params, mesh: Mesh,
+                     dp_axis: str = "dp"):
+    """(zdims, state_specs) for ZeRO-over-dp: the ONE derivation of the
+    dp-sharded optimizer-state layout, shared by build_train_step (every
+    stage — the slots shard identically under stages 1/2/3), the
+    hbm_audit 6.7B compile and the byte-shrink test (the call sites must
+    agree or audited bytes stop matching the real program)."""
+    zdims = zero_dims(specs, example_params, mesh, dp_axis)
+    ext = zero_param_specs(specs, zdims, example_params, dp_axis)
     return zdims, state_specs_for(optimizer, ext, example_params)
+
+
+# thin compat wrappers: PR 7 layout_extra fingerprints and the pre-stage
+# call sites (hbm_audit, tests) keep working unchanged
+_zero1_dims = zero_dims
+_zero1_extend_spec = zero_extend_spec
+
+
+def zero1_state_specs(optimizer, specs, example_params, mesh: Mesh,
+                      dp_axis: str = "dp"):
+    return zero_state_specs(optimizer, specs, example_params, mesh,
+                            dp_axis)
 
 
 def _effective_clip(opt):
@@ -198,6 +221,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      optimizer, data_spec: P = None, dp_axis: str = "dp",
                      extra_grad_axes=(), example_params=None,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
+                     zero_stage=None, zero3=None,
                      comm_overlap="auto", fp8=None, telemetry="auto",
                      mp_overlap=None, moe=None, flash=None,
                      donate: bool = False):
@@ -214,15 +238,50 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     that manage their own synchronization (LocalSGD/DGC — attribute
     `_skips_grad_sync`) receive dp-UNreduced local gradients.
 
-    zero1_dp=True: ZeRO stage-1 composed with the hybrid mesh — optimizer
-    state shards over the dp axis (on top of its pp/mp shardings), grads
-    reduce-scatter instead of all-reduce, each dp rank updates only its
-    param shard and the new params all-gather back. Same bytes on the wire
-    as allreduce (RS + AG), 1/dp the optimizer-state HBM and update flops.
-    Reference: DygraphShardingOptimizer (stage 1) under
-    HybridParallelOptimizer. Requires the per-leaf optimizer protocol
+    zero_stage: ZeRO sharding stage over the dp axis composed with the
+    hybrid mesh (None/0 = off, compiles bitwise-identically to a build
+    without the argument). Requires the per-leaf optimizer protocol
     (AdamW-family; name filters ride the ctx protocol) and supports
-    ClipGradByGlobalNorm/ByValue.
+    ClipGradByGlobalNorm/ByValue. The per-leaf dp shard dim is the ONE
+    `zero_dims` rule for every stage.
+
+    * stage 1 (== the legacy ``zero1_dp=True``): optimizer state shards
+      over dp (on top of its pp/mp shardings), grads reduce-scatter
+      instead of all-reduce, each dp rank updates only its param shard
+      and the new params all-gather back. Same bytes on the wire as
+      allreduce (RS + AG), 1/dp the optimizer-state HBM and update
+      flops. Reference: DygraphShardingOptimizer (stage 1) under
+      HybridParallelOptimizer.
+    * stage 2: stage 1 with the gradient reduce-scatter OWNING the dp
+      grad buffer — the scattered shards are the only dp-synchronized
+      gradients that exist next to the dp-sharded slots. In this
+      one-compiled-program engine stage 1 already reduce-scatters
+      before the update, so stages 1 and 2 issue the SAME collectives
+      (trajectories are asserted identical in tests); the stage exists
+      as an explicit axis because the planner's HBM rule and the
+      checkpoint layout metadata account the grad buffer dp-sharded.
+    * stage 3: params dp-sharded AT REST — every dp-shardable leaf's
+      spec grows the dp axis (`zero_param_specs`), and the LOSS gathers
+      each leaf on use (the model builders thread a zero3 plan:
+      per-block all-gathers inside the layer scan, prefetched so block
+      i+1's transfer hides under block i's compute, re-gathered by the
+      backward's remat replay — comm_overlap.zero3.scan_gather). The
+      all-gather's AD transpose delivers each leaf's gradient SHARD
+      already dp-summed (psum_scatter), so the engine's update divides
+      by dp and updates the resident shard in place: no full grad, no
+      end-of-step param all-gather, params/grads/opt state all ~1/dp.
+      Reference anchors: group_sharded_stage3.py:85,
+      dygraph_sharding_optimizer.py:571 (allgather-overlap comm
+      buffers).
+
+    zero3: the stage-3 extras plan a model builder threads when the
+    quantized gather is on — {"ef": {"init", "specs"} or None, "meta":
+    build metadata}. The int8 error-feedback AG residuals then ride
+    ``opt_state["zero3_ef"]`` (the moe_ef carry discipline: the loss
+    takes the flat residual tree as 4th arg and returns
+    (loss, new_residuals)); pp degree 1 / one pipeline microbatch only,
+    not composed with fp8 / comm_overlap / the quantized-a2a MoE plan
+    (each already owns the loss arity or the accumulation schedule).
 
     comm_overlap: bucketed, schedule-overlapped dp gradient collectives
     (distributed.comm_overlap) replacing the monolithic end-of-backward
@@ -317,25 +376,50 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
     data_spec = P(dp_axis) if data_spec is None else data_spec
-    zdims = None
+    # -- ZeRO stage resolution (zero1_dp is the legacy stage-1 spelling) ----
+    zero_stage = 0 if zero_stage is None else int(zero_stage)
     if zero1_dp:
+        from ..enforce import enforce
+        enforce(zero_stage in (0, 1),
+                "zero1_dp is the legacy spelling of zero_stage=1 — do not "
+                "combine it with a different explicit stage",
+                op="build_train_step", zero_stage=zero_stage)
+        zero_stage = 1
+    zdims = None
+    pspecs = specs  # the PARAM specs the program shards with
+    if zero_stage:
         from ..distributed.sharding.group_sharded import _leaf_streamable
         from ..enforce import enforce
+        enforce(zero_stage in (1, 2, 3),
+                "zero_stage must be one of 0/1/2/3",
+                op="build_train_step", zero_stage=zero_stage)
         enforce(example_params is not None,
-                "zero1_dp needs example_params (leaf shapes pick the dp "
+                "zero_stage needs example_params (leaf shapes pick the dp "
                 "shard dims)", op="build_train_step")
         enforce(_leaf_streamable(optimizer),
-                "zero1_dp re-runs the update per leaf shard; the optimizer "
-                "must follow the per-leaf _init_slot/_update protocol "
-                f"(AdamW-family). Got {type(optimizer).__name__}",
+                "zero_stage re-runs the update per leaf shard; the "
+                "optimizer must follow the per-leaf _init_slot/_update "
+                f"protocol (AdamW-family). Got {type(optimizer).__name__}",
                 op="build_train_step")
         enforce(not getattr(optimizer, "_skips_grad_sync", False),
-                "LocalSGD/DGC own the dp axis — incompatible with zero1_dp",
-                op="build_train_step")
-        zdims, sspec = zero1_state_specs(optimizer, specs, example_params,
-                                         mesh, dp_axis)
+                "LocalSGD/DGC own the dp axis — incompatible with "
+                "zero_stage", op="build_train_step")
+        zdims, sspec = zero_state_specs(optimizer, specs, example_params,
+                                        mesh, dp_axis)
+        if zero_stage >= 3:
+            # params dp-sharded at rest: the loss gathers on use (model
+            # builders thread the zero3 plan into their loss closures)
+            pspecs = zero_param_specs(specs, zdims, example_params,
+                                      dp_axis)
     else:
         sspec = state_specs_for(optimizer, specs, example_params)
+    z3_ef = (zero3 or {}).get("ef") if zero3 is not None else None
+    if z3_ef is not None:
+        from ..enforce import enforce
+        enforce(zero_stage == 3,
+                "a zero3 EF plan (quantized param all-gather) requires "
+                "zero_stage=3", op="build_train_step",
+                zero_stage=zero_stage)
 
     # -- bucketed/overlapped dp gradient collectives -------------------------
     from ..distributed import comm_overlap as _co
@@ -348,10 +432,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     ef_plan = None
     if ocfg is not None and ocfg.quantize:
         from ..enforce import enforce
-        enforce(not zero1_dp,
+        enforce(not zero_stage,
                 "comm_quantize=int8 is the replicated all-reduce path; "
-                "zero1_dp reduce-scatters shards whose codes cannot share "
-                "a bucket scale — disable one of the two",
+                "the ZeRO stages reduce-scatter shards whose codes cannot "
+                "share a bucket scale — disable one of the two",
                 op="build_train_step")
         enforce(example_params is not None,
                 "comm_quantize=int8 needs example_params (the "
@@ -359,6 +443,18 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 "gradient shapes at build time)", op="build_train_step")
         ef_plan = _co.ef_plan_for(example_params, specs, mesh,
                                   ocfg.bucket_bytes)
+    if z3_ef is not None:
+        from ..enforce import enforce
+        enforce(ocfg is None,
+                "zero3_quantize_ag threads ONE error-feedback residual "
+                "slot per step; the comm_overlap scan calls the loss once "
+                "per comm microbatch and would sum residuals — disable "
+                "FLAGS_comm_* or FLAGS_zero3_quantize_ag",
+                op="build_train_step")
+        enforce(fp8 is None,
+                "zero3_quantize_ag and fp8 delayed scaling both own the "
+                "loss's 4th argument (residuals vs scales) — disable one "
+                "of the two", op="build_train_step")
     fp8_plan = fp8
     if fp8_plan is not None:
         from ..enforce import enforce
@@ -407,6 +503,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     "the loss once per comm microbatch and would sum "
                     "residuals — disable FLAGS_comm_* or "
                     "FLAGS_moe_quantize_a2a", op="build_train_step")
+            enforce(z3_ef is None,
+                    "moe_quantize_a2a and zero3_quantize_ag both thread "
+                    "their residuals as the loss's 4th argument — "
+                    "disable one of the two", op="build_train_step")
     # -- in-program telemetry (observability) --------------------------------
     from .. import observability as _obs
     tcfg = _obs.telemetry_from_flags() if telemetry == "auto" else telemetry
@@ -423,8 +523,13 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         tcfg.static["host"] = default_host()
         tcfg.static["role"] = "trainer"
         for k in ("comm_buckets_bytes", "comm_quantize",
-                  "comm_microbatches", "mp_mode", "moe", "flash"):
+                  "comm_microbatches", "mp_mode", "moe", "flash",
+                  "zero_stage", "zero3"):
             tcfg.static.pop(k, None)
+        if zero_stage:
+            tcfg.static["zero_stage"] = zero_stage
+            if zero3 is not None:
+                tcfg.static["zero3"] = dict(zero3.get("meta", {}))
         if mp_mode is not None:
             tcfg.static["mp_mode"] = mp_mode
         if moe_plan is not None:
@@ -452,6 +557,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         wrap_specs["fp8_meta"] = fp8_plan["specs"]
     if moe_plan is not None and moe_plan.get("ef") is not None:
         wrap_specs["moe_ef"] = moe_plan["ef"]["specs"]
+    if z3_ef is not None:
+        wrap_specs["zero3_ef"] = z3_ef["specs"]
     if tcfg is not None:
         wrap_specs["telemetry"] = _obs.buffer_specs(tcfg)
     if wrap_specs:
@@ -460,7 +567,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     def shard_params(params):
         return jax.tree.map(
             lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-            params, specs)
+            params, pspecs)
 
     # Elastic-checkpoint hints (checkpoint.reshard): everything about this
     # build's topology that the saved arrays' shardings cannot express —
@@ -470,7 +577,9 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     # on/off. Models add the "pp" stacked-block layout on top. Thread it
     # to run_resilient(layout_extra=init_state.layout_extra) /
     # commit_checkpoint so both the save and the resumed template agree.
-    layout_extra: Dict[str, Any] = {"zero1": bool(zero1_dp), "carries": {}}
+    layout_extra: Dict[str, Any] = {"zero1": zero_stage >= 1,
+                                    "zero_stage": int(zero_stage),
+                                    "carries": {}}
     if ef_plan is not None:
         layout_extra["carries"]["comm_ef"] = "reset_on_mismatch"
         layout_extra["comm_plan"] = {
@@ -483,6 +592,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         # a2a residuals are per-rank rounding errors of a mesh-shaped
         # exchange — any topology change invalidates them
         layout_extra["carries"]["moe_ef"] = "reset_on_mismatch"
+    if z3_ef is not None:
+        # AG-EF residuals are each dp rank's rounding error for ITS param
+        # shard — any topology/stage change invalidates them
+        layout_extra["carries"]["zero3_ef"] = "reset_on_mismatch"
     if tcfg is not None:
         layout_extra["carries"]["telemetry"] = "reinit"
 
@@ -504,6 +617,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             extras["moe_ef"] = jax.tree.map(
                 lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
                 moe_plan["ef"]["init"](), moe_plan["ef"]["specs"])
+        if z3_ef is not None:
+            extras["zero3_ef"] = jax.tree.map(
+                lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+                z3_ef["init"](), z3_ef["specs"])
         if tcfg is not None:
             extras["telemetry"] = jax.tree.map(
                 lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
@@ -528,6 +645,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             extras["fp8_meta"] = jax.eval_shape(fp8_plan["init"])
         if moe_plan is not None and moe_plan.get("ef") is not None:
             extras["moe_ef"] = jax.eval_shape(moe_plan["ef"]["init"])
+        if z3_ef is not None:
+            extras["zero3_ef"] = jax.eval_shape(z3_ef["init"])
         if tcfg is not None:
             extras["telemetry"] = jax.eval_shape(
                 lambda: _obs.init_buffer(tcfg))
@@ -536,16 +655,28 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         return inner
     init_state.abstract = abstract_state
     init_state.state_specs = sspec
+    init_state.param_specs = pspecs
 
-    def _zero1_apply(params, grads, opt_state, lr, pre_reduced=False):
-        """Per-leaf ZeRO-1 update inside shard_map: reduce-scatter the
-        leaf's grad over dp, update only this rank's param/state shard,
-        all-gather the new params. Leaves with no dp-shardable dim stay
-        replicated (pmean + full update). The per-leaf name/ctx/rng
-        protocol comes from Optimizer._leaf_items (one implementation
-        across every per-leaf loop). pre_reduced=True: grads arrived
-        already scattered/averaged (the comm_overlap scan reduced them
-        under backward) — skip pass 1's collectives.
+    def _zero_apply(params, grads, opt_state, lr, pre_reduced=False):
+        """Per-leaf ZeRO update inside shard_map, all stages.
+
+        Stages 1/2: reduce-scatter the leaf's grad over dp, update only
+        this rank's param/state shard (dynamic-sliced from the
+        replicated leaf), all-gather the new params.
+
+        Stage 3: the resident leaf IS this rank's shard, and its grad
+        arrived already dp-SUMMED and scattered (the loss's per-block
+        all-gather transposes to psum_scatter in the backward) — pass 1
+        only folds the 1/dp of the loss mean (+ any extra-axis pmean),
+        and pass 2 updates the shard in place with NO dynamic slice and
+        NO closing all-gather. Replicated leaves (no dp-shardable dim)
+        keep pmean + full update under every stage.
+
+        The per-leaf name/ctx/rng protocol comes from
+        Optimizer._leaf_items (one implementation across every per-leaf
+        loop). pre_reduced=True: grads arrived already scattered/averaged
+        (the comm_overlap scan reduced them under backward) — skip
+        pass 1's collectives.
 
         Returns (new_params, new_state, tele): tele is None unless
         telemetry is on, else the grad-norm/nonfinite series computed
@@ -573,6 +704,12 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     continue
                 if extra_grad_axes:
                     g = lax.pmean(g, tuple(extra_grad_axes))
+                if zero_stage >= 3 and zd >= 0:
+                    # the gather's AD transpose already psum_scattered
+                    # this leaf (dp SUM at the shard) — only the loss
+                    # mean's divisor remains
+                    red.append((g / dp).astype(g.dtype))
+                    continue
                 gr = g.astype(grad_reduce_dtype) \
                     if grad_reduce_dtype is not None else g
                 if zd < 0:
@@ -593,7 +730,11 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             }
             # wire accounting (trace-time constants): RS/pmean of the
             # grads (unless the overlap scan already counted them) + the
-            # param all-gather that closes every zero1 step
+            # param all-gather that closes every stage-1/2 step. Stage-3
+            # sharded leaves move their bytes inside the loss (the
+            # per-block AG and its RS transpose) — the model deposits
+            # those through observability.note_zero3_comm, so only the
+            # replicated-leaf pmean is counted here.
             dpn = dp
             f = (dpn - 1) / dpn
             wire = (jnp.dtype(grad_reduce_dtype).itemsize
@@ -607,6 +748,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                      else jnp.dtype(p.dtype).itemsize))
                 if zd < 0:
                     rs_b += 2 * f * gb   # pmean all-reduce
+                elif zero_stage >= 3:
+                    pass                 # counted by the model's deposit
                 else:
                     rs_b += f * gb       # psum_scatter
                     ag_b += f * pb       # new-param all-gather
@@ -621,10 +764,11 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                        dp_axis, clip)
         elif clip is not None and not isinstance(clip, ClipGradByValue):
             raise NotImplementedError(
-                f"zero1_dp supports global-norm/by-value clip, got "
+                f"zero_stage supports global-norm/by-value clip, got "
                 f"{type(clip).__name__}")
 
-        # pass 2: per-leaf update on this rank's shard, gather params back
+        # pass 2: per-leaf update on this rank's shard; stages 1/2 gather
+        # the new params back, stage 3 keeps the resident shard
         new_p, new_s = [], []
         for (p, g_unused, s, ctx, rng), g, zd in zip(items, red, leaves_z):
             if g is None:
@@ -641,8 +785,6 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 np_, ns_ = optimizer._update_ctx(ctx, p, g, s, lr,
                                                  step_no, rng=rng)
             else:
-                shard = p.shape[zd] // dp
-                p_sh = lax.dynamic_slice_in_dim(p, idx * shard, shard, zd)
                 if rng is not None:
                     # dp-sharded leaf: each rank updates a DISTINCT param
                     # shard — fold the dp rank into the per-leaf SR key,
@@ -651,9 +793,19 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     # the per-leaf key remain correlated — accepted, the
                     # per-leaf protocol has no mesh knowledge there)
                     rng = jax.random.fold_in(rng, idx)
-                np_sh, ns_ = optimizer._update_ctx(ctx, p_sh, g, s, lr,
-                                                   step_no, rng=rng)
-                np_ = lax.all_gather(np_sh, dp_axis, axis=zd, tiled=True)
+                if zero_stage >= 3:
+                    # p IS the resident shard; the next step's loss
+                    # re-gathers it on use
+                    np_, ns_ = optimizer._update_ctx(ctx, p, g, s, lr,
+                                                     step_no, rng=rng)
+                else:
+                    shard = p.shape[zd] // dp
+                    p_sh = lax.dynamic_slice_in_dim(p, idx * shard, shard,
+                                                    zd)
+                    np_sh, ns_ = optimizer._update_ctx(ctx, p_sh, g, s, lr,
+                                                       step_no, rng=rng)
+                    np_ = lax.all_gather(np_sh, dp_axis, axis=zd,
+                                         tiled=True)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
@@ -698,12 +850,16 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         for g, zd in zip(g_leaves, z_leaves):
             if g is None:
                 continue
+            if zero_stage >= 3 and zd >= 0:
+                # stage-3 sharded leaves reduce inside the loss's AD
+                # (counted by the model's note_zero3_comm deposit)
+                continue
             if ocfg.quantize:
                 b = float(g.size)  # int8 codes on the wire
             else:
                 wd = wire_dtype if wire_dtype is not None else g.dtype
                 b = float(g.size * jnp.dtype(wd).itemsize)
-            total += (f if (zero1_dp and zd >= 0) else 2 * f) * b
+            total += (f if (zero_stage >= 1 and zd >= 0) else 2 * f) * b
         return total
 
     def _overlap_grads(params, tokens, labels, residuals):
@@ -729,11 +885,27 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             if tcfg is not None and tele_comms["reduce"] is None:
                 # idempotent: the scan body may trace twice (eval_shape)
                 z_leaves = (jax.tree.structure(g).flatten_up_to(zdims)
-                            if zero1_dp else
+                            if zero_stage else
                             [-1] * len(jax.tree.leaves(g)))
                 tele_comms["reduce"] = ocfg.microbatches * _overlap_bytes(
                     jax.tree.leaves(g), z_leaves, wire_dtype)
-            if zero1_dp:
+            if zero_stage >= 3:
+                # sharded leaves arrived dp-SUMMED at the shard (gather
+                # transpose) — scale by the microbatch weight / dp; only
+                # the replicated leaves still need a collective
+                def z3_one(g_, zd):
+                    if g_ is None:
+                        return None
+                    if zd >= 0:
+                        return (g_ * jnp.asarray(weight / dp, g_.dtype)
+                                ).astype(g_.dtype)
+                    gr = (g_.astype(wire_dtype) if wire_dtype is not None
+                          else g_)
+                    gr = gr * jnp.asarray(weight, gr.dtype)
+                    return lax.pmean(gr, dp_axis).astype(g_.dtype)
+                return jax.tree.map(z3_one, g, zdims,
+                                    is_leaf=lambda x: x is None), res
+            if zero_stage:
                 red = _co.reduce_scatter_tree(
                     g, zdims, dp_axis, axis_size=dp,
                     reduce_dtype=wire_dtype, weight=weight)
@@ -760,11 +932,12 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                lr)
 
     def _local_step(mp_cell, params, opt_state, tokens, labels, lr):
-        ef = fmeta = tbuf = mef = None
+        ef = fmeta = tbuf = mef = zef = None
         if wrap_specs:
             ef = opt_state.get("comm_ef")
             fmeta = opt_state.get("fp8_meta")
             mef = opt_state.get("moe_ef")
+            zef = opt_state.get("zero3_ef")
             tbuf = opt_state.get("telemetry")
             opt_state = opt_state["opt"]
 
@@ -802,7 +975,9 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                                        + (tele_comms["ep"] or 0.0)
                                        + mp_calls
                                        * (mp_cell.get("wire_bytes", 0.0)
-                                          + mp_cell.get("ep_bytes", 0.0)))
+                                          + mp_cell.get("ep_bytes", 0.0)
+                                          + mp_cell.get("zero3_bytes",
+                                                        0.0)))
                 if fp8_plan is not None and amax is not None:
                     vals["fp8_amax_max"] = jnp.stack(
                         [jnp.max(a) for a in jax.tree.leaves(amax)]).max()
@@ -820,6 +995,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     # reads the enclosing `mef`, which the moe-ef branch
                     # rebinds to the loss's new residuals before exiting
                     w["moe_ef"] = mef
+                if z3_ef is not None:
+                    # same discipline: the zero3-ef branch rebinds `zef`
+                    # to the loss's refreshed AG residuals
+                    w["zero3_ef"] = zef
                 if tcfg is not None:
                     w["telemetry"] = new_tbuf
                 new_state = w
@@ -830,8 +1009,8 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
         if ocfg is not None:
             loss, grads, ef, obs = _overlap_grads(params, tokens, labels,
                                                   ef)
-            if zero1_dp:
-                new_params, new_state, z1t = _zero1_apply(
+            if zero_stage:
+                new_params, new_state, z1t = _zero_apply(
                     params, grads, opt_state, lr, pre_reduced=True)
                 return rewrap(new_params, new_state, ef, fmeta, loss,
                               tele=z1t, obs=obs)
@@ -855,9 +1034,9 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             if fp8_axes:
                 amax = jax.tree.map(lambda a: lax.pmax(a, fp8_axes), amax)
             fmeta = _f8.update_fp8_meta(fmeta, amax)
-            if zero1_dp:
-                new_params, new_state, z1t = _zero1_apply(params, grads,
-                                                          opt_state, lr)
+            if zero_stage:
+                new_params, new_state, z1t = _zero_apply(params, grads,
+                                                         opt_state, lr)
                 return rewrap(new_params, new_state, ef, fmeta, loss,
                               tele=z1t, amax=amax, obs=obs)
         elif moe_plan is not None and moe_plan.get("ef") is not None:
@@ -878,11 +1057,34 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     mef_loss, has_aux=True)(params)
             mef = new_mef
             grads = _ep_sync(grads)
-            if zero1_dp:
-                new_params, new_state, z1t = _zero1_apply(params, grads,
-                                                          opt_state, lr)
+            if zero_stage:
+                new_params, new_state, z1t = _zero_apply(params, grads,
+                                                         opt_state, lr)
                 return rewrap(new_params, new_state, ef, fmeta, loss,
                               tele=z1t, obs=obs)
+        elif z3_ef is not None:
+            # int8-EF quantized zero3 param all-gather: the residuals
+            # ride in as a loss arg and the refreshed residuals ride out
+            # as an aux output — the moe_ef discipline (the residual is
+            # a forward-side value, not a gradient)
+            zef_loss = lambda p: loss_fn(p, tokens, labels, zef)
+            if tcfg is not None:
+                def zef_loss_obs(p):
+                    with _obs.collecting() as sink:
+                        l, nzef = zef_loss(p)
+                    return l, (nzef, _obs.metrics.obs_dict(sink))
+                (loss, (new_zef, obs)), grads = jax.value_and_grad(
+                    zef_loss_obs, has_aux=True)(params)
+            else:
+                (loss, new_zef), grads = jax.value_and_grad(
+                    zef_loss, has_aux=True)(params)
+            zef = new_zef
+            grads = _ep_sync(grads)
+            # z3_ef implies zero_stage == 3 (enforced at build)
+            new_params, new_state, z1t = _zero_apply(params, grads,
+                                                     opt_state, lr)
+            return rewrap(new_params, new_state, ef, fmeta, loss,
+                          tele=z1t, obs=obs)
         else:
             plain_loss = lambda p: loss_fn(p, tokens, labels)
             if tcfg is not None:
@@ -895,9 +1097,9 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             else:
                 loss, grads = jax.value_and_grad(plain_loss)(params)
             grads = _ep_sync(grads)
-            if zero1_dp:
-                new_params, new_state, z1t = _zero1_apply(params, grads,
-                                                          opt_state, lr)
+            if zero_stage:
+                new_params, new_state, z1t = _zero_apply(params, grads,
+                                                         opt_state, lr)
                 return rewrap(new_params, new_state, ef, fmeta, loss,
                               tele=z1t, obs=obs)
         # dp gradient reduction (the EagerReducer equivalent — one pmean,
@@ -1006,7 +1208,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     tele_comms = {"reduce": None, "zero1": None, "ep": None}
     step = _shard_map(
         local_step, mesh=mesh,
-        in_specs=(specs, sspec, data_spec, data_spec, P()),
-        out_specs=(specs, sspec, P()))
+        in_specs=(pspecs, sspec, data_spec, data_spec, P()),
+        out_specs=(pspecs, sspec, P()))
     return (jax.jit(step, donate_argnums=(0, 1) if donate else ()),
             shard_params, init_state)

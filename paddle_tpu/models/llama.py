@@ -517,7 +517,7 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True,
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1, fp8=None, sp=None,
-                   flash=None, sep_axis="sep"):
+                   flash=None, sep_axis="sep", z3=None):
     """Per-device loss of the full hybrid Llama (inside shard_map). fp8:
     this pp rank's stacked [L/pp] delayed scales (1F1B only — see
     gpt.hybrid_loss_fn). sp: None or comm_overlap.MpOverlapConfig —
@@ -527,7 +527,10 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     gpt.hybrid_loss_fn) — with flash.sep, tokens arrive sequence-sharded
     over `sep_axis` and the RoPE tables become this rank's GLOBAL
     position slice (ring rotation / the Ulysses gather both preserve the
-    already-rotated K blocks)."""
+    already-rotated K blocks). z3: None or the ZeRO-3 gather-on-use plan
+    (see gpt.hybrid_loss_fn — dp-sharded params, per-layer all-gathers
+    inside the stage scan; the llama builder's stage 3 is always the
+    unquantized gather)."""
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
@@ -553,6 +556,16 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
         sin = lax.dynamic_slice_in_dim(sin_g, off, S, axis=0)
     else:
         cos, sin = rope_tables(cfg, S)
+    if z3 is not None:
+        from ..distributed.comm_overlap import zero3 as _z3g
+        from .gpt import _note_zero3_wire
+        _note_zero3_wire(z3, params, pp_axis, M, virtual_pp=virtual_pp)
+        params = dict(params)
+        for name in z3["other_leaves"]:
+            zd_ = z3["zdims"][name]
+            if zd_ >= 0:
+                params[name] = _z3g.all_gather_param(params[name], zd_,
+                                                     z3["axis"])
     x = _vocab_parallel_embed(params["wte"], tokens, mp_axis)
     x = x.astype(cfg.dtype)
     if sp is not None:
@@ -566,6 +579,15 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     def stage_fn(block_params, h):
         if fp8 is not None:
             blocks, scales = block_params
+            if z3 is not None:
+                def blk_fn(p, c, f):
+                    return _block_fn(p, c, cos, sin, cfg, mp_axis,
+                                     fp8=f, sp=sp, flash=flash,
+                                     sep_axis=sep_axis), None
+                out, _, _ = _z3g.scan_gather(
+                    blk_fn, h, blocks, z3["zdims"]["blocks"],
+                    z3["axis"], extras=(scales,), cfg=z3["cfg"])
+                return out
 
             def body(carry, pf):
                 p, f = pf
@@ -573,6 +595,15 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                                  fp8=f, sp=sp, flash=flash,
                                  sep_axis=sep_axis), None
             out, _ = lax.scan(body, h, (blocks, scales))
+            return out
+
+        if z3 is not None:
+            def blk_fn(p, c):
+                return _block_fn(p, c, cos, sin, cfg, mp_axis, sp=sp,
+                                 flash=flash, sep_axis=sep_axis), None
+            out, _, _ = _z3g.scan_gather(
+                blk_fn, h, block_params, z3["zdims"]["blocks"],
+                z3["axis"], cfg=z3["cfg"])
             return out
 
         def body(carry, p):
@@ -621,7 +652,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             num_microbatches: int = 1, dp_axis="dp",
                             pp_axis="pp", mp_axis="mp", extra_grad_axes=(),
                             virtual_pp: int = 1, grad_reduce_dtype="auto",
-                            zero1_dp: bool = False, fp8="auto",
+                            zero1_dp: bool = False, zero_stage="auto",
+                            zero3="auto", fp8="auto",
                             telemetry="auto", mp_overlap="auto",
                             flash_attention="auto", sep_axis="sep"):
     """mp_overlap: "auto" (FLAGS_mp_seq_parallel / FLAGS_mp_collective_
@@ -635,7 +667,15 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
     in every decoder layer; see gpt.build_hybrid_train_step. A sep mode
     mounts `sep_axis` as a context-parallel axis ("ulysses" needs BOTH
     heads/mp and kv_heads/mp divisible by the sep degree — the
-    all-to-all trades seq for heads on q and kv alike)."""
+    all-to-all trades seq for heads on q and kv alike).
+
+    zero_stage: "auto" (FLAGS_zero_stage) / None / 0/1/2/3 — ZeRO over
+    dp; see gpt.build_hybrid_train_step. zero3: "auto" (flags) / None /
+    Zero3Config — the stage-3 gather knobs (the planner pins an
+    explicit config so plans stay flag-independent); the llama
+    builder's stage 3 is always the UNQUANTIZED gather (the
+    narrower-surface convention — a quantizing config is refused here;
+    the gpt builder carries the int8-EF path)."""
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
     from ..distributed.comm_overlap.collective_matmul import \
@@ -677,25 +717,50 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                 "fp8 delayed scaling supports the 1F1B schedule only",
                 op="llama.build_hybrid_train_step", virtual_pp=virtual_pp)
 
+    # -- ZeRO stage resolution (see gpt.build_hybrid_train_step) ----------
+    from .hybrid_engine import zero_dims
+    from ..distributed.comm_overlap.zero3 import (resolve_zero3,
+                                                  resolve_zero_stage)
+    specs = hybrid_param_specs(cfg)
+    example = jax.eval_shape(
+        lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
+    stage = resolve_zero_stage(zero_stage, zero1_dp,
+                               op="llama.build_hybrid_train_step")
+    z3plan = None
+    z3_engine = None
+    if stage >= 3:
+        z3cfg = resolve_zero3(zero3)
+        enforce(not z3cfg.quantize,
+                "the llama builder's stage 3 is the unquantized gather "
+                "(narrower surface) — disable FLAGS_zero3_quantize_ag or "
+                "use the gpt builder",
+                op="llama.build_hybrid_train_step")
+        zdims = zero_dims(specs, example, mesh, dp_axis)
+        z3plan = {"zdims": zdims, "axis": dp_axis, "cfg": z3cfg,
+                  "other_leaves": ("wte", "lnf_g", "head_w")}
+        z3_engine = {"ef": None, "meta": z3cfg.meta()}
+
+    if fp8_plan is not None:
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, fp8=scales, sp=sp,
-                                  flash=flash, sep_axis=sep_axis)
+                                  flash=flash, sep_axis=sep_axis,
+                                  z3=z3plan)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, sp=sp,
-                                  flash=flash, sep_axis=sep_axis)
+                                  flash=flash, sep_axis=sep_axis,
+                                  z3=z3plan)
 
-    example = jax.eval_shape(
-        lambda: init_hybrid_params(cfg, jax.random.PRNGKey(0)))
     step, shard_params, init_state = build_train_step(
-        loss_fn, hybrid_param_specs(cfg), mesh, optimizer, dp_axis=dp_axis,
+        loss_fn, specs, mesh, optimizer, dp_axis=dp_axis,
         data_spec=(P(dp_axis, sep_axis) if sep_on else None),
         extra_grad_axes=extra_grad_axes, example_params=example,
-        grad_reduce_dtype=grad_reduce_dtype, zero1_dp=zero1_dp,
+        grad_reduce_dtype=grad_reduce_dtype, zero_stage=stage,
+        zero3=z3_engine,
         fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp, flash=flash)
     # elastic-checkpoint hint: see gpt.build_hybrid_train_step
     init_state.layout_extra["pp"] = {

@@ -53,8 +53,9 @@ from .flops import (collective_seconds, gpt_flops_per_token,
 from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
                       buffer_specs, collecting, ep_a2a_wire_bytes,
                       init_buffer, mp_comm_scope, mp_wire_bytes,
-                      note_ep_comm, note_mp_comm, observe,
-                      telemetry_from_flags, update_buffer)
+                      note_ep_comm, note_mp_comm, note_zero3_comm, observe,
+                      telemetry_from_flags, update_buffer,
+                      zero3_ag_wire_bytes)
 from .profile_reader import (MeasuredRates, ProfileWindow,
                              capture_step_profile, derive_hardware_profile,
                              hlo_census, load_profile_json,
@@ -69,6 +70,7 @@ __all__ = [
     "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
     "update_buffer", "mp_wire_bytes", "note_mp_comm", "mp_comm_scope",
     "ep_a2a_wire_bytes", "note_ep_comm",
+    "zero3_ag_wire_bytes", "note_zero3_comm",
     "StepTimer",
     "gpt_flops_per_token", "gpt_moe_flops_per_token",
     "llama_flops_per_token",

@@ -40,7 +40,7 @@ __all__ = ["TelemetryConfig", "telemetry_from_flags", "observe",
            "collecting", "BUILTIN_SERIES", "init_buffer", "buffer_specs",
            "update_buffer", "TelemetryHost", "mp_wire_bytes",
            "note_mp_comm", "mp_comm_scope", "ep_a2a_wire_bytes",
-           "note_ep_comm"]
+           "note_ep_comm", "zero3_ag_wire_bytes", "note_zero3_comm"]
 
 # always-present builtin slots (fp8 slots stay 0.0 when fp8 is off) — a
 # FIXED tuple so host decode needs only the config, never the engine
@@ -182,6 +182,54 @@ def note_ep_comm(wire_bytes: float) -> None:
     cell = getattr(_MP_COMM, "cell", None)
     if cell is not None:
         cell["ep_bytes"] = float(wire_bytes)
+
+
+def zero3_ag_wire_bytes(dp: int, *, block_param_bytes: float,
+                        n_stage_executions: float,
+                        other_param_bytes: float = 0.0,
+                        quantize: bool = False,
+                        param_itemsize: int = 4) -> float:
+    """Analytic per-rank dp-axis wire bytes of ONE train step's ZeRO-3
+    param gathers (ring accounting), shared by the models' telemetry
+    deposit and the tests'/planner's expected values.
+
+    block_param_bytes: bytes of the dp-SHARDABLE block params ONE stage
+        execution gathers (this pp rank's stacked layers, already local
+        to pp·mp, full over dp).
+    n_stage_executions: pipeline ticks per step — every tick re-runs the
+        stage scan and therefore re-gathers its layers (bubble ticks
+        included), and the checkpointed backward replays the gathers, so
+        one step pays 2 all-gathers + 1 cotangent reduce-scatter per
+        executed (tick, layer).
+    other_param_bytes: the once-per-step leaves outside the pipeline
+        (embeddings, LM head, final LN) — 1 gather + 1 RS each, never
+        quantized.
+    quantize: the block all-gathers cross the wire as int8 codes — ONE
+        byte per element, i.e. 1/param_itemsize of the input bytes (1/4
+        of fp32, 1/2 of bf16; per-shard fp32 scales are noise and not
+        counted); the cotangent reduce-scatters stay full precision.
+    param_itemsize: bytes per element of the UNquantized params the
+        byte totals were computed from (sets the int8 compression
+        ratio; ignored when quantize=False).
+    """
+    if dp <= 1:
+        return 0.0
+    f = (dp - 1) / dp
+    ag_item = 1.0 / max(int(param_itemsize), 1) if quantize else 1.0
+    blocks = n_stage_executions * f * block_param_bytes * (2.0 * ag_item
+                                                           + 1.0)
+    others = f * other_param_bytes * (1.0 + 1.0)
+    return blocks + others
+
+
+def note_zero3_comm(wire_bytes: float) -> None:
+    """Deposit a model's analytic ZeRO-3 param-gather wire bytes from
+    inside its loss trace — the stage-3 sibling of note_mp_comm, folded
+    into the same comms_bytes builtin by the engine. Inert unless an
+    engine has a scope open; last write wins."""
+    cell = getattr(_MP_COMM, "cell", None)
+    if cell is not None:
+        cell["zero3_bytes"] = float(wire_bytes)
 
 
 def note_mp_comm(mode: Optional[str], wire_bytes: float) -> None:

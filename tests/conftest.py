@@ -136,6 +136,16 @@ _SLOW_TESTS = {
     # deadline/shed/preempt/replay coverage on both engine paths.
     "test_spawned_kill_and_replay_ragged",
     "test_overload_shedding_preserves_admitted_slo",
+    # round 9: ZeRO-stage heavies — the 50-step zero3 acceptance curve,
+    # the 4-leg heavy compose matrix (ring/vpp/overlap/moe — each builds
+    # 2 hybrid engines) and the cross-mesh quantized-AG carry reset
+    # (4 more engine builds). The fast tier keeps the 4-step parity
+    # gates, the refusals, flags-off bitwise, the EF primitive, the
+    # planner rules and the stage-transition resumes.
+    "test_zero3_acceptance_50_steps",
+    "test_zero3_compose_slow",
+    "test_resume_quantized_zero3_resets_ef_carry",
+    "test_two_process_zero3_parity",
 }
 
 

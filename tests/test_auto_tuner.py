@@ -135,7 +135,7 @@ def _round_trip(cfg, cand, family="gpt", gb=GB, seq=SEQ):
 
 def test_round_trip_hybrid_zero1_bucketed():
     _round_trip(_tiny_gpt(), PlanCandidate(dp=2, mp=2, pp=2,
-                                           micro_batches=2, zero1=True,
+                                           micro_batches=2, zero_stage=1,
                                            comm_bucket_mb=4.0))
 
 
@@ -269,7 +269,7 @@ def test_hbm_model_monotonic_in_zero1_mp_and_sp():
     spec = _spec()
     cm = CostModel(spec, KNOWN_PROFILES["cpu"], global_batch=GB, seq=SEQ)
     base, parts = cm.hbm_bytes(PlanCandidate(dp=8))
-    z1, z1_parts = cm.hbm_bytes(PlanCandidate(dp=8, zero1=True))
+    z1, z1_parts = cm.hbm_bytes(PlanCandidate(dp=8, zero_stage=1))
     assert z1_parts["opt"] < parts["opt"] and z1 < base
     mp1, _ = cm.hbm_bytes(PlanCandidate(dp=4, mp=2))
     assert mp1 < base
@@ -403,7 +403,7 @@ def test_autotuner_trial_driver_picks_best_and_records_failures():
     spec = _spec()
     cands, _ = AT.generate_plan_candidates(
         spec, 4, global_batch=8, seq=SEQ, micro_batch_options=(1, 2),
-        zero1_options=(False,), comm_bucket_options=(0.0,),
+        zero_stage_options=(0,), comm_bucket_options=(0.0,),
         mp_overlap_options=(None,), vpp_options=(1,),
         schedules=("1f1b",))
     tuner = AutoTuner(trial)

@@ -484,7 +484,7 @@ def test_planner_flash_hbm_drops_and_compute_honest():
     rep = PL.plan(cfg, world=8, global_batch=8, seq=4096, family="gpt",
                   hbm_gb=budget,
                   micro_batch_options=(1,), schedules=("1f1b",),
-                  vpp_options=(1,), zero1_options=(False,),
+                  vpp_options=(1,), zero_stage_options=(0,),
                   comm_bucket_options=(0.0,), mp_overlap_options=(None,))
     kept = {str(s.candidate) for s in rep.ranked}
     assert str(fl) in kept

@@ -190,3 +190,16 @@ def test_two_process_zero1_parity():
     golden = mp_smoke.golden_for(8, "z1dpmp")
     assert all(np.isfinite(golden)), golden
     _spawn_and_check(8, golden, mode="z1dpmp")
+
+
+def test_two_process_zero3_parity():
+    """ZeRO-3 over a dp axis that SPANS two real processes (round 9):
+    params resident as cross-process dp shards, every layer's per-block
+    param all-gather (and its psum_scatter transpose in the backward)
+    crosses the boundary every step, plus the stage-3 global-norm clip
+    psum — loss parity vs the identical single-process run."""
+    from paddle_tpu.distributed import mp_smoke
+
+    golden = mp_smoke.golden_for(8, "z3dpmp")
+    assert all(np.isfinite(golden)), golden
+    _spawn_and_check(8, golden, mode="z3dpmp")
