@@ -756,6 +756,78 @@ def _run_zero_stages_config(jax, paddle, G, conf, iters):
     return out
 
 
+def _run_numerics_config(jax, paddle, G, conf, iters):
+    """Numerics observability (FLAGS_numerics): flags-on vs flags-off
+    hybrid step time on the dp4 x mp2 smoke mesh — the overhead of the
+    in-program tensor-health series (per-layer grad norms + activation
+    rms/absmax riding the telemetry ring, host poll every interval
+    included in the timed loop). Target: < 3% step-time overhead; also
+    reports the registered series count and a sample of the decoded
+    per-layer stats so rounds can see the path is live."""
+    import time
+
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import observability as obs
+
+    on_tpu = any(d.platform.lower() != "cpu" for d in jax.devices())
+    batch, seq = max(conf["batch"], 8), conf["seq"]  # dp4 divisibility
+    cfg = G.GPTConfig(
+        vocab_size=conf["vocab_size"], hidden_size=conf["hidden_size"],
+        num_layers=conf["num_layers"], num_heads=conf["num_heads"],
+        max_seq_len=conf["max_seq_len"],
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    mesh = dist.build_mesh({"dp": 4, "pp": 1, "mp": 2})
+    params0 = G.init_hybrid_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    interval = 10
+
+    def timed(telemetry, numerics):
+        step, shard_params, init_state = G.build_hybrid_train_step(
+            cfg, mesh, paddle.optimizer.AdamW(learning_rate=1e-3),
+            num_microbatches=1, telemetry=telemetry, numerics=numerics)
+        # host AFTER the build: the engine registers the numerics series
+        # onto the config
+        host = (obs.TelemetryHost(telemetry) if telemetry is not None
+                else None)
+        p = shard_params(params0)
+        s = init_state(p)
+        p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-3))
+        float(loss)  # compile + settle
+        n = max(iters, 2) * interval
+        t0 = time.perf_counter()
+        for i in range(n):
+            p, s, loss = step(p, s, tokens, labels, jnp.float32(1e-3))
+            if host is not None:
+                host.poll(s, i)
+        float(loss)
+        return (time.perf_counter() - t0) / n * 1e3, float(loss), host
+
+    off_ms, off_loss, _ = timed(None, None)
+    tcfg = obs.TelemetryConfig(interval=interval, strict=False)
+    on_ms, on_loss, host = timed(tcfg, True)
+    overhead = (on_ms - off_ms) / off_ms * 100.0
+    sample = {k: round(host.series[k][-1], 5)
+              for k in list(tcfg.extra)[:4]}
+    return {
+        "config_hash": _config_hash(conf),
+        "mesh": {"dp": 4, "mp": 2},
+        "interval": interval,
+        "n_series": tcfg.n_series,
+        "step_ms_off": round(off_ms, 3),
+        "step_ms_on": round(on_ms, 3),
+        "overhead_pct": round(overhead, 2),
+        "target_pct": 3.0,
+        "fetches": host.fetch_count,
+        "sample_series": sample,
+        # the two programs train identically up to the telemetry carry
+        "loss_delta": abs(on_loss - off_loss),
+    }
+
+
 def _run_planner_config(jax, G, conf):
     """Auto-parallel planner end-to-end (distributed.auto_tuner): plan the
     bench shape over the local mesh, then run a 4-point measured sweep —
@@ -1077,6 +1149,11 @@ def main():
     out["telemetry"] = _run_telemetry_config(
         jax, paddle, G, tele_conf, iters if on_tpu else 3,
         comms_fraction=out["overlap"]["comms_fraction"])
+    # numerics observability (FLAGS_numerics): flags-on step-time
+    # overhead of the in-program tensor-health series (target < 3%) +
+    # a decoded per-layer sample proving the path is live
+    out["numerics"] = _run_numerics_config(
+        jax, paddle, G, tele_conf, iters if on_tpu else 3)
     # auto-parallel planner (distributed.auto_tuner): plan time, top-1
     # predicted vs measured step ms on this host's mesh, ranking-order
     # check over a 4-point sweep with reshard warm hops between mesh

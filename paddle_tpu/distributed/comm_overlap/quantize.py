@@ -22,9 +22,61 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["quantize_int8", "dequantize_int8", "ef_quantized_psum"]
+__all__ = ["quantize_int8", "dequantize_int8", "ef_quantized_psum",
+           "spec_axes", "replication_factor", "residual_sq_norm"]
 
 _QMAX = 127.0
+
+
+def spec_axes(spec) -> set:
+    """Mesh axes a PartitionSpec shards over (nested tuples flattened) —
+    the ONE copy of the rule, shared by the hybrid engine's global
+    grad-norm/clip accounting (`hybrid_engine._spec_axes` aliases this)
+    and the EF-residual norms below."""
+    s = set()
+    for e in spec:
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            s.add(a)
+    return s
+
+
+def replication_factor(spec, mesh, extra_sharded=()) -> int:
+    """How many ranks hold a copy of a leaf with this PartitionSpec:
+    the product of mesh axes it is NOT sharded over. `extra_sharded`
+    adds axes sharded outside the spec (the engine's ZeRO dp dim)."""
+    sharded = spec_axes(spec) | set(extra_sharded)
+    repl = 1
+    for a in mesh.axis_names:
+        if a not in sharded:
+            repl *= mesh.shape[a]
+    return repl
+
+
+def residual_sq_norm(tree, specs, mesh):
+    """Replication-aware GLOBAL sum of squares of an error-feedback
+    residual carry (any of the ``opt_state`` EF namespaces — comm_ef's
+    flat buckets, moe_ef's flat per-layer slices, zero3_ef's stacked
+    dp-extended leaves). Each leaf's local sum of squares is divided by
+    its replication factor (mesh axes its PartitionSpec does NOT shard),
+    then ONE psum over every mesh axis counts each distinct element
+    exactly once — the same accounting the hybrid engine's global
+    grad-norm/clip uses, applied to forward-side EF state. Runs inside
+    shard_map; feeds the ``num_ef_*`` numerics telemetry series."""
+    from jax.sharding import PartitionSpec as P
+
+    acc = jnp.zeros((), jnp.float32)
+    td = jax.tree.structure(tree)
+    for t, sp in zip(td.flatten_up_to(tree),
+                     td.flatten_up_to(specs)):
+        if t is None:
+            continue
+        repl = (replication_factor(sp, mesh) if isinstance(sp, P)
+                else int(mesh.devices.size))
+        tf = t.astype(jnp.float32)
+        acc = acc + jnp.sum(tf * tf) / repl
+    return lax.psum(acc, tuple(mesh.axis_names))
 
 
 def quantize_int8(x: jax.Array, scale: jax.Array) -> jax.Array:

@@ -127,7 +127,7 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                   keep_n: Optional[int] = None,
                   resume: bool = True,
                   layout_extra: Optional[Dict[str, Any]] = None,
-                  aggregator=None,
+                  aggregator=None, numerics=None,
                   on_step: Optional[Callable[[int, Optional[float]], None]]
                   = None) -> Tuple[Dict, Dict[str, Any]]:
     """Drive ``step_fn(state, step) -> (new_state, loss)`` for ``steps``
@@ -142,6 +142,21 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
     0's gauges then carry per-host step-time p50/p95 and straggler flags
     (``straggler_detected`` JSONL events). The final fleet report lands
     in ``info["fleet"]``.
+
+    numerics: a :class:`observability.numerics.NumericsGuard` (ISSUE
+    15) — after every step the loop feeds it the host-observed loss and
+    the new state (the guard polls the telemetry ring on its interval
+    cadence and runs the anomaly detectors; one ``numerics_anomaly``
+    event + flight-recorder bundle per episode). A CONFIRMED episode
+    can act per FLAGS_numerics_action: "skip" rejects the diverging
+    step (the found_inf discipline at episode level —
+    ``resilience_numerics_skip`` events, ``info["numerics_skips"]``);
+    "rollback" reloads the LAST COMMITTED checkpoint and re-trains
+    forward from its step (``resilience_numerics_rollback``,
+    ``info["numerics_rollbacks"]``; bounded by the monitor's
+    max_rollbacks). The ``numerics/spike`` faults-grammar site in this
+    loop injects a synthetic host-observed loss spike for end-to-end
+    detection tests.
 
     Crash forensics: when FLAGS_flight_recorder_dir is set, a watchdog
     timeout (the CommWatchdog dumps from its own monitor thread), the
@@ -197,6 +212,7 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
 
     info: Dict[str, Any] = {"resumed_from": None, "preempted": False,
                             "watchdog_abort": False, "nonfinite_skips": 0,
+                            "numerics_skips": 0, "numerics_rollbacks": 0,
                             "final_checkpoint": None}
     start_step = 0
     if resume:
@@ -263,6 +279,17 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                 faults.maybe_fail("watchdog/hang")
                 new_state, loss = step_fn(state, i)
             loss_val = _loss_value(loss)
+            if loss_val is not None and faults.maybe_trigger(
+                    "numerics/spike"):
+                # synthetic loss/grad spike: perturbs only the DRIVER's
+                # view of the loss (device state untouched) so the
+                # numerics detection + forensics loop can be exercised
+                # deterministically (ISSUE 15)
+                loss_val = loss_val * 1e6 if loss_val != 0.0 else 1e6
+                _emit("numerics_spike_injected", step=i, loss=loss_val)
+            guard_action = None
+            if numerics is not None:
+                guard_action = numerics.after_step(new_state, i, loss_val)
             step_ms = (time.perf_counter() - t_step0) * 1e3
             if loss_val is not None and not math.isfinite(loss_val):
                 # found_inf discipline at loop level: reject the step,
@@ -283,7 +310,38 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
                         f"{nonfinite_report(new_state)}")
             else:
                 progress["nonfinite"] = 0
-                state = new_state
+                if guard_action == "skip":
+                    # confirmed-divergence skip: keep the last good state
+                    # (the found_inf discipline at episode level)
+                    info["numerics_skips"] += 1
+                    _emit("resilience_numerics_skip", step=i,
+                          loss=loss_val)
+                else:
+                    state = new_state
+            if guard_action == "rollback":
+                ckpt, md = latest_checkpoint(ckpt_dir, with_metadata=True)
+                if ckpt is None:
+                    # nothing committed yet: nothing to roll back to —
+                    # record it, REFUND the monitor's rollback budget
+                    # (charged at arm time) and keep training; a later
+                    # confirmation re-arms once a commit exists
+                    numerics.on_rollback_unavailable()
+                    _emit("resilience_numerics_rollback_unavailable",
+                          step=i)
+                else:
+                    from ..checkpoint import load_state_dict
+                    template = {"step": 0, "state": state}
+                    loaded = load_state_dict(template, ckpt, metadata=md)
+                    state = template["state"]
+                    progress["done"] = int(loaded["step"])
+                    info["numerics_rollbacks"] += 1
+                    _emit("resilience_numerics_rollback", step=i,
+                          to_step=progress["done"], checkpoint=ckpt)
+                    # detectors reset + the telemetry host rewinds to
+                    # the restored carry's ring count so replayed rows
+                    # re-enter detection
+                    numerics.on_rollback(state)
+                    return "rollback"  # restart the loop from the ckpt
             progress["done"] = i + 1
             if aggregator is not None:
                 # float(loss) above forced the step, so this is executed
@@ -302,7 +360,11 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
     try:
         with SigtermGuard() as sig:
             try:
-                _loop(sig)
+                # a numerics rollback rewinds progress["done"] to the
+                # checkpoint's step and restarts the pass (bounded by
+                # the guard monitor's max_rollbacks budget)
+                while _loop(sig) == "rollback":
+                    pass
                 done = progress["done"]
                 if (not info["preempted"] and done > start_step
                         and ckpt_every and done % ckpt_every):
@@ -353,6 +415,15 @@ def run_resilient(step_fn: Callable[[Dict, int], Tuple[Dict, Any]],
 
     info["completed_steps"] = done
     info["watchdog"] = wd.stats()
+    if numerics is not None:
+        # drain the partial tail interval so an end-of-run anomaly still
+        # reaches the detectors/forensics
+        try:
+            numerics.flush(state)
+        except Exception as e:
+            sys.stderr.write(f"[resilience] numerics flush failed: "
+                             f"{e!r}\n")
+        info["numerics_anomalies"] = len(numerics.monitor.anomalies)
     if aggregator is not None:
         info["fleet"] = aggregator.last_report
     _emit("resilience_run_end", completed_steps=done,

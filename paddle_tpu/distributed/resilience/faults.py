@@ -50,6 +50,13 @@ Sites currently planted (grep for ``maybe_fail`` /
 * ``serving/pool_exhausted``  — the admission loop found the queue head
   pool-blocked (no free KV pages): fires each blocked attempt, so tests
   can prove head-of-line pressure (and the preempt path) actually ran
+* ``numerics/spike``          — a ``maybe_trigger`` QUERY site in the
+  resilient driver's step loop: when armed (e.g.
+  ``numerics/spike:12``), the scheduled hit makes the driver scale its
+  HOST-OBSERVED loss by 1e6 — a synthetic loss/grad spike exercising
+  the numerics anomaly detectors + flight-recorder forensics end to
+  end with the device state untouched (ISSUE 15; the watchdog/hang
+  pattern applied to value corruption instead of stalls)
 """
 
 from __future__ import annotations
@@ -60,8 +67,9 @@ import threading
 import zlib
 from typing import Dict, Optional
 
-__all__ = ["FaultInjected", "maybe_fail", "maybe_corrupt_file", "configure",
-           "reset", "hits", "FAULT_EXIT_CODE"]
+__all__ = ["FaultInjected", "maybe_fail", "maybe_trigger",
+           "maybe_corrupt_file", "configure", "reset", "hits",
+           "FAULT_EXIT_CODE"]
 
 FAULT_EXIT_CODE = 41  # distinguishable from python crashes (1) / signals
 
@@ -183,7 +191,23 @@ def maybe_fail(site: str, exc=FaultInjected) -> None:
     _fire(site, exc)
 
 
-def _fire(site: str, exc, before=None) -> None:
+def maybe_trigger(site: str) -> bool:
+    """QUERY-style injection point for sites whose failure mode is a
+    corrupted VALUE rather than an exception (a numerics spike, a
+    degraded reading): counts a hit and returns True on the scheduled
+    firing instead of raising — the caller then perturbs its own state.
+    ``kill`` clauses keep their hard-exit semantics; ``hangN`` clauses
+    stall-then-continue and return False (a hang is not a corruption).
+    Disarmed: one comparison, always False."""
+    if _ENABLED is None:
+        from ...flags import flag
+        configure(flag("fault_inject"))
+    if not _ENABLED:
+        return False
+    return _fire(site, None, trigger_only=True)
+
+
+def _fire(site: str, exc, before=None, trigger_only=False) -> bool:
     with _LOCK:
         n = _COUNTS.get(site, 0) + 1
         _COUNTS[site] = n
@@ -200,7 +224,7 @@ def _fire(site: str, exc, before=None) -> None:
         kill = cl.kill
         hang_s = cl.hang_s
     if not fire:
-        return
+        return False
     if before is not None:
         before()  # e.g. tear the file THEN die, like real torn storage
     if hang_s is not None:
@@ -209,8 +233,10 @@ def _fire(site: str, exc, before=None) -> None:
         # hang a flight-recorder test diagnoses from the bundle alone
         import time
         time.sleep(hang_s)
-        return
+        return False
     if kill:
         os._exit(FAULT_EXIT_CODE)  # crash without cleanup: no atexit drain,
         #                            no buffered IO flush — a real SIGKILL
+    if trigger_only:
+        return True  # the caller owns the corruption (maybe_trigger)
     raise exc(f"[fault-injection] {site} (hit {n})")

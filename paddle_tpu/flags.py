@@ -618,6 +618,38 @@ define_flag("telemetry_straggler_factor", 1.5,
             "median exceeds the fleet median by this factor (consumed by "
             "observability.aggregate.detect_stragglers; emits a "
             "straggler_detected JSONL event).")
+define_flag("numerics", False,
+            "Numerics observability: in-program tensor-health telemetry "
+            "riding the train-step telemetry ring (per-layer grad norms "
+            "and activation rms/absmax, EF-residual norms for the "
+            "comm_ef/moe_ef/zero3_ef wires, fp8 per-site scale "
+            "saturation + amax headroom) plus the serving engine's "
+            "KV-pool page-scale drift gauges. Implies an (auto-created, "
+            "non-strict) telemetry config when FLAGS_telemetry is off. "
+            "Off = strict no-op: the compiled step is bitwise identical "
+            "(consumed by observability.numerics.resolve_numerics via "
+            "gpt/llama build_hybrid_train_step(numerics='auto') and "
+            "inference.ServingEngine).")
+define_flag("numerics_window", 32,
+            "Rolling-history length of the host-side numerics anomaly "
+            "detectors (loss/grad spike vs window median, EF growth, "
+            "fp8 saturation rate) and the last-K depth of the "
+            "numerics.json forensics snapshot (consumed by "
+            "observability.numerics.detector_from_flags).")
+define_flag("numerics_spike_factor", 4.0,
+            "Spike threshold for the loss/grad-norm/activation "
+            "detectors: fire when a new value exceeds its series' "
+            "rolling MEDIAN by this factor (consumed by "
+            "observability.numerics.detector_from_flags).")
+define_flag("numerics_action", "none",
+            "What a CONFIRMED numerics anomaly episode asks the "
+            "resilient driver to do: 'none' (observe + forensics only), "
+            "'skip' (reject diverging steps, the found_inf discipline "
+            "at episode level) or 'rollback' (reload the last committed "
+            "checkpoint and re-train forward; bounded by the monitor's "
+            "max_rollbacks). Consumed by "
+            "observability.numerics.detector_from_flags via "
+            "run_resilient(numerics=NumericsGuard(...)).")
 define_flag("flight_recorder_dir", "",
             "Crash-bundle directory for the hang flight recorder: on a "
             "watchdog timeout, resilience SIGTERM or nonfinite abort, a "

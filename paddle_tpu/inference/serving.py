@@ -534,6 +534,23 @@ class ServingEngine:
         # engine — weak registration, same contract as TelemetryHost
         from ..observability.flight_recorder import register_serving_engine
         register_serving_engine(self)
+        # -- numerics: KV-pool page-scale drift (ISSUE 15). A quantized
+        # pool's per-page running-absmax scales only ever GROW while a
+        # page is live; sustained growth means every append requantizes
+        # old tokens onto a coarser grid. Host-side only (one bounded
+        # device fetch per telemetry interval, OUTSIDE the compiled
+        # program) — flags-off behavior stays byte-identical.
+        from ..flags import flag as _flag
+        self._numerics_kv = (bool(_flag("numerics"))
+                             and self.k_scales is not None)
+        self._numerics_kv_interval = max(int(_flag("telemetry_interval")),
+                                         1)
+        self._numerics_kv_prev: Optional[Dict[str, np.ndarray]] = None
+        self._numerics_kv_last: Optional[Dict[str, float]] = None
+        # per-page allocation generation (bumped at admission): the
+        # drift poll uses it to exclude pages freed + re-admitted
+        # between two polls from the "requantized" count
+        self._numerics_kv_gen = np.zeros((num_blocks,), np.int64)
 
         # params ride as ARGUMENTS (a closure would bake 4 bytes/param
         # into the serialized HLO — megabytes that also defeat donation)
@@ -968,6 +985,9 @@ class ServingEngine:
                                  if total else 0.0),
             "slots": [None if s is None else req(s) for s in self.slots],
             "queue": [req(r) for r in self.queue],
+            # last KV page-scale drift poll (FLAGS_numerics, quantized
+            # pools) — already-fetched host floats, device untouched
+            "kv_scales": self._numerics_kv_last,
         }
 
     def _take_notifications(self) -> List[Request]:
@@ -1048,6 +1068,11 @@ class ServingEngine:
             self.queue.pop(0)
             self._hol_wait_steps = 0
             blocks = [self.free_blocks.pop() for _ in range(need)]
+            if self._numerics_kv:
+                # bump the pages' allocation generation so the numerics
+                # scale-drift poll can tell requantization of LIVE pages
+                # from free->re-admit churn between two polls
+                self._numerics_kv_gen[blocks] += 1
             self.tables[i, :] = 0
             self.tables[i, :need] = blocks
             self.lens[i] = 0
@@ -1296,10 +1321,67 @@ class ServingEngine:
                 out = self._step_two_program()
             if self._health == "loading":
                 self._health = "ready"
+            self._numerics_kv_poll()
             # admission-time rejections land in _notify DURING the path
             # body — drain them now so a run that ends this step still
             # reports them
             return terminal + out + self._take_notifications()
+
+    def _numerics_kv_poll(self) -> None:
+        """KV-pool page-scale drift telemetry (FLAGS_numerics, quantized
+        pools): every telemetry interval, fetch the per-(head, page)
+        scales and export gauges — ``kv_scale_max`` / ``kv_scale_mean``
+        over live pages, ``kv_pages_live`` and ``kv_scale_regrew_frac``
+        (fraction of live pages whose scale GREW since the last poll —
+        each growth requantized that page's existing tokens onto a
+        coarser grid) — plus one role-tagged ``numerics_kv`` JSONL
+        event. Host-side read of device state; never changes the
+        compiled program."""
+        if (not self._numerics_kv
+                or self.engine_steps % self._numerics_kv_interval):
+            return
+        import jax
+        ks, vs = jax.device_get((self.k_scales, self.v_scales))
+        cur = np.maximum(np.max(np.asarray(ks, np.float32), axis=0),
+                         np.max(np.asarray(vs, np.float32), axis=0))
+        # page axis is last ([L/H, ..., NB] — reduce everything else)
+        cur = cur.reshape(-1, cur.shape[-1]).max(axis=0)   # [NB]
+        # liveness from the HOST pool accounting, not scale > 0: freed
+        # pages keep their stale scale until re-admission zeroes it
+        # (reset_page_scales runs at re-admit), so scale alone would
+        # count dead pages and read allocation churn as drift
+        alloc = np.ones(cur.shape[0], bool)
+        alloc[0] = False  # reserved scratch block
+        if self.free_blocks:
+            alloc[np.asarray(self.free_blocks, np.int64)] = False
+        live = alloc & (cur > 0.0)  # allocated AND written
+        n_live = int(live.sum())
+        prev = self._numerics_kv_prev
+        grew = 0.0
+        if prev is not None:
+            # requantization drift: pages live at BOTH polls with the
+            # SAME allocation generation (a page freed + re-admitted in
+            # between regrew its scale from the reset, not from
+            # re-rounding existing tokens) whose scale grew
+            both = (live & prev["live"]
+                    & (self._numerics_kv_gen == prev["gen"]))
+            if both.any():
+                grew = float(np.sum(both & (cur > prev["page_max"]
+                                            + 1e-12)) / both.sum())
+        stats = {
+            "kv_scale_max": float(cur[live].max()) if n_live else 0.0,
+            "kv_scale_mean": float(cur[live].mean()) if n_live else 0.0,
+            "kv_pages_live": float(n_live),
+            "kv_scale_regrew_frac": grew,
+        }
+        for name, v in stats.items():
+            self._prom.gauge_set(name, v,
+                                 help="numerics: KV-pool page-scale "
+                                      "drift (FLAGS_numerics)")
+        self._numerics_kv_prev = {"page_max": cur, "live": live,
+                                  "gen": self._numerics_kv_gen.copy()}
+        self._numerics_kv_last = stats
+        self._emit_event("numerics_kv", step=self.engine_steps, **stats)
 
     def _step_two_program(self) -> List[Request]:
         """The frozen parity baseline: one batched prefill-chunk dispatch

@@ -836,6 +836,62 @@ def _note_zero3_wire(z3, params, pp_axis, num_microbatches: int,
         param_itemsize=jnp.dtype(p0.dtype).itemsize))
 
 
+def _act_stats(x):
+    """Per-layer activation health of one block output (trace-time, fp32):
+    mean-square (rms after the host sqrt) and absmax — the numerics
+    deposit each scan body makes when the plan's `act` is on.
+    stop_gradient at the source: the stats are diagnostics riding the
+    aux channel, and the downstream pmax has no differentiation rule."""
+    xf = lax.stop_gradient(x).astype(jnp.float32)
+    return {"sq": jnp.mean(xf * xf), "am": jnp.max(jnp.abs(xf))}
+
+
+def _scatter_layer_stats(ys, pp_axis):
+    """This pp rank's stacked per-layer stats [L_local] scattered into
+    the GLOBAL layer vector [L_local x pp] at the rank's slice (zeros
+    elsewhere) — the pipeline aux channel's psum over pp then assembles
+    the full vector with no overlap. Shared by the gpt and llama hybrid
+    losses."""
+    L_loc = int(ys["sq"].shape[0])
+    Lg = L_loc * lax.axis_size(pp_axis)
+    pos = lax.axis_index(pp_axis) * L_loc
+    return jax.tree.map(
+        lambda v: lax.dynamic_update_slice(
+            jnp.zeros((Lg,), jnp.float32), v.astype(jnp.float32), (pos,)),
+        ys)
+
+
+def _pack_num_aux(out, ys, num_act, pp_axis, extra=None):
+    """ONE copy of the stage-aux packaging every scan branch shares
+    (gpt + llama): the per-layer activation stats when the numerics
+    plan asks, merged next to any existing side-channel entries (the
+    z3ef residuals). Plain `out` when there is no aux — the pipeline
+    is then called without with_aux and the program is
+    bitwise-unchanged."""
+    if extra is None and not num_act:
+        return out
+    aux = dict(extra or {})
+    if num_act:
+        aux["num"] = _scatter_layer_stats(ys, pp_axis)
+    return out, aux
+
+
+def _deposit_act_stats(aux, M: int, axes):
+    """Observe the per-layer activation series from the pipeline aux
+    (summed over the M valid ticks — /M is the mean over microbatches;
+    rms additionally pmeans and absmax pmaxes over the data axes so the
+    replicated telemetry row is rank-identical). Shared gpt/llama."""
+    from ..observability import metrics as _metrics
+    sq = aux["sq"] / float(M)
+    am = aux["am"] / float(M)
+    if axes:
+        sq = lax.pmean(sq, axes)
+        am = lax.pmax(am, axes)
+    for i in range(int(sq.shape[0])):
+        _metrics.observe(f"num_act_rms_l{i}", jnp.sqrt(sq[i]))
+        _metrics.observe(f"num_act_absmax_l{i}", am[i])
+
+
 def _moe_pipeline(params, x_mb, cfg: GPTConfig, M: int, pp_axis, mp_axis,
                   ep_axis, mcfg, moe_ef, flash=None, z3=None):
     """1F1B pipeline over (dense, MoE) layer pairs with the aux side
@@ -979,7 +1035,7 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
                    mp_axis="mp", virtual_pp: int = 1,
                    schedule: str = "1F1B", fp8=None, sp=None,
                    ep_axis="ep", moe=None, moe_ef=None, flash=None,
-                   sep_axis="sep", z3=None, z3_ef=None):
+                   sep_axis="sep", z3=None, z3_ef=None, num=None):
     """Per-device loss of the full hybrid GPT (runs inside shard_map).
 
     tokens/labels: this dp shard's batch [b_local, S]. virtual_pp > 1 runs
@@ -1029,6 +1085,17 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
     this rank's stacked int8-EF residual tree when the block gathers are
     quantized — the return value then becomes (loss, new_z3_ef)
     (pp degree 1, one pipeline microbatch, enforced at build).
+
+    num: None or an observability.numerics.NumericsConfig — with
+    num.act the dense block scan additionally emits each layer's
+    activation mean-square/absmax as scan ys, the pipeline aux channel
+    assembles the global per-layer vectors (each pp rank scatters its
+    slice; valid-tick masked, psum'd over pp), and the loss observes
+    the ``num_act_rms_l<i>`` / ``num_act_absmax_l<i>`` telemetry series
+    (mean over microbatches, pmean/pmax over the data axes so the
+    replicated row is rank-identical). Plain-1F1B dense path only (the
+    aux channel); per-layer GRAD norms are engine-side and cover every
+    schedule. None is bitwise-unchanged.
     """
     b_local, S = tokens.shape
     M = num_microbatches
@@ -1102,57 +1169,75 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
 
     moe_stats = None
     new_z3_ef = None
+    num_aux = None
+    num_act = num is not None and num.act
+    if num_act:
+        enforce(not moe_on and virtual_pp == 1 and schedule == "1F1B",
+                "per-layer activation telemetry rides the plain 1F1B "
+                "pipeline's aux channel (the builders disable num.act "
+                "for MoE/ZBH1/VPP — per-layer grad norms stay on)",
+                op="gpt.hybrid_loss_fn")
     if moe_on:
         out, moe_stats, new_moe_ef = _moe_pipeline(
             params, x_mb, cfg, M, pp_axis, mp_axis, ep_axis, moe, moe_ef,
             flash=flash, z3=z3)
     else:
+        def _y(out):
+            # per-layer scan output: activation health when the numerics
+            # plan asks for it (None keeps the scan ys empty — bitwise)
+            return _act_stats(out) if num_act else None
+
         def stage_fn(block_params, h):
             if fp8 is not None:
                 blocks, scales = block_params
                 if z3 is not None:
                     def blk_fn(p, c, f):
-                        return _block_fn(p, c, cfg, mp_axis, fp8=f,
-                                         sp=sp, flash=flash,
-                                         sep_axis=sep_axis), None
-                    out, _, _ = _z3g.scan_gather(
+                        o = _block_fn(p, c, cfg, mp_axis, fp8=f,
+                                      sp=sp, flash=flash,
+                                      sep_axis=sep_axis)
+                        return o, _y(o)
+                    out, ys, _ = _z3g.scan_gather(
                         blk_fn, h, blocks, z3["zdims"]["blocks"],
                         z3["axis"], extras=(scales,), cfg=z3["cfg"])
-                    return out
-
-                def body(carry, pf):
-                    p, f = pf
-                    return _block_fn(p, carry, cfg, mp_axis, fp8=f,
-                                     sp=sp, flash=flash,
-                                     sep_axis=sep_axis), None
-                out, _ = lax.scan(body, h, (blocks, scales))
-                return out
+                else:
+                    def body(carry, pf):
+                        p, f = pf
+                        o = _block_fn(p, carry, cfg, mp_axis, fp8=f,
+                                      sp=sp, flash=flash,
+                                      sep_axis=sep_axis)
+                        return o, _y(o)
+                    out, ys = lax.scan(body, h, (blocks, scales))
+                return _pack_num_aux(out, ys, num_act, pp_axis)
 
             if z3 is not None and z3_ef is not None:
                 blocks, resid = block_params
 
                 def blk_fn(p, c):
-                    return _block_fn(p, c, cfg, mp_axis, sp=sp,
-                                     flash=flash, sep_axis=sep_axis), None
-                out, _, nres = _z3g.scan_gather(
+                    o = _block_fn(p, c, cfg, mp_axis, sp=sp,
+                                  flash=flash, sep_axis=sep_axis)
+                    return o, _y(o)
+                out, ys, nres = _z3g.scan_gather(
                     blk_fn, h, blocks, z3["zdims"]["blocks"], z3["axis"],
                     cfg=z3["cfg"], residuals=resid)
-                return out, {"z3ef": nres}
+                return _pack_num_aux(out, ys, num_act, pp_axis,
+                                     extra={"z3ef": nres})
 
             if z3 is not None:
                 def blk_fn(p, c):
-                    return _block_fn(p, c, cfg, mp_axis, sp=sp,
-                                     flash=flash, sep_axis=sep_axis), None
-                out, _, _ = _z3g.scan_gather(
+                    o = _block_fn(p, c, cfg, mp_axis, sp=sp,
+                                  flash=flash, sep_axis=sep_axis)
+                    return o, _y(o)
+                out, ys, _ = _z3g.scan_gather(
                     blk_fn, h, block_params, z3["zdims"]["blocks"],
                     z3["axis"], cfg=z3["cfg"])
-                return out
+                return _pack_num_aux(out, ys, num_act, pp_axis)
 
             def body(carry, p):
-                return _block_fn(p, carry, cfg, mp_axis, sp=sp,
-                                 flash=flash, sep_axis=sep_axis), None
-            out, _ = lax.scan(body, h, block_params)
-            return out
+                o = _block_fn(p, carry, cfg, mp_axis, sp=sp,
+                              flash=flash, sep_axis=sep_axis)
+                return o, _y(o)
+            out, ys = lax.scan(body, h, block_params)
+            return _pack_num_aux(out, ys, num_act, pp_axis)
 
         stage_params = (params["blocks"] if fp8 is None
                         else (params["blocks"], fp8))
@@ -1163,6 +1248,7 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
             out, aux = spmd_pipeline(stage_fn, (params["blocks"], z3_ef),
                                      x_mb, axis=pp_axis, with_aux=True)
             new_z3_ef = aux["z3ef"]
+            num_aux = aux.get("num")
         elif virtual_pp > 1:
             out = spmd_pipeline_interleaved(
                 stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp),
@@ -1170,6 +1256,12 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         elif schedule == "ZBH1":
             out = spmd_pipeline_zero_bubble(stage_fn, params["blocks"],
                                             x_mb, axis=pp_axis)
+        elif num_act:
+            # the activation stats ride the same valid-tick-masked aux
+            # side channel the MoE routing stats use
+            out, aux = spmd_pipeline(stage_fn, stage_params, x_mb,
+                                     axis=pp_axis, with_aux=True)
+            num_aux = aux["num"]
         else:
             out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
     out = out.reshape(b_local, x.shape[1], cfg.hidden_size)
@@ -1200,6 +1292,13 @@ def hybrid_loss_fn(params, tokens, labels, cfg: GPTConfig,
         _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
                       jax.tree.leaves(params["blocks"])[0].shape[0],
                       virtual_pp=virtual_pp)
+    if num_aux is not None:
+        # sp shards the sequence over mp (per-rank shards differ); plain
+        # TP replicates the activations, so mp needs no reduction there
+        _deposit_act_stats(num_aux, M,
+                           (dp_axis,)
+                           + ((mp_axis,) if sp is not None else ())
+                           + ((sep_axis,) if sep_on else ()))
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
     if moe_on:
@@ -1258,7 +1357,8 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                             fp8="auto", telemetry="auto",
                             mp_overlap="auto", ep_axis="ep",
                             moe_dispatch="auto", moe_ef_tokens=None,
-                            flash_attention="auto", sep_axis="sep"):
+                            flash_attention="auto", sep_axis="sep",
+                            numerics="auto"):
     """Compile the full hybrid train step: one program containing embedding,
     pipelined blocks, vocab-parallel loss, backward, dp grad sync and the
     optimizer update. Returns (step_fn, shard_params_fn, init_state_fn).
@@ -1339,6 +1439,17 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
     flash as the inner kernel — requires the axis on the mesh, S
     divisible by its degree (trace-time), no mp sequence parallelism
     and no MoE; "ulysses" further needs heads/mp divisible by sep.
+
+    numerics: "auto" (FLAGS_numerics, default off) / None / bool /
+    observability.numerics.NumericsConfig — in-program tensor-health
+    telemetry riding the telemetry ring (ISSUE 15): per-stacked-layer
+    grad norms (every schedule; MoE sums the dense+moe pair per index),
+    per-layer activation rms/absmax deposited from the block scan
+    (plain-1F1B dense path — the pipeline aux channel), EF-residual
+    norms for whichever of comm_ef/moe_ef/zero3_ef the build threads,
+    and fp8 per-site scale saturation/headroom. Implies a (non-strict)
+    telemetry config when FLAGS_telemetry is off. Off compiles
+    BITWISE-identically (tier-1 asserted).
     """
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
@@ -1533,6 +1644,18 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
             z3_engine = {"ef": {"init": ef_init, "specs": ef_specs},
                          "meta": z3cfg.meta()}
 
+    # -- numerics plan (tensor-health telemetry; ISSUE 15) ----------------
+    from ..observability.numerics import resolve_numerics
+    ncfg = resolve_numerics(
+        numerics,
+        # the stacked block subtree's layer-index count: GPT-MoE stacks
+        # (dense, moe) PAIRS, so its per-layer series span L/2 indices
+        num_layers=(cfg.num_layers // 2 if moe_on else cfg.num_layers),
+        # activation stats need the plain-1F1B aux channel; per-layer
+        # grad norms (engine-side) stay on for every schedule
+        act=(not moe_on and virtual_pp == 1 and schedule == "1F1B"),
+        pp_axis=pp_axis)
+
     if moe_plan is not None and moe_plan["ef"] is not None:
         def loss_fn(p, tokens, labels, moe_ef):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
@@ -1540,14 +1663,14 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                                   virtual_pp=virtual_pp, schedule=schedule,
                                   sp=sp, ep_axis=ep_axis, moe=mcfg,
                                   moe_ef=moe_ef, flash=flash,
-                                  sep_axis=sep_axis, z3=z3plan)
+                                  sep_axis=sep_axis, z3=z3plan, num=ncfg)
     elif fp8_plan is not None:
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, schedule=schedule,
                                   fp8=scales, sp=sp, flash=flash,
-                                  sep_axis=sep_axis, z3=z3plan)
+                                  sep_axis=sep_axis, z3=z3plan, num=ncfg)
     elif z3_engine is not None and z3_engine["ef"] is not None:
         def loss_fn(p, tokens, labels, z3_ef):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
@@ -1555,7 +1678,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                                   virtual_pp=virtual_pp, schedule=schedule,
                                   sp=sp, ep_axis=ep_axis, moe=mcfg,
                                   flash=flash, sep_axis=sep_axis,
-                                  z3=z3plan, z3_ef=z3_ef)
+                                  z3=z3plan, z3_ef=z3_ef, num=ncfg)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
@@ -1563,7 +1686,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
                                   virtual_pp=virtual_pp, schedule=schedule,
                                   sp=sp, ep_axis=ep_axis, moe=mcfg,
                                   flash=flash, sep_axis=sep_axis,
-                                  z3=z3plan)
+                                  z3=z3plan, num=ncfg)
 
     if moe_on:
         data_spec = P((dp_axis, ep_axis))
@@ -1579,7 +1702,7 @@ def build_hybrid_train_step(cfg: GPTConfig, mesh: Mesh, optimizer,
         grad_reduce_dtype=grad_reduce_dtype, zero_stage=stage,
         zero3=z3_engine,
         comm_overlap=comm_overlap, fp8=fp8_plan, telemetry=telemetry,
-        mp_overlap=sp, moe=moe_plan, flash=flash)
+        mp_overlap=sp, moe=moe_plan, flash=flash, numerics=ncfg)
     # elastic-checkpoint hint (checkpoint.reshard): the stacked-[L] block
     # leaves' STORAGE order is (pp, vpp)-dependent under the interleaved
     # schedule; resume onto a different layout permutes them (fp8_meta's

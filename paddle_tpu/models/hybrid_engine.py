@@ -155,27 +155,18 @@ def _effective_clip(opt):
     return None, None
 
 
-def _spec_axes(spec: P) -> set:
-    s = set()
-    for e in spec:
-        if e is None:
-            continue
-        for a in (e if isinstance(e, tuple) else (e,)):
-            s.add(a)
-    return s
+# ONE copy of the spec-sharding/replication accounting, shared with the
+# EF-residual norms (comm_overlap.quantize.residual_sq_norm) so the
+# numerics telemetry can never drift from the grad-norm/clip rule
+from ..distributed.comm_overlap.quantize import (  # noqa: E402
+    replication_factor as _replication_factor, spec_axes as _spec_axes)
 
 
 def _repl_factor(spec, zd, mesh: Mesh, dp_axis) -> int:
     """How many ranks hold a copy of this leaf: product of mesh axes it is
     NOT sharded over (zd >= 0 adds the ZeRO dp sharding)."""
-    sharded = _spec_axes(spec)
-    if zd is not None and zd >= 0:
-        sharded = sharded | {dp_axis}
-    repl = 1
-    for a in mesh.axis_names:
-        if a not in sharded:
-            repl *= mesh.shape[a]
-    return repl
+    extra = (dp_axis,) if (zd is not None and zd >= 0) else ()
+    return _replication_factor(spec, mesh, extra_sharded=extra)
 
 
 def _global_leaf_reduce(per_leaf, red, leaves_spec, leaves_z, mesh: Mesh,
@@ -223,7 +214,7 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                      grad_reduce_dtype="auto", zero1_dp: bool = False,
                      zero_stage=None, zero3=None,
                      comm_overlap="auto", fp8=None, telemetry="auto",
-                     mp_overlap=None, moe=None, flash=None,
+                     mp_overlap=None, moe=None, flash=None, numerics=None,
                      donate: bool = False):
     """loss_fn(params, tokens, labels) -> scalar, running per-device inside
     shard_map. Returns (jitted_step, shard_params, init_state).
@@ -371,7 +362,21 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     thread it via their own flash_attention="auto"); here it lands in
     the telemetry JSONL header as static["flash"]. A sep-mode plan's
     context-parallel gradients arrive through extra_grad_axes like any
-    other partial-grad axis — no engine special-casing."""
+    other partial-grad axis — no engine special-casing.
+
+    numerics: None, or an observability.numerics.NumericsConfig (the
+    model builders resolve their numerics="auto" off FLAGS_numerics) —
+    in-program tensor-health telemetry riding the SAME ring buffer. The
+    engine then (a) auto-creates a non-strict TelemetryConfig when
+    telemetry resolved off (numerics implies the carry), (b) registers
+    the numerics series (observability.numerics.numerics_series) onto
+    the config from its own live plans — per-stacked-layer grad norms,
+    EF-residual norms for whichever of comm_ef/moe_ef/zero3_ef this
+    build threads, fp8 per-site saturation/headroom — and (c) computes
+    the engine-side values at trace time with the same replication
+    accounting the global-norm clip uses. Models deposit the per-layer
+    activation rms/absmax through observe() (ncfg.act). None compiles
+    bitwise-identically to a build without the argument."""
     if grad_reduce_dtype == "auto":
         from ..distributed.fleet.fleet import fleet as _fleet
         grad_reduce_dtype = _fleet.grad_reduce_dtype()
@@ -510,6 +515,14 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     # -- in-program telemetry (observability) --------------------------------
     from .. import observability as _obs
     tcfg = _obs.telemetry_from_flags() if telemetry == "auto" else telemetry
+    ncfg = numerics
+    if ncfg is not None and tcfg is None:
+        # numerics rides the telemetry carry: a numerics build with
+        # telemetry resolved off gets a non-strict flag-interval config
+        # (the whole point of FLAGS_numerics is one switch)
+        from ..flags import flag as _flag
+        tcfg = _obs.TelemetryConfig(
+            interval=int(_flag("telemetry_interval")), strict=False)
     if tcfg is not None:
         # rewrite (never merge) the build metadata: a config reused for a
         # second build must not carry the previous engine's mesh/bucket
@@ -545,6 +558,58 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 plan, wire_itemsize=1 if ocfg.quantize else None)
             tcfg.static["comm_quantize"] = ocfg.quantize or "none"
             tcfg.static["comm_microbatches"] = ocfg.microbatches
+        tcfg.static.pop("numerics", None)
+
+    # -- numerics: tensor-health series registered from the live plans -------
+    layer_gather_ax = None   # mesh axis sharding the stacked layer dim
+    z_noop_blocks = None     # all-replicated zdims stand-in (zero off)
+    if ncfg is not None:
+        from ..enforce import enforce
+        from ..observability import numerics as _onum
+        if ncfg.num_layers:
+            enforce(example_params is not None
+                    and isinstance(example_params, dict)
+                    and ncfg.block_key in example_params,
+                    "numerics per-layer series need example_params with "
+                    f"the stacked '{ncfg.block_key}' subtree",
+                    op="build_train_step")
+            blocks_ex = example_params[ncfg.block_key]
+            dims0 = {int(l.shape[0]) for l in jax.tree.leaves(blocks_ex)}
+            enforce(dims0 == {int(ncfg.num_layers)},
+                    "numerics num_layers must equal the stacked block "
+                    "leaves' global dim 0", op="build_train_step",
+                    num_layers=int(ncfg.num_layers), dims0=sorted(dims0))
+            d0 = set()
+            for sp_ in jax.tree.leaves(
+                    specs[ncfg.block_key],
+                    is_leaf=lambda x: isinstance(x, P)):
+                d0.add(sp_[0] if len(sp_) else None)
+            enforce(len(d0) == 1,
+                    "per-layer grad norms need every stacked block leaf "
+                    "to shard its layer dim the same way",
+                    op="build_train_step", dim0_entries=sorted(map(str, d0)))
+            layer_gather_ax = d0.pop()
+            if layer_gather_ax is not None:
+                enforce(isinstance(layer_gather_ax, str)
+                        and layer_gather_ax in mesh.axis_names,
+                        "the stacked layer dim's spec entry must be one "
+                        "mesh axis", op="build_train_step",
+                        entry=str(layer_gather_ax))
+            z_noop_blocks = jax.tree.map(lambda _l: -1, blocks_ex)
+        ef_ns = [ns for ns, on in (
+            ("comm_ef", ef_plan is not None),
+            ("moe_ef", moe_plan is not None
+             and moe_plan.get("ef") is not None),
+            ("zero3_ef", z3_ef is not None)) if on]
+        fp8_sites = (tuple(fp8_plan["specs"]["scale"])
+                     if fp8_plan is not None else ())
+        nser = _onum.numerics_series(ncfg, ef_namespaces=ef_ns,
+                                     fp8_sites=fp8_sites)
+        # register in place (the moe-series discipline: a caller-owned
+        # config decodes from the same object — build before the host)
+        tcfg.extra = tcfg.extra + tuple(s for s in nser
+                                        if s not in tcfg.extra)
+        tcfg.static["numerics"] = ncfg.meta()
 
     # extra state riding the optimizer carry: the step signature and the
     # checkpoint surface stay (params, state, batch..., lr) no matter
@@ -656,6 +721,51 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
     init_state.abstract = abstract_state
     init_state.state_specs = sspec
     init_state.param_specs = pspecs
+    # the RESOLVED telemetry config (numerics may have auto-created or
+    # extended it): flag-driven callers build their TelemetryHost /
+    # NumericsGuard from this so host decode always matches the buffer
+    init_state.telemetry_config = tcfg
+
+    def _layer_gsq(red_blocks, spec_blocks, z_blocks):
+        """Per-stacked-layer-index GLOBAL grad sq norms [L_global],
+        replicated on every rank: each block leaf's per-layer local sum
+        of squares divided by its replication factor (the global-norm
+        clip's accounting), ONE psum over every non-layer mesh axis,
+        then an all-gather over the layer-sharding axis so the telemetry
+        row is rank-identical. Storage order (vpp chunk-major under the
+        interleaved schedule; MoE sums the dense+moe pair per index)."""
+        per = []
+
+        def one(g, sp, zd):
+            if g is not None:
+                gf = g.astype(jnp.float32)
+                per.append(jnp.sum(gf * gf,
+                                   axis=tuple(range(1, gf.ndim)))
+                           / _repl_factor(sp, zd, mesh, dp_axis))
+            return g
+        jax.tree.map(one, red_blocks, spec_blocks, z_blocks,
+                     is_leaf=lambda x: x is None)
+        if not per:
+            return None
+        acc = sum(per)
+        other = tuple(a for a in mesh.axis_names if a != layer_gather_ax)
+        if other:
+            acc = lax.psum(acc, other)
+        if layer_gather_ax is not None:
+            acc = lax.all_gather(acc, layer_gather_ax, axis=0, tiled=True)
+        return acc
+
+    def _numerics_layer_tele(tele, red_tree, z_blocks):
+        """Fold the per-layer grad series into a tele dict (no-op unless
+        the numerics plan registered them)."""
+        if (ncfg is not None and ncfg.num_layers
+                and z_noop_blocks is not None
+                and isinstance(red_tree, dict)
+                and ncfg.block_key in red_tree):
+            tele["layer_gsq"] = _layer_gsq(red_tree[ncfg.block_key],
+                                           specs[ncfg.block_key],
+                                           z_blocks)
+        return tele
 
     def _zero_apply(params, grads, opt_state, lr, pre_reduced=False):
         """Per-leaf ZeRO update inside shard_map, all stages.
@@ -728,6 +838,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 "nonfinite": _global_nonfinite_count(
                     red, leaves_spec, leaves_z, mesh, dp_axis),
             }
+            if ncfg is not None and ncfg.num_layers:
+                _numerics_layer_tele(
+                    tele, jax.tree.unflatten(treedef, red),
+                    zdims[ncfg.block_key])
             # wire accounting (trace-time constants): RS/pmean of the
             # grads (unless the overlap scan already counted them) + the
             # param all-gather that closes every stage-1/2 step. Stage-3
@@ -952,9 +1066,10 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
             lg = treedef.flatten_up_to(grads)
             lsp = treedef.flatten_up_to(specs)
             lz = [-1] * len(lg)
-            return {"grad_sq": _global_sq_norm(lg, lsp, lz, mesh, dp_axis),
+            tele = {"grad_sq": _global_sq_norm(lg, lsp, lz, mesh, dp_axis),
                     "nonfinite": _global_nonfinite_count(lg, lsp, lz, mesh,
                                                          dp_axis)}
+            return _numerics_layer_tele(tele, grads, z_noop_blocks)
 
         def rewrap(new_params, new_state, new_ef, new_fmeta, loss, *,
                    tele=None, amax=None, obs=None):
@@ -966,6 +1081,21 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                 vals["loss"] = loss
                 vals["grad_norm"] = jnp.sqrt(tele["grad_sq"])
                 vals["nonfinite_count"] = tele["nonfinite"]
+                if ncfg is not None:
+                    lg = tele.get("layer_gsq")
+                    if lg is not None:
+                        for i in range(int(ncfg.num_layers)):
+                            vals[f"num_gnorm_l{i}"] = jnp.sqrt(lg[i])
+                    # EF residual norms: forward-side carry health, the
+                    # same replication accounting as the grad norm
+                    from ..distributed.comm_overlap.quantize import \
+                        residual_sq_norm
+                    for ns, tree in (("comm_ef", new_ef), ("moe_ef", mef),
+                                     ("zero3_ef", zef)):
+                        if ns in wrap_specs and tree is not None:
+                            vals[_obs.numerics.EF_SERIES[ns]] = jnp.sqrt(
+                                residual_sq_norm(tree, wrap_specs[ns],
+                                                 mesh))
                 # mp/ep a2a bytes are per loss CALL — the overlap scan
                 # calls the loss once per comm microbatch on the split
                 # batch
@@ -1033,6 +1163,17 @@ def build_train_step(loss_fn: Callable, specs: Dict[str, Any], mesh: Mesh,
                     fp8_loss, argnums=(0, 1))(params, _f8.scales_of(fmeta))
             if fp8_axes:
                 amax = jax.tree.map(lambda a: lax.pmax(a, fp8_axes), amax)
+            if tcfg is not None and ncfg is not None:
+                # scale health vs the delayed scales this step USED
+                # (pre-rotation) — saturation > 1 means the cast
+                # clipped; pmax over EVERY mesh axis (the stacked pp
+                # axis included — amax itself never reduces over it, so
+                # each rank's local max only covers its own layers and
+                # the replicated row must still be rank-identical)
+                obs = dict(obs)
+                obs.update(_obs.numerics.fp8_site_health(
+                    amax, _f8.scales_of(fmeta),
+                    axes=tuple(mesh.axis_names)))
             fmeta = _f8.update_fp8_meta(fmeta, amax)
             if zero_stage:
                 new_params, new_state, z1t = _zero_apply(params, grads,

@@ -517,7 +517,7 @@ def dense_loss(params, tokens, labels, cfg: LlamaConfig, remat: bool = True,
 def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
                    num_microbatches: int, dp_axis="dp", pp_axis="pp",
                    mp_axis="mp", virtual_pp: int = 1, fp8=None, sp=None,
-                   flash=None, sep_axis="sep", z3=None):
+                   flash=None, sep_axis="sep", z3=None, num=None):
     """Per-device loss of the full hybrid Llama (inside shard_map). fp8:
     this pp rank's stacked [L/pp] delayed scales (1F1B only — see
     gpt.hybrid_loss_fn). sp: None or comm_overlap.MpOverlapConfig —
@@ -530,7 +530,9 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     already-rotated K blocks). z3: None or the ZeRO-3 gather-on-use plan
     (see gpt.hybrid_loss_fn — dp-sharded params, per-layer all-gathers
     inside the stage scan; the llama builder's stage 3 is always the
-    unquantized gather)."""
+    unquantized gather). num: None or a numerics plan — with num.act
+    the block scan emits per-layer activation rms/absmax through the
+    pipeline aux channel (plain-1F1B path; see gpt.hybrid_loss_fn)."""
     b_local, S = tokens.shape
     M = num_microbatches
     enforce(b_local % M == 0,
@@ -576,48 +578,68 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
         x = _cm.scatter_seq(x, mp_axis, dim=1)  # [b_local, S/mp, H]
     x_mb = x.reshape(M, b_local // M, x.shape[1], cfg.hidden_size)
 
+    num_act = num is not None and num.act
+    if num_act:
+        enforce(virtual_pp == 1,
+                "per-layer activation telemetry rides the plain 1F1B "
+                "pipeline's aux channel (the builder disables num.act "
+                "for VPP — per-layer grad norms stay on)",
+                op="llama.hybrid_loss_fn")
+    from .gpt import _act_stats, _deposit_act_stats, _pack_num_aux
+
+    def _y(out):
+        return _act_stats(out) if num_act else None
+
     def stage_fn(block_params, h):
         if fp8 is not None:
             blocks, scales = block_params
             if z3 is not None:
                 def blk_fn(p, c, f):
-                    return _block_fn(p, c, cos, sin, cfg, mp_axis,
-                                     fp8=f, sp=sp, flash=flash,
-                                     sep_axis=sep_axis), None
-                out, _, _ = _z3g.scan_gather(
+                    o = _block_fn(p, c, cos, sin, cfg, mp_axis,
+                                  fp8=f, sp=sp, flash=flash,
+                                  sep_axis=sep_axis)
+                    return o, _y(o)
+                out, ys, _ = _z3g.scan_gather(
                     blk_fn, h, blocks, z3["zdims"]["blocks"],
                     z3["axis"], extras=(scales,), cfg=z3["cfg"])
-                return out
-
-            def body(carry, pf):
-                p, f = pf
-                return _block_fn(p, carry, cos, sin, cfg, mp_axis,
-                                 fp8=f, sp=sp, flash=flash,
-                                 sep_axis=sep_axis), None
-            out, _ = lax.scan(body, h, (blocks, scales))
-            return out
+            else:
+                def body(carry, pf):
+                    p, f = pf
+                    o = _block_fn(p, carry, cos, sin, cfg, mp_axis,
+                                  fp8=f, sp=sp, flash=flash,
+                                  sep_axis=sep_axis)
+                    return o, _y(o)
+                out, ys = lax.scan(body, h, (blocks, scales))
+            return _pack_num_aux(out, ys, num_act, pp_axis)
 
         if z3 is not None:
             def blk_fn(p, c):
-                return _block_fn(p, c, cos, sin, cfg, mp_axis, sp=sp,
-                                 flash=flash, sep_axis=sep_axis), None
-            out, _, _ = _z3g.scan_gather(
+                o = _block_fn(p, c, cos, sin, cfg, mp_axis, sp=sp,
+                              flash=flash, sep_axis=sep_axis)
+                return o, _y(o)
+            out, ys, _ = _z3g.scan_gather(
                 blk_fn, h, block_params, z3["zdims"]["blocks"],
                 z3["axis"], cfg=z3["cfg"])
-            return out
+            return _pack_num_aux(out, ys, num_act, pp_axis)
 
         def body(carry, p):
-            return _block_fn(p, carry, cos, sin, cfg, mp_axis, sp=sp,
-                             flash=flash, sep_axis=sep_axis), None
-        out, _ = lax.scan(body, h, block_params)
-        return out
+            o = _block_fn(p, carry, cos, sin, cfg, mp_axis, sp=sp,
+                          flash=flash, sep_axis=sep_axis)
+            return o, _y(o)
+        out, ys = lax.scan(body, h, block_params)
+        return _pack_num_aux(out, ys, num_act, pp_axis)
 
     stage_params = (params["blocks"] if fp8 is None
                     else (params["blocks"], fp8))
+    num_aux = None
     if virtual_pp > 1:
         out = spmd_pipeline_interleaved(
             stage_fn, vpp_chunk_blocks(params["blocks"], virtual_pp), x_mb,
             axis=pp_axis)
+    elif num_act:
+        out, aux = spmd_pipeline(stage_fn, stage_params, x_mb,
+                                 axis=pp_axis, with_aux=True)
+        num_aux = aux["num"]
     else:
         out = spmd_pipeline(stage_fn, stage_params, x_mb, axis=pp_axis)
     out = out.reshape(b_local, x.shape[1], cfg.hidden_size)
@@ -639,6 +661,11 @@ def hybrid_loss_fn(params, tokens, labels, cfg: LlamaConfig,
     _note_mp_wire(cfg, tokens, sp, mp_axis, pp_axis, M,
                   jax.tree.leaves(params["blocks"])[0].shape[0],
                   virtual_pp=virtual_pp)
+    if num_aux is not None:
+        _deposit_act_stats(num_aux, M,
+                           (dp_axis,)
+                           + ((mp_axis,) if sp is not None else ())
+                           + ((sep_axis,) if sep_on else ()))
     loss, valid = _vocab_parallel_ce(logits_local, labels, mp_axis)
     total = jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
     if sep_on:
@@ -655,7 +682,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                             zero1_dp: bool = False, zero_stage="auto",
                             zero3="auto", fp8="auto",
                             telemetry="auto", mp_overlap="auto",
-                            flash_attention="auto", sep_axis="sep"):
+                            flash_attention="auto", sep_axis="sep",
+                            numerics="auto"):
     """mp_overlap: "auto" (FLAGS_mp_seq_parallel / FLAGS_mp_collective_
     matmul) / None / mode string / MpOverlapConfig — sequence-parallel TP
     with optional ring collective matmul; see gpt.build_hybrid_train_step
@@ -675,7 +703,13 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
     explicit config so plans stay flag-independent); the llama
     builder's stage 3 is always the UNQUANTIZED gather (the
     narrower-surface convention — a quantizing config is refused here;
-    the gpt builder carries the int8-EF path)."""
+    the gpt builder carries the int8-EF path).
+
+    numerics: "auto" (FLAGS_numerics) / None / bool / NumericsConfig —
+    in-program tensor-health telemetry (per-layer grad norms every
+    schedule, activation rms/absmax on the plain-1F1B path, EF/fp8
+    health); see gpt.build_hybrid_train_step. Off compiles
+    BITWISE-identically."""
     from .hybrid_engine import build_train_step
     from ..quantization import fp8 as _f8
     from ..distributed.comm_overlap.collective_matmul import \
@@ -740,20 +774,25 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
                   "other_leaves": ("wte", "lnf_g", "head_w")}
         z3_engine = {"ef": None, "meta": z3cfg.meta()}
 
+    # -- numerics plan (tensor-health telemetry; ISSUE 15) ----------------
+    from ..observability.numerics import resolve_numerics
+    ncfg = resolve_numerics(numerics, num_layers=cfg.num_layers,
+                            act=(virtual_pp == 1), pp_axis=pp_axis)
+
     if fp8_plan is not None:
         def loss_fn(p, tokens, labels, scales):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, fp8=scales, sp=sp,
                                   flash=flash, sep_axis=sep_axis,
-                                  z3=z3plan)
+                                  z3=z3plan, num=ncfg)
     else:
         def loss_fn(p, tokens, labels):
             return hybrid_loss_fn(p, tokens, labels, cfg, num_microbatches,
                                   dp_axis, pp_axis, mp_axis,
                                   virtual_pp=virtual_pp, sp=sp,
                                   flash=flash, sep_axis=sep_axis,
-                                  z3=z3plan)
+                                  z3=z3plan, num=ncfg)
 
     step, shard_params, init_state = build_train_step(
         loss_fn, specs, mesh, optimizer, dp_axis=dp_axis,
@@ -761,7 +800,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
         extra_grad_axes=extra_grad_axes, example_params=example,
         grad_reduce_dtype=grad_reduce_dtype, zero_stage=stage,
         zero3=z3_engine,
-        fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp, flash=flash)
+        fp8=fp8_plan, telemetry=telemetry, mp_overlap=sp, flash=flash,
+        numerics=ncfg)
     # elastic-checkpoint hint: see gpt.build_hybrid_train_step
     init_state.layout_extra["pp"] = {
         "num_layers": int(cfg.num_layers), "pp": int(mesh.shape[pp_axis]),

@@ -56,6 +56,10 @@ from .metrics import (BUILTIN_SERIES, TelemetryConfig, TelemetryHost,
                       note_ep_comm, note_mp_comm, note_zero3_comm, observe,
                       telemetry_from_flags, update_buffer,
                       zero3_ag_wire_bytes)
+from . import numerics
+from .numerics import (DetectorConfig, NumericsConfig, NumericsGuard,
+                       NumericsMonitor, numerics_from_flags,
+                       resolve_numerics)
 from .profile_reader import (MeasuredRates, ProfileWindow,
                              capture_step_profile, derive_hardware_profile,
                              hlo_census, load_profile_json,
@@ -85,4 +89,6 @@ __all__ = [
     "measure_collective_rates", "MeasuredRates", "ProfileWindow",
     "TelemetryAggregator", "detect_stragglers",
     "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+    "numerics", "NumericsConfig", "NumericsMonitor", "NumericsGuard",
+    "DetectorConfig", "numerics_from_flags", "resolve_numerics",
 ]

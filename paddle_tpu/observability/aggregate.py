@@ -224,6 +224,14 @@ class TelemetryAggregator:
                                 help="per-host window-median step ms")
             self.prom.gauge_set(f"step_ms_p95_host{h}", s["p95_ms"],
                                 help="per-host window-p95 step ms")
+        for h, p in by_host.items():
+            # numerics fleet view: a host whose TelemetryHost exports the
+            # decoded grad-norm (prom=) surfaces it in the rank-0 scrape
+            gn = p.get("prom", {}).get("train_grad_norm")
+            if gn is not None:
+                self.prom.gauge_set(f"grad_norm_host{h}", float(gn),
+                                    help="per-host latest decoded global "
+                                         "grad norm")
         if det["fleet_median_ms"] is not None:
             self.prom.gauge_set("fleet_step_ms_median",
                                 det["fleet_median_ms"],

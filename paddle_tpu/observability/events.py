@@ -206,6 +206,13 @@ def merge_event_streams(*logs, out_path: Optional[str] = None,
     missing a ``role`` (pre-rotation history, foreign producers) get one
     from `roles` — {stream_index: role} — defaulting to "stream<i>".
 
+    Size-cap rotation is transparent: when a stream's live file has a
+    rotated ``<path>.1`` generation next to it, that generation's
+    records are read FIRST (they are strictly older — the rotation
+    renamed the whole previous file), so a capped long-running log
+    merges as one unbroken timeline instead of silently dropping its
+    oldest half.
+
     out_path: also write the merged records as JSONL. Returns the merged
     record list.
     """
@@ -215,11 +222,14 @@ def merge_event_streams(*logs, out_path: Optional[str] = None,
         path = log.path if isinstance(log, EventLog) else str(log)
         fallback = (roles or {}).get(
             i, log.role if isinstance(log, EventLog) else f"stream{i}")
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                lines = f.read().splitlines()
-        except OSError:
-            continue
+        lines: List[str] = []
+        # rotated generation first: all of <path>.1 predates <path>
+        for p in (path + ".1", path):
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    lines.extend(f.read().splitlines())
+            except OSError:
+                continue
         for rec_no, line in enumerate(lines):
             try:
                 rec = json.loads(line)

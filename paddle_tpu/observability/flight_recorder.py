@@ -20,6 +20,9 @@ at that moment is dumped as ONE bounded bundle directory under
   last-heartbeat ages, the last straggler report): *who else* was alive;
 * ``profile_window.json`` — the active profile-capture window, if one
   was open when the hang hit;
+* ``numerics.json``     — every live numerics monitor's last-K
+  per-layer/EF/fp8 stats + detector/episode state (ISSUE 15; the
+  monitor also dumps a bundle itself on a ``numerics_anomaly``);
 * ``report.txt``        — the watchdog's thread-stack report.
 
 The recorder is pull-based: sources register weakly (TelemetryHost,
@@ -44,12 +47,13 @@ from typing import Any, Dict, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
            "maybe_dump", "register_telemetry_host", "register_aggregator",
-           "register_serving_engine"]
+           "register_serving_engine", "register_numerics_monitor"]
 
 _SRC_LOCK = threading.Lock()
 _TELEMETRY_HOSTS: "weakref.WeakSet" = weakref.WeakSet()
 _AGGREGATORS: "weakref.WeakSet" = weakref.WeakSet()
 _SERVING_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_NUMERICS_MONITORS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_telemetry_host(host) -> None:
@@ -73,6 +77,15 @@ def register_serving_engine(engine) -> None:
     (called by ServingEngine.__init__; ISSUE 13)."""
     with _SRC_LOCK:
         _SERVING_ENGINES.add(engine)
+
+
+def register_numerics_monitor(monitor) -> None:
+    """Weakly track a numerics NumericsMonitor so EVERY crash bundle —
+    hang, SIGTERM, nonfinite abort or the monitor's own anomaly dump —
+    gains ``numerics.json`` (last-K per-layer stats + detector state;
+    called by NumericsMonitor.__init__, ISSUE 15)."""
+    with _SRC_LOCK:
+        _NUMERICS_MONITORS.add(monitor)
 
 
 from .events import _jsonable  # one coercion for bundles AND the log
@@ -163,6 +176,7 @@ class FlightRecorder:
             hosts = list(_TELEMETRY_HOSTS)
             aggs = list(_AGGREGATORS)
             engines = list(_SERVING_ENGINES)
+            monitors = list(_NUMERICS_MONITORS)
         tele = {}
         for i, h in enumerate(hosts):
             try:
@@ -204,6 +218,17 @@ class FlightRecorder:
                 continue
         if serving:
             self._write_json(path, "serving.json", serving)
+
+        # numerics forensics: last-K per-layer/EF/fp8 stats + detector
+        # state of every live NumericsMonitor (host deques only)
+        num = {}
+        for i, m in enumerate(monitors):
+            try:
+                num[f"numerics_monitor_{i}"] = m.snapshot()
+            except Exception:
+                continue
+        if num:
+            self._write_json(path, "numerics.json", num)
 
         from .profile_reader import active_profile_window
         win = active_profile_window()

@@ -367,14 +367,32 @@ class TelemetryHost:
     (``fetch_count`` says how many — the no-op/overhead tests assert it),
     appends decoded rows to per-series host lists, and mirrors each
     interval into the JSONL event log as a ``telemetry`` event.
-    ``flush(state)`` drains a partial tail interval at end of run."""
+    ``flush(state)`` drains a partial tail interval at end of run.
 
-    def __init__(self, cfg: TelemetryConfig, event_log=None):
+    prom: optional :class:`~paddle_tpu.observability.prom.PromRegistry`
+    — each ingested interval then also exports the engine's
+    already-computed global grad-norm and loss as live metrics instead
+    of living only in the ring: ``train_grad_norm`` / ``train_loss``
+    gauges (latest step) plus per-step ``train_grad_norm_step`` /
+    ``train_loss_step`` summary observations whose recent window gives
+    p50/p95 via ``quantile()`` (ISSUE 15 satellite; the fleet
+    aggregator ships the snapshot to rank-0 gauges)."""
+
+    PROM_SERIES = ("grad_norm", "loss")
+
+    def __init__(self, cfg: TelemetryConfig, event_log=None, prom=None):
         self.cfg = cfg
         self.series: Dict[str, List[float]] = {s: [] for s in cfg.series}
         self.steps: List[int] = []
         self.fetch_count = 0
+        # device-count watermark of rows already decoded: a resilient
+        # run that SKIPS a step keeps a carry whose ring count lags the
+        # polled (discarded) sibling — without the watermark the next
+        # fetch would re-decode overlapping rows as duplicates and
+        # flush()'s tail arithmetic would go negative and drain nothing
+        self._ingested = 0
         self._event_log = event_log
+        self._prom = prom
         self._header_emitted = False
         # crash forensics: the flight recorder includes this host's ring
         # tail in hang bundles (weak registration — no lifetime coupling)
@@ -424,18 +442,41 @@ class TelemetryHost:
         # rows [count-n_rows, count) live at (step % interval); with a full
         # interval that is simply rows 0..interval-1 in step order
         first = count - n_rows
-        rows = [(s, data[s % interval]) for s in range(first, count)]
+        rows = [(s, data[s % interval]) for s in range(first, count)
+                if s >= self._ingested]
+        self._ingested = max(self._ingested, count)
         self._emit_header()
         new = {}
         for step, row in rows:
             self.steps.append(step)
             for i, name in enumerate(self.cfg.series):
-                self.series[name].append(float(row[i]))
+                lst = self.series.get(name)
+                if lst is None:
+                    # a series REGISTERED on the shared config after this
+                    # host already ingested rows (an engine build extends
+                    # the extras — MoE, numerics): pad its history so
+                    # every list stays positionally aligned with `steps`
+                    # (tail() and rewind() slice/truncate by position)
+                    lst = self.series[name] = (
+                        [float("nan")] * (len(self.steps) - 1))
+                lst.append(float(row[i]))
                 new.setdefault(name, []).append(float(row[i]))
         log = self._log()
         if log is not None and rows:
             log.emit("telemetry", first_step=rows[0][0],
                      last_step=rows[-1][0], series=new)
+        if self._prom is not None and rows:
+            for name in self.PROM_SERIES:
+                vals = new.get(name)
+                if not vals:
+                    continue
+                for v in vals:
+                    self._prom.summary_observe(
+                        f"train_{name}_step", float(v),
+                        help=f"per-step {name} decoded from the "
+                             "telemetry ring")
+                self._prom.gauge_set(f"train_{name}", float(vals[-1]),
+                                     help=f"latest decoded {name}")
         return new
 
     def _buf_of(self, state):
@@ -452,14 +493,31 @@ class TelemetryHost:
             return None
         return self._ingest(buf, self.cfg.interval)
 
+    def rewind(self, count: int) -> None:
+        """Rewind to a restored carry's ring count (numerics rollback):
+        drop decoded rows at or past `count` — they belong to the
+        abandoned timeline — and pull the ingest watermark back so the
+        REPLAYED rows re-decode into their place (steps stay unique and
+        monotone; the decode order invariant the watermark enforces)."""
+        count = max(int(count), 0)
+        keep = sum(1 for s in self.steps if s < count)
+        self.steps = self.steps[:keep]
+        for name in self.series:
+            self.series[name] = self.series[name][:keep]
+        self._ingested = min(self._ingested, count)
+
     def flush(self, state) -> Optional[Dict[str, List[float]]]:
-        """Drain the partial tail interval (crash/end-of-run forensics)."""
+        """Drain the partial tail interval (crash/end-of-run forensics).
+        Measured against the ingest WATERMARK, not len(steps): after a
+        numerics skip/rollback the retained carry's count may lag rows
+        already decoded from a discarded sibling, and those rows must
+        be neither re-drained nor allowed to wedge the tail at <= 0."""
         buf = self._buf_of(state)
         if buf is None:
             return None
         import jax
         count = int(jax.device_get(buf["count"]))
-        tail = count - len(self.steps)
+        tail = count - self._ingested
         if tail <= 0:
             return None
         return self._ingest(buf, min(tail, self.cfg.interval))

@@ -62,6 +62,7 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 __all__ = ["E4M3", "E5M2", "E4M3_MAX", "E5M2_MAX", "FP8_REMAT_NAMES",
+           "role_fmax",
            "fp8_enabled", "quantize_fp8", "dequantize_fp8", "fp8_dot",
            "site_mm", "Fp8Linear", "init_fp8_meta", "scales_of",
            "update_fp8_meta", "fp8_meta_specs", "fp8_plan",
@@ -84,6 +85,14 @@ _TINY = 1e-12                   # amax floor — a scale must never be 0
 
 def _fmax(role: str) -> float:
     return E5M2_MAX if role == "g" else E4M3_MAX
+
+
+def role_fmax(role: str) -> float:
+    """Public form of the per-role dtype max (fwd operands are e4m3, the
+    bwd cotangent e5m2) — the numerics telemetry derives each site's
+    scale-saturation ratio amax / (scale x fmax) from it
+    (observability.numerics.fp8_site_health)."""
+    return _fmax(role)
 
 
 def fp8_enabled() -> bool:
