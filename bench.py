@@ -1024,6 +1024,7 @@ def _run_serving_config(jax, G):
     so BENCH_r0N rows carry the single-dispatch numbers the standalone
     `benchmarks/serving_bench.py` measures."""
     from benchmarks.serving_bench import (run_overload_comparison,
+                                          run_router_comparison,
                                           run_single_dispatch_comparison,
                                           scenario)
 
@@ -1043,6 +1044,11 @@ def _run_serving_config(jax, G):
     # off — admitted p99 TTFT vs SLO, shed rate, goodput
     report["overload"] = run_overload_comparison(
         params, cfg, mk, 8, n_req=(64 if on_tpu else 48))
+    # ISSUE 16: 2-replica fleet with one replica killed mid-run vs the
+    # uninterrupted fleet — goodput cost of a journaled failover, with
+    # bitwise-equal outputs (the exactly-once contract)
+    report["router"] = run_router_comparison(
+        params, cfg, mk, 8, n_req=(48 if on_tpu else 32))
     return report
 
 

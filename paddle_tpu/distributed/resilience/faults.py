@@ -47,6 +47,20 @@ Sites currently planted (grep for ``maybe_fail`` /
   a stuck device would
 * ``serving/dispatch``        — immediately before each compiled serving
   program is invoked (prefill / decode burst / unified ragged step)
+* ``router/dispatch``         — in the fleet router, immediately before a
+  request is handed to the chosen replica: a ``raise`` clause makes that
+  dispatch fail (the request requeues, the replica's consecutive-failure
+  count charges toward ``FLAGS_router_max_failures`` quarantine), ``kill``
+  hard-exits the router process itself (ISSUE 16)
+* ``replica/spawn``           — in the router's replica start/probe path,
+  before the engine is built (in-process) or the worker process spawned:
+  arming it proves the quarantine + doubling-backoff probe loop runs
+  (ISSUE 16)
+* ``replica/heartbeat``       — a ``maybe_trigger`` QUERY site in the
+  router's per-replica heartbeat check: the scheduled hit makes the
+  router treat that replica's heartbeat as timed out — the
+  journaled-failover path runs without anyone actually dying, the
+  watchdog-hang pattern applied to liveness (ISSUE 16)
 * ``serving/pool_exhausted``  — the admission loop found the queue head
   pool-blocked (no free KV pages): fires each blocked attempt, so tests
   can prove head-of-line pressure (and the preempt path) actually ran

@@ -734,6 +734,37 @@ define_flag("serving_adaptive_mix", True,
             "from the queue-depth and TTFT telemetry series: admission "
             "pressure shortens the fused decode burst so prefill slices "
             "come around sooner; an idle queue runs full bursts.")
+define_flag("serving_journal_fsync", 0,
+            "fsync the serving delivery journal every N token appends "
+            "(consumed by inference.resilient.ServingJournal). 0 = "
+            "flush-only (the default): every line survives PROCESS death "
+            "(kill -9, os._exit) because the line is in the kernel page "
+            "cache before the callback sees the token, but a HOST crash "
+            "or power loss can lose the un-synced tail. N>0 bounds that "
+            "host-crash window to at most N-1 whole records plus one "
+            "torn tail line (which the loader already drops); N=1 is "
+            "one fsync per token — full durability at per-token fsync "
+            "latency on the delivery path.")
+define_flag("router_max_failures", 3,
+            "Consecutive dispatch/step failures before the fleet router "
+            "quarantines a replica (doubling-backoff probes thereafter; "
+            "consumed by inference.router.Router). A successful "
+            "dispatch+step resets the count.")
+define_flag("router_queue_max", 0,
+            "Fleet-level backpressure for the router: max requests "
+            "waiting in the ROUTER queue (beyond every replica's own "
+            "bounded queue) — arrivals past it are SHED at submit "
+            "(status='shed', router_shed event, router_shed_total). "
+            "0 = unbounded.")
+define_flag("router_heartbeat_timeout_s", 10.0,
+            "Replica heartbeat staleness the router treats as death: a "
+            "spawned replica whose health file is older than this (or an "
+            "armed replica/heartbeat fault site) is failed over exactly "
+            "like a process exit — its journaled in-flight requests "
+            "replay onto survivors.")
+define_flag("router_quarantine_backoff_s", 0.25,
+            "Initial quarantine probe backoff for the fleet router; "
+            "each failed probe doubles it (capped at 30s).")
 define_flag("flash_attn_version", 2,
             "Compat (reference FLAGS_flash_attn_version): the Pallas "
             "kernel implements the FA-2 recurrence; recorded only.")

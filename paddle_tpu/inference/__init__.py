@@ -23,6 +23,12 @@ _LAZY = {
     "ServingEngine": ".serving",
     "ServingJournal": ".resilient",
     "run_serving_resilient": ".resilient",
+    "Router": ".router",
+    "ReplicaSet": ".router",
+    "InProcessReplica": ".router",
+    "SpawnedReplica": ".router",
+    "router_failover_check": ".router",
+    "router_spawn_check": ".router",
 }
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",

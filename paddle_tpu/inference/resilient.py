@@ -69,10 +69,25 @@ class ServingJournal:
     (``{"lid": i, "status": ...}``) and first-submit wall-clock stamps
     (``{"lid": i, "t0": unix}``) so deadlines keep their original epoch
     across restarts. ``path=None`` keeps the watermark in memory only
-    (single-process rebuilds)."""
+    (single-process rebuilds).
 
-    def __init__(self, path: Optional[str] = None):
+    Durability (ISSUE 16): flush-per-line covers PROCESS death — every
+    appended line reaches the kernel page cache before the user callback
+    sees the token, so a kill -9 / ``os._exit`` never replays a delivered
+    token. A HOST crash (kernel panic, power loss) can still lose the
+    un-synced tail: ``fsync`` (default ``FLAGS_serving_journal_fsync``)
+    bounds that window by fsyncing every N appends — at most N-1 whole
+    records plus one torn final line (dropped by the loader) can vanish;
+    N=1 trades per-token fsync latency for a zero-record window."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 fsync: Optional[int] = None):
+        if fsync is None:
+            from ..flags import flag
+            fsync = int(flag("serving_journal_fsync"))
         self.path = path
+        self.fsync_every = max(int(fsync), 0)
+        self._appends_since_sync = 0
         self.delivered: Dict[int, List[int]] = {}
         self.statuses: Dict[int, str] = {}
         self.t0: Dict[int, float] = {}
@@ -106,6 +121,11 @@ class ServingJournal:
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
+            if self.fsync_every:
+                self._appends_since_sync += 1
+                if self._appends_since_sync >= self.fsync_every:
+                    os.fsync(self._fh.fileno())
+                    self._appends_since_sync = 0
 
     def append(self, lid: int, tok: int):
         self.delivered.setdefault(lid, []).append(int(tok))
@@ -122,6 +142,8 @@ class ServingJournal:
 
     def close(self):
         if self._fh is not None:
+            if self.fsync_every:
+                os.fsync(self._fh.fileno())
             self._fh.close()
             self._fh = None
 

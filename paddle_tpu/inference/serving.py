@@ -960,6 +960,21 @@ class ServingEngine:
                 out.append(r)
         return out
 
+    def load_stats(self) -> Dict[str, float]:
+        """Placement read for a fleet router (ISSUE 16): pending work
+        (queue + running), recent-window TTFT p95 and KV-pool
+        utilization, straight off the engine's own prom registry — host
+        floats only, the device is never touched."""
+        pending = (len(self.queue)
+                   + sum(1 for s in self.slots if s is not None))
+        return {
+            "pending": float(pending),
+            "ttft_p95": float(self._prom.quantile("ttft_seconds", 0.95)
+                              or 0.0),
+            "pool_utilization": float(
+                self._prom.get("kv_pool_utilization") or 0.0),
+        }
+
     def snapshot(self) -> Dict:
         """Host-state serving snapshot for flight-recorder bundles:
         slots, queue, pool utilization, health — cheap, never touches

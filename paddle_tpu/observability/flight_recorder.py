@@ -47,13 +47,15 @@ from typing import Any, Dict, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
            "maybe_dump", "register_telemetry_host", "register_aggregator",
-           "register_serving_engine", "register_numerics_monitor"]
+           "register_serving_engine", "register_numerics_monitor",
+           "register_router"]
 
 _SRC_LOCK = threading.Lock()
 _TELEMETRY_HOSTS: "weakref.WeakSet" = weakref.WeakSet()
 _AGGREGATORS: "weakref.WeakSet" = weakref.WeakSet()
 _SERVING_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 _NUMERICS_MONITORS: "weakref.WeakSet" = weakref.WeakSet()
+_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_telemetry_host(host) -> None:
@@ -77,6 +79,16 @@ def register_serving_engine(engine) -> None:
     (called by ServingEngine.__init__; ISSUE 13)."""
     with _SRC_LOCK:
         _SERVING_ENGINES.add(engine)
+
+
+def register_router(router) -> None:
+    """Weakly track a fleet Router so crash bundles gain ``router.json``
+    — per-replica lifecycle state + failure counters, the fleet queue
+    and every request's status/watermark (called by Router.__init__;
+    ISSUE 16): a fleet incident leaves forensics, not just one
+    replica's view."""
+    with _SRC_LOCK:
+        _ROUTERS.add(router)
 
 
 def register_numerics_monitor(monitor) -> None:
@@ -177,6 +189,7 @@ class FlightRecorder:
             aggs = list(_AGGREGATORS)
             engines = list(_SERVING_ENGINES)
             monitors = list(_NUMERICS_MONITORS)
+            routers = list(_ROUTERS)
         tele = {}
         for i, h in enumerate(hosts):
             try:
@@ -218,6 +231,17 @@ class FlightRecorder:
                 continue
         if serving:
             self._write_json(path, "serving.json", serving)
+
+        # fleet state: every live router's replica lifecycle + failure
+        # counters + per-request watermarks (ISSUE 16; host dicts only)
+        rts = {}
+        for i, r in enumerate(routers):
+            try:
+                rts[f"router_{i}"] = r.snapshot()
+            except Exception:
+                continue
+        if rts:
+            self._write_json(path, "router.json", rts)
 
         # numerics forensics: last-K per-layer/EF/fp8 stats + detector
         # state of every live NumericsMonitor (host deques only)
