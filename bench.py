@@ -1024,6 +1024,7 @@ def _run_serving_config(jax, G):
     so BENCH_r0N rows carry the single-dispatch numbers the standalone
     `benchmarks/serving_bench.py` measures."""
     from benchmarks.serving_bench import (run_overload_comparison,
+                                          run_prefix_spec_comparison,
                                           run_router_comparison,
                                           run_single_dispatch_comparison,
                                           scenario)
@@ -1049,6 +1050,10 @@ def _run_serving_config(jax, G):
     # bitwise-equal outputs (the exactly-once contract)
     report["router"] = run_router_comparison(
         params, cfg, mk, 8, n_req=(48 if on_tpu else 32))
+    # ISSUE 17: prefix page sharing admission multiplier at a fixed pool
+    # + speculative-decoding tokens/decode-step (replay + ngram
+    # proposers), both bitwise vs plain greedy decode
+    report["prefix_spec"] = run_prefix_spec_comparison(params, cfg, mk, 8)
     return report
 
 

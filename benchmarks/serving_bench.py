@@ -336,6 +336,153 @@ def run_router_comparison(params, cfg, mk, batch, *, n_req: int = 32,
     }
 
 
+def run_prefix_spec_comparison(params, cfg, mk, batch, *, seed=0):
+    """Prefix sharing + speculative decoding section (ISSUE 17), two legs:
+
+    (a) Admission multiplier at a FIXED pool: every request opens with the
+    same 4-page system prompt, the pool is sized so the unshared engine
+    can only hold ~4 residents (5 pages each), and the metric is PEAK
+    concurrently-resident requests with sharing on vs off. Sharing turns
+    the 4 prompt pages into one refcounted copy, so each extra resident
+    costs 1 fresh tail page instead of 5 — the multiplier is the capacity
+    a fleet gets back from templated traffic without buying HBM.
+
+    (b) Tokens per decode step with speculation: same greedy workload,
+    ``decode_burst=1`` on both sides so one engine step = one model
+    forward per row. The headline proposer is :class:`ReplayCache` primed
+    by an identical first wave (repeat/retry traffic — the same workload
+    prefix sharing multiplies); the draft-free n-gram proposer runs
+    alongside. Exact-match acceptance keeps every variant bitwise equal
+    to plain decode — speculation only moves tokens/step, never text."""
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.speculative import ReplayCache
+
+    bs = mk["block_size"]
+    rng = np.random.RandomState(seed)
+
+    # ---- leg (a): admission at a fixed pool, sharing on vs off -------
+    sys_prompt = rng.randint(0, cfg.vocab_size, (4 * bs,))
+    n_req = 24
+    prompts = [np.concatenate(
+        [sys_prompt, rng.randint(0, cfg.vocab_size, (bs // 2,))]
+    ).astype(np.int32) for _ in range(n_req)]
+    max_new = bs // 2
+    pages_per_req = (4 * bs + bs // 2 + max_new + bs - 1) // bs   # = 5
+    usable = 4 * pages_per_req + 1   # unshared engine caps at 4 residents
+    slots = 16
+
+    def residency(share):
+        eng = ServingEngine(
+            params, cfg, max_batch=slots, adaptive_mix=False, ragged=True,
+            block_size=bs, num_blocks=usable + 1,
+            max_blocks_per_seq=mk["max_blocks_per_seq"], chunk=mk["chunk"],
+            # burst=1 so a resident decodes across many engine steps —
+            # peak residency is then observable at step boundaries
+            decode_burst=1,
+            token_budget=slots * (1 + mk["chunk"]),
+            prefix_share=share, pool_audit=True)
+        # primer wave: ONE request registers the system prompt's full
+        # pages in the prefix cache (and compiles the programs); with
+        # sharing off it is just a warmup
+        eng.add_request(prompts[0], max_new)
+        eng.run()
+        rids = [eng.add_request(p, max_new) for p in prompts]
+        outs, peak, shared_peak = {}, 0, 0
+        while eng.has_work():
+            for r in eng.step():
+                outs[r.rid] = r.output
+            peak = max(peak, sum(1 for s in eng.slots if s is not None))
+            shared_peak = max(shared_peak, int((eng.refcount > 1).sum()))
+        return (peak, shared_peak, [outs[rid] for rid in rids],
+                usable - eng.free_pages())
+
+    peak_off, _, outs_off, leak_off = residency(False)
+    peak_on, shared_on, outs_on, leak_on = residency(True)
+
+    # ---- leg (b): tokens per decode step, speculation on vs off ------
+    rng2 = np.random.RandomState(seed + 1)
+    prompts2 = [rng2.randint(0, cfg.vocab_size, (bs,)).astype(np.int32)
+                for _ in range(batch)]
+    new2 = 2 * bs
+    total2 = batch * new2
+
+    def mk_eng(k=0, proposer=None):
+        return ServingEngine(
+            params, cfg, max_batch=batch, adaptive_mix=False, ragged=True,
+            block_size=bs, num_blocks=mk["num_blocks"],
+            max_blocks_per_seq=mk["max_blocks_per_seq"], chunk=mk["chunk"],
+            decode_burst=1, token_budget=batch * (1 + mk["chunk"]),
+            spec_decode_k=k, proposer=proposer)
+
+    def wave(eng, record_into=None):
+        rids = [eng.add_request(p, new2) for p in prompts2]
+        outs = {}
+        s0 = eng.engine_steps
+        p0, a0 = eng.spec_proposed, eng.spec_accepted
+        t0 = time.perf_counter()
+        while eng.has_work():
+            for r in eng.step():
+                outs[r.rid] = r.output
+        dt = time.perf_counter() - t0
+        if record_into is not None:
+            for p, rid in zip(prompts2, rids):
+                record_into.record(p, outs[rid])
+        return ([outs[rid] for rid in rids], eng.engine_steps - s0, dt,
+                eng.spec_proposed - p0, eng.spec_accepted - a0)
+
+    eng_plain = mk_eng()
+    wave(eng_plain)                                   # compile wave
+    outs_plain, steps_plain, dt_plain, _, _ = wave(eng_plain)
+
+    cache = ReplayCache()
+    eng_rep = mk_eng(k=3, proposer=cache)
+    wave(eng_rep, record_into=cache)   # wave 1 primes the replay cache
+    outs_rep, steps_rep, dt_rep, prop_r, acc_r = wave(eng_rep)
+
+    eng_ng = mk_eng(k=3)                     # default prompt-lookup/ngram
+    wave(eng_ng)
+    outs_ng, steps_ng, dt_ng, prop_n, acc_n = wave(eng_ng)
+
+    def spec_stats(steps, dt, prop=None, acc=None):
+        out = {"tokens_per_decode_step":
+               round(total2 / (steps * batch), 2),
+               "engine_steps": steps, "wall_s": round(dt, 3)}
+        if prop is not None:
+            out.update(proposed=int(prop), accepted=int(acc),
+                       acceptance_rate=round(acc / max(prop, 1), 3))
+        return out
+
+    return {
+        "prefix_sharing": {
+            "config": f"{n_req} reqs sharing a {4 * bs}-token system "
+                      f"prompt ({pages_per_req} pages/req unshared), "
+                      f"pool {usable} pages, {slots} slots, prefix "
+                      "cache primed by one completed request",
+            "peak_resident_requests": {"share_off": peak_off,
+                                       "share_on": peak_on},
+            "admission_multiplier": round(peak_on / max(peak_off, 1), 2),
+            "peak_shared_pages": shared_on,
+            "outputs_match_share_off": outs_on == outs_off,
+            "pages_leaked": {"share_off": int(leak_off),
+                             "share_on": int(leak_on)},
+        },
+        "speculative": {
+            "config": f"{batch} reqs x {new2} greedy tokens, k=3, "
+                      "decode_burst=1 both sides (1 engine step = 1 "
+                      "forward/row); replay = history proposer primed "
+                      "by an identical first wave, ngram = draft-free "
+                      "prompt lookup",
+            "plain": spec_stats(steps_plain, dt_plain),
+            "replay": spec_stats(steps_rep, dt_rep, prop_r, acc_r),
+            "ngram": spec_stats(steps_ng, dt_ng, prop_n, acc_n),
+            "step_reduction_replay_vs_plain":
+                round(steps_plain / max(steps_rep, 1), 2),
+            "outputs_bitwise_plain": {"replay": outs_rep == outs_plain,
+                                      "ngram": outs_ng == outs_plain},
+        },
+    }
+
+
 def scenario(on_tpu: bool, big: bool = False, shape: str = "auto"):
     """Workload + engine geometry per platform/shape. Returns
     (cfg, n_req, plens, out_hi, mk) — shared by main() and bench.py's
@@ -492,6 +639,10 @@ def main(big: bool = False, shape: str = "auto"):
         "router": run_router_comparison(
             params, cfg, mk, batch,
             n_req=(48 if on_tpu else 32)),
+        # ISSUE 17: prefix page sharing (admission multiplier at a fixed
+        # pool) + speculative decoding (tokens per decode step, bitwise
+        # vs plain)
+        "prefix_spec": run_prefix_spec_comparison(params, cfg, mk, batch),
     }
     if shape == "gpt1p3b":
         out["metric"] = "serving_single_dispatch_gpt1p3b"

@@ -734,6 +734,40 @@ define_flag("serving_adaptive_mix", True,
             "from the queue-depth and TTFT telemetry series: admission "
             "pressure shortens the fused decode burst so prefill slices "
             "come around sooner; an idle queue runs full bursts.")
+define_flag("serving_prefix_share", False,
+            "Prefix page sharing in the serving engine's paged KV pool: "
+            "the pool becomes refcounted, full prompt pages are "
+            "registered in a page-granular chained-hash prefix cache, "
+            "and a request whose prompt prefix is already resident "
+            "references the cached pages instead of recomputing and "
+            "re-storing them (cross-request shared system prompts, n>1 "
+            "sampling fan-out). First append into a still-shared page "
+            "copies-on-write; a page returns to the free list only at "
+            "refcount 0 (registered pages linger reusable in a cached-"
+            "free LRU until evicted for allocation). Off = the frozen "
+            "non-refcounted pool, byte-identical step (consumed by "
+            "inference.serving.ServingEngine).")
+define_flag("serving_spec_decode_k", 0,
+            "Speculative decoding draft length k for the serving engine: "
+            "each greedy decode row asks the proposer (default draft-"
+            "model-free n-gram prompt lookup, "
+            "inference.speculative.ngram_propose) for up to k draft "
+            "tokens and ONE dispatch verifies the row with q_len=k+1 "
+            "(the ragged kernel's per-row descriptors handle mixed "
+            "q_lens for free; the two-program path uses a dedicated "
+            "verify program). Exact-match acceptance under greedy keeps "
+            "outputs bitwise identical to plain decode — only tokens/"
+            "step changes; rejected draft KV rolls back via the block "
+            "table. 0 = off, byte-identical step (consumed by "
+            "inference.serving.ServingEngine).")
+define_flag("serving_pool_audit", False,
+            "Debug refcount audit of the serving engine's paged KV pool: "
+            "after every admission/release, walk all live block tables "
+            "and assert they agree with the pool's refcounts and that "
+            "free/cached-free/live pages partition the pool exactly — "
+            "sharing bugs fail loudly instead of leaking pages silently "
+            "(consumed by inference.serving.ServingEngine; meant for "
+            "tests/CI, costs a host walk per admission).")
 define_flag("serving_journal_fsync", 0,
             "fsync the serving delivery journal every N token appends "
             "(consumed by inference.resilient.ServingJournal). 0 = "

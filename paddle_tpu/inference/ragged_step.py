@@ -46,12 +46,16 @@ __all__ = ["ragged_pass", "unified_step"]
 
 def ragged_pass(params, tokens, row_of, off_of, starts, pos0, q_lens,
                 tables, temps, key, kp, vp, ks, vs, *, cfg, bs, c_att,
-                mp_axis=None):
+                mp_axis=None, all_greedy=False):
     """One transformer forward over the packed ragged batch + per-row
     sampling. tokens/row_of/off_of: [T] packed (off_of >= q_len marks
     padding); starts/pos0/q_lens/temps: [R]; tables: [R, nb]; pools:
     [L, H_kv, NB, bs, D] (+ [L, H_kv, NB] scales when quantized).
-    Returns (tok [R], (kp, vp[, ks, vs]) updated)."""
+    Returns (tok [R], (kp, vp[, ks, vs]) updated); with ``all_greedy``
+    the head runs over EVERY packed position and the return gains a
+    ``greedy_t [T]`` argmax vector between tok and the pools — the
+    speculative-decoding verify signal (draft token i is accepted iff it
+    equals the model's own argmax one position earlier)."""
     T = tokens.shape[0]
     quantized = ks is not None
     pos_t = jnp.minimum(pos0[row_of] + off_of, cfg.max_seq_len - 1)
@@ -98,16 +102,28 @@ def ragged_pass(params, tokens, row_of, off_of, starts, pos0, q_lens,
     x, pools = lax.scan(body, x, xs)
     x = G._ln(x, params["lnf_g"], params["lnf_b"])
     last_idx = jnp.clip(starts + jnp.maximum(q_lens, 1) - 1, 0, T - 1)
-    logits = _head_logits(params, x[0][last_idx], cfg, mp_axis)  # [R, V]
+    if all_greedy:
+        # spec verify: the head GEMM widens from [R, V] to [T, V] so the
+        # model's argmax is known at every draft position in ONE pass
+        logits_all = _head_logits(params, x[0], cfg, mp_axis)    # [T, V]
+        greedy_t = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+        logits = logits_all[last_idx]                            # [R, V]
+    else:
+        logits = _head_logits(params, x[0][last_idx], cfg, mp_axis)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy), pools
+    tok = jnp.where(temps > 0, sampled, greedy)
+    if all_greedy:
+        return tok, greedy_t, pools
+    return tok, pools
 
 
 def unified_step(params, tokens, row_of, off_of, starts, pos0, q_lens,
                  tables, fresh, sample0, remaining, eos_ids, temps, key,
-                 kp, vp, ks, vs, *, cfg, bs, c_att, K, mp_axis=None):
+                 kp, vp, ks, vs, cow_src=None, cow_dst=None,
+                 reset_tables=None, *, cfg, bs, c_att, K, spec=False,
+                 mp_axis=None):
     """ONE compiled program per engine step: the ragged pass (prefill
     chunks + first decode token for every row) followed by K-1 decode
     micro-steps for every sampling row. fresh: [R] bool — slots admitted
@@ -117,17 +133,43 @@ def unified_step(params, tokens, row_of, off_of, starts, pos0, q_lens,
     this step); remaining: [R] tokens each row may still emit INCLUDING
     pass-1's (0 for mid-prefill rows); eos_ids: [R] (-1 = none);
     temps: [R] (0 = greedy).
-    Returns (toks [K, R], kp, vp, ks, vs, lens [R])."""
+
+    Prefix sharing (ISSUE 17) appends three OPTIONAL trailing args so the
+    flags-off trace — and hence the compiled HLO — is byte-identical:
+    cow_src/cow_dst [R] pair up copy-on-write page copies executed
+    before any append (idle pairs point 0 -> 0, a scratch-block no-op);
+    reset_tables [R, nb] replaces ``tables`` in the fresh-row scale
+    reset with inherited (shared) entries zeroed, so admitting a request
+    onto cached pages never wipes the canonical pages' quantization
+    scales. Scale order matters: reset first, COW copy after, so a COW
+    destination inherits its source page's running absmax.
+
+    Returns (toks [K, R], kp, vp, ks, vs, lens [R]); with ``spec=True``
+    (K must be 1) the return gains ``greedy_all [T]`` after toks — the
+    model's argmax at every packed position, from which the host accepts
+    the longest exactly-matching draft prefix."""
+    assert not (spec and K > 1), "spec verify subsumes the burst"
     R = pos0.shape[0]
     quantized = ks is not None
     if quantized:
-        ks = reset_page_scales(ks, tables, fresh)
-        vs = reset_page_scales(vs, tables, fresh)
+        rt = tables if reset_tables is None else reset_tables
+        ks = reset_page_scales(ks, rt, fresh)
+        vs = reset_page_scales(vs, rt, fresh)
+    if cow_src is not None:
+        kp = kp.at[:, :, cow_dst].set(kp[:, :, cow_src])
+        vp = vp.at[:, :, cow_dst].set(vp[:, :, cow_src])
+        if quantized:
+            ks = ks.at[:, :, cow_dst].set(ks[:, :, cow_src])
+            vs = vs.at[:, :, cow_dst].set(vs[:, :, cow_src])
     key, sub = jax.random.split(key)
-    tok0, pools = ragged_pass(params, tokens, row_of, off_of, starts,
-                              pos0, q_lens, tables, temps, sub,
-                              kp, vp, ks, vs, cfg=cfg, bs=bs,
-                              c_att=c_att, mp_axis=mp_axis)
+    out = ragged_pass(params, tokens, row_of, off_of, starts,
+                      pos0, q_lens, tables, temps, sub,
+                      kp, vp, ks, vs, cfg=cfg, bs=bs,
+                      c_att=c_att, mp_axis=mp_axis, all_greedy=spec)
+    if spec:
+        tok0, greedy_all, pools = out
+    else:
+        tok0, pools = out
     if quantized:
         kp, vp, ks, vs = pools
     else:
@@ -164,4 +206,6 @@ def unified_step(params, tokens, row_of, off_of, starts, pos0, q_lens,
         all_toks = jnp.concatenate([tok0[None], toks], axis=0)
     else:
         all_toks = tok0[None]
+    if spec:
+        return all_toks, greedy_all, kp, vp, ks, vs, lens
     return all_toks, kp, vp, ks, vs, lens

@@ -391,7 +391,9 @@ def run_serving_resilient(
     info["leftover"] = sorted(lid for lid, s in statuses.items()
                               if s == "pending")
     if engine is not None:
-        info["free_blocks"] = len(engine.free_blocks)
+        # free_pages(): cached-free prefix pages are reclaimable, not
+        # leaked — the zero-leak gate must count them as free
+        info["free_blocks"] = engine.free_pages()
         info["pool_blocks"] = engine._num_blocks - 1
     results = {lid: list(journal.delivered.get(lid, []))
                for lid in range(len(requests))}

@@ -117,6 +117,11 @@ class _ReplicaBase:
         utilization) — lower is better on every axis."""
         return (float(len(self.assigned)), 0.0, 0.0)
 
+    def extra_stats(self) -> Dict[str, float]:
+        """Sharing/speculation health counters for the fleet /metrics
+        (ISSUE 17) — empty where the engine is out of process."""
+        return {}
+
     def heartbeat_age(self) -> float:
         return 0.0
 
@@ -204,6 +209,15 @@ class InProcessReplica(_ReplicaBase):
         s = eng.load_stats()
         return (s["pending"], s["ttft_p95"], s["pool_utilization"])
 
+    def extra_stats(self):
+        eng = self.engine
+        if eng is None:
+            return {}
+        s = eng.load_stats()
+        return {k: s[k] for k in ("kv_pages_shared", "kv_cow_copies_total",
+                                  "spec_proposed_total",
+                                  "spec_accepted_total") if k in s}
+
     def stop(self, grace_s, reason) -> None:
         # in-process drain: no SIGTERM to send — stop admission, shed the
         # queue and cancel in-flight (pages freed; the journal keeps every
@@ -221,7 +235,9 @@ class InProcessReplica(_ReplicaBase):
     def free_pool(self) -> Tuple[Optional[int], Optional[int]]:
         if self.engine is None:
             return None, None
-        return len(self.engine.free_blocks), self.engine._num_blocks - 1
+        # free_pages() counts cached-free prefix pages as reclaimable —
+        # the zero-leak failover gate must not read them as leaked
+        return self.engine.free_pages(), self.engine._num_blocks - 1
 
 
 class SpawnedReplica(_ReplicaBase):
@@ -800,6 +816,11 @@ class Router:
             self._prom.gauge_set(f"replica_pool_utilization_{i}", util)
             if ttft:
                 self._prom.gauge_set(f"replica_ttft_p95_{i}", ttft)
+            for name, v in rep.extra_stats().items():
+                # kv_pages_shared / kv_cow_copies_total /
+                # spec_proposed_total / spec_accepted_total (ISSUE 17) —
+                # accepted/proposed is the fleet speculation health rate
+                self._prom.gauge_set(f"replica_{name}_{i}", v)
 
     def has_work(self) -> bool:
         return (bool(self.queue)

@@ -156,7 +156,9 @@ def main(argv):
     _write_health(rdir, "draining")
     print("RESULT " + json.dumps({
         "gen": gen,
-        "free_blocks": len(eng.free_blocks),
+        # free_pages(): cached-free prefix pages count as free — the
+        # router's zero-leak assert reads this field
+        "free_blocks": eng.free_pages(),
         "pool_blocks": eng._num_blocks - 1,
         "engine_steps": eng.engine_steps,
         "delivered": delivered,

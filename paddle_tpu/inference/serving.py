@@ -390,6 +390,61 @@ def _prefill_chunk(params, chunk_tokens, pos0, tables, last_idx, temps,
     return jnp.where(temps > 0, sampled, greedy), ks, vs
 
 
+def _verify_chunk(params, draft_tokens, pos0, q_lens, tables, temps,
+                  key, k_pools, v_pools, *, cfg, bs, mp_axis=None):
+    """Speculative verify on the two-program path: every decode slot's
+    [pending, draft_1..draft_k] row scores in ONE dispatch (ISSUE 17).
+    draft_tokens: [P, C] with per-row q_lens in [0, C] — NOT
+    _prefill_chunk, because pad columns here can sit past a row's
+    pre-allocated footprint, where the table lookup's index clamp would
+    alias them onto a REAL page; invalid columns instead write to the
+    reserved scratch block 0 (the ragged path's convention). Returns
+    (tok [P] sampled at each row's last valid column — the plain-decode
+    token for temperature > 0 rows — greedy [P, C] argmax at EVERY
+    column for host-side exact-match acceptance, k_pools', v_pools')."""
+    P, C = draft_tokens.shape
+    pos = pos0[:, None] + jnp.arange(C)[None, :]          # [P, C]
+    valid = jnp.arange(C)[None, :] < q_lens[:, None]      # [P, C]
+    x = _embed(params, draft_tokens, pos, cfg)            # [P, C, H]
+
+    def body(x, layer):
+        p, kp, vp = layer
+        q, k, v = _qkv(p, x, cfg, mp_axis)                # [P, C, h, D]
+        posb = jnp.clip(pos // bs, 0, tables.shape[1] - 1)
+        blks = jnp.where(valid, jnp.take_along_axis(tables, posb, axis=1),
+                         0)
+        offs = jnp.where(valid, pos % bs, 0)
+        h_loc, D = k.shape[2], k.shape[3]
+        kp = kp.at[:, blks.ravel(), offs.ravel()].set(
+            jnp.moveaxis(k.reshape(P * C, h_loc, D), 1, 0).astype(kp.dtype))
+        vp = vp.at[:, blks.ravel(), offs.ravel()].set(
+            jnp.moveaxis(v.reshape(P * C, h_loc, D), 1, 0).astype(vp.dtype))
+        ck = _gather_seqs(kp, tables, bs)                 # [P, cap, H, D]
+        cv = _gather_seqs(vp, tables, bs)
+        cap = ck.shape[1]
+        allowed = (jnp.arange(cap)[None, None, :]
+                   <= pos[:, :, None])                    # [P, C, cap]
+        from ..nn import functional as F
+        attn = F.scaled_dot_product_attention(
+            q, ck, cv, attn_mask=allowed[:, None])
+        x = _block_math(p, x, attn, cfg, mp_axis)
+        return x, (kp, vp)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pools, v_pools))
+    x = G._ln(x, params["lnf_g"], params["lnf_b"])
+    logits = _head_logits(params, x.reshape(P * C, -1), cfg, mp_axis)
+    logits = logits.reshape(P, C, -1)                     # [P, C, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    last_idx = jnp.clip(q_lens - 1, 0, C - 1)
+    logits_last = jnp.take_along_axis(
+        logits, last_idx[:, None, None], axis=1)[:, 0]    # [P, V]
+    scaled = logits_last / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    g_last = jnp.take_along_axis(greedy, last_idx[:, None], axis=1)[:, 0]
+    tok = jnp.where(temps > 0, sampled, g_last)
+    return tok, greedy, ks, vs
+
+
 class ServingEngine:
     """Continuous-batching engine over a paged KV pool (see module doc)."""
 
@@ -403,7 +458,8 @@ class ServingEngine:
                  token_budget: Optional[int] = None, adaptive_mix=None,
                  ttft_slo_s: Optional[float] = None, queue_max=None,
                  shed=None, shed_headroom: float = 0.5, preempt=None,
-                 preempt_wait_steps: int = 2):
+                 preempt_wait_steps: int = 2, prefix_share=None,
+                 spec_decode_k=None, proposer=None, pool_audit=None):
         from ..flags import flag
         from ..enforce import enforce
         block_size = (int(flag("paged_block_size")) if block_size is None
@@ -458,6 +514,48 @@ class ServingEngine:
         self.lens = np.zeros((max_batch,), np.int32)
         # block 0 is the scratch block idle slots write into
         self.free_blocks = list(range(num_blocks - 1, 0, -1))
+        # -- prefix page sharing + speculative decoding (ISSUE 17).
+        # Refcounted pool: every allocated page carries a holder count;
+        # block tables may reference the same page from several rows.
+        # Flags-off the refcounts are all 0/1 and every path below
+        # degenerates to the pre-sharing behavior byte-for-byte.
+        if prefix_share is None or prefix_share == "auto":
+            prefix_share = bool(flag("serving_prefix_share"))
+        self.prefix_share = bool(prefix_share)
+        if spec_decode_k is None or spec_decode_k == "auto":
+            spec_decode_k = int(flag("serving_spec_decode_k"))
+        self.spec_k = max(int(spec_decode_k), 0)
+        if proposer is None:
+            from .speculative import ngram_propose
+            proposer = ngram_propose
+        self._proposer = proposer
+        if pool_audit is None or pool_audit == "auto":
+            pool_audit = bool(flag("serving_pool_audit"))
+        self.pool_audit = bool(pool_audit)
+        self.refcount = np.zeros((num_blocks,), np.int32)
+        # page-granular prefix cache: chained page hash -> resident block
+        # (and the reverse index). Pages whose last holder left stay
+        # addressable in the cached-free LRU until evicted for allocation.
+        self._prefix_cache: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        from collections import OrderedDict
+        self._cached_free: "OrderedDict[int, bool]" = OrderedDict()
+        # first-page hash -> rid of a still-prefilling owner: queued
+        # siblings (n>1 fan-out) defer admission until the owner's pages
+        # are computed, then share them instead of recomputing
+        self._prefix_pending: Dict[bytes, int] = {}
+        # copy-on-write pairs (src, dst) scheduled by admission and
+        # executed IN-PROGRAM by the next dispatch (one-dispatch contract)
+        self._cow_pairs: List = []
+        self._cow_jit = None
+        self._verify_prog = None
+        self._reset_tables = np.zeros_like(self.tables)
+        self.cow_copies = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._cow_reported = 0
+        self._spec_prop_reported = 0
+        self._spec_acc_reported = 0
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.queue: List[Request] = []
         self._next_rid = 0
@@ -697,38 +795,57 @@ class ServingEngine:
                              jax.random.key_data(key), kp, vp))
 
     # -- single-dispatch ragged path (ISSUE 6) -------------------------------
-    def _unified(self, K):
+    def _unified(self, K, spec=False):
         """The ONE compiled program for a ragged step with a K-token
         decode burst (lazily built per K — only scheduler-chosen sizes
-        compile). Calling convention matches ragged_step.unified_step
-        with the pools (and scales, when quantized) donated."""
-        fn = self._unified_cache.get(K)
+        compile; the spec-verify variant, which returns the argmax at
+        every packed position, is its own entry). Calling convention
+        matches ragged_step.unified_step with the pools (and scales,
+        when quantized) donated."""
+        key = (K, spec)
+        fn = self._unified_cache.get(key)
         if fn is None:
-            fn = self._build_unified(K)
-            self._unified_cache[K] = fn
+            fn = self._build_unified(K, spec)
+            self._unified_cache[key] = fn
         return fn
 
-    def _build_unified(self, K):
+    def _build_unified(self, K, spec=False):
         from . import ragged_step as RS
         cfg, bsz, c_att = self.cfg, self.bs, self._c_att
         quant = self.kv_quantized
+        share = self.prefix_share
         mesh, ax = self._mesh, self._mp_axis
         if mesh is None:
             if quant:
+                # positional passthrough: with prefix sharing on, the
+                # engine appends (cow_src, cow_dst, reset_tables)
                 jfn = jax.jit(functools.partial(
-                    RS.unified_step, cfg=cfg, bs=bsz, c_att=c_att, K=K),
+                    RS.unified_step, cfg=cfg, bs=bsz, c_att=c_att, K=K,
+                    spec=spec),
                     donate_argnums=(14, 15, 16, 17))
                 self._jit_programs.append(jfn)
                 return jfn
 
-            def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
-                   tables, fresh, sample0, remaining, eos_ids, temps,
-                   key, kp, vp):
-                return RS.unified_step(
-                    params, tokens, row_of, off_of, starts, pos0, q_lens,
-                    tables, fresh, sample0, remaining, eos_ids, temps,
-                    key, kp, vp, None, None, cfg=cfg, bs=bsz,
-                    c_att=c_att, K=K)
+            if share:
+                def fn(params, tokens, row_of, off_of, starts, pos0,
+                       q_lens, tables, fresh, sample0, remaining,
+                       eos_ids, temps, key, kp, vp, cow_src, cow_dst,
+                       reset_tables):
+                    return RS.unified_step(
+                        params, tokens, row_of, off_of, starts, pos0,
+                        q_lens, tables, fresh, sample0, remaining,
+                        eos_ids, temps, key, kp, vp, None, None,
+                        cow_src, cow_dst, reset_tables, cfg=cfg, bs=bsz,
+                        c_att=c_att, K=K, spec=spec)
+            else:
+                def fn(params, tokens, row_of, off_of, starts, pos0,
+                       q_lens, tables, fresh, sample0, remaining,
+                       eos_ids, temps, key, kp, vp):
+                    return RS.unified_step(
+                        params, tokens, row_of, off_of, starts, pos0,
+                        q_lens, tables, fresh, sample0, remaining,
+                        eos_ids, temps, key, kp, vp, None, None,
+                        cfg=cfg, bs=bsz, c_att=c_att, K=K, spec=spec)
 
             jfn = jax.jit(fn, donate_argnums=(14, 15))
             self._jit_programs.append(jfn)
@@ -745,29 +862,38 @@ class ServingEngine:
         if quant:
             def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
                    tables, fresh, sample0, remaining, eos_ids, temps,
-                   key_data, kp, vp, ks, vs):
+                   key_data, kp, vp, ks, vs, *extra):
                 return RS.unified_step(
                     params, tokens, row_of, off_of, starts, pos0, q_lens,
                     tables, fresh, sample0, remaining, eos_ids, temps,
                     jax.random.wrap_key_data(key_data), kp, vp, ks, vs,
-                    cfg=cfg, bs=bsz, c_att=c_att, K=K, mp_axis=ax)
+                    *extra, cfg=cfg, bs=bsz, c_att=c_att, K=K, spec=spec,
+                    mp_axis=ax)
             in_specs = (pspec,) + (rep,) * 13 + (pool_spec,) * 4
-            out_specs = (rep, pool_spec, pool_spec, pool_spec, pool_spec,
-                         rep)
+            out_specs = ((rep,) * (2 if spec else 1)
+                         + (pool_spec,) * 4 + (rep,))
             donate = (14, 15, 16, 17)
         else:
             def fn(params, tokens, row_of, off_of, starts, pos0, q_lens,
                    tables, fresh, sample0, remaining, eos_ids, temps,
-                   key_data, kp, vp):
-                toks, kp, vp, _, _, lens = RS.unified_step(
+                   key_data, kp, vp, *extra):
+                out = RS.unified_step(
                     params, tokens, row_of, off_of, starts, pos0, q_lens,
                     tables, fresh, sample0, remaining, eos_ids, temps,
                     jax.random.wrap_key_data(key_data), kp, vp, None,
-                    None, cfg=cfg, bs=bsz, c_att=c_att, K=K, mp_axis=ax)
+                    None, *extra, cfg=cfg, bs=bsz, c_att=c_att, K=K,
+                    spec=spec, mp_axis=ax)
+                if spec:
+                    toks, greedy_all, kp, vp, _, _, lens = out
+                    return toks, greedy_all, kp, vp, lens
+                toks, kp, vp, _, _, lens = out
                 return toks, kp, vp, lens
             in_specs = (pspec,) + (rep,) * 13 + (pool_spec, pool_spec)
-            out_specs = (rep, pool_spec, pool_spec, rep)
+            out_specs = ((rep,) * (2 if spec else 1)
+                         + (pool_spec, pool_spec, rep))
             donate = (14, 15)
+        if share:
+            in_specs = in_specs + (rep, rep, rep)
 
         jfn = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs),
@@ -775,23 +901,85 @@ class ServingEngine:
         self._jit_programs.append(jfn)
 
         if quant:
-            def call(params, tokens, row_of, off_of, starts, pos0, q_lens,
-                     tables, fresh, sample0, remaining, eos_ids, temps,
-                     key, kp, vp, ks, vs):
-                return jfn(params, tokens, row_of, off_of, starts, pos0,
-                           q_lens, tables, fresh, sample0, remaining,
-                           eos_ids, temps, jax.random.key_data(key),
-                           kp, vp, ks, vs)
+            def call(*a):
+                a = list(a)
+                a[13] = jax.random.key_data(a[13])  # PRNG key position
+                return jfn(*a)
         else:
-            def call(params, tokens, row_of, off_of, starts, pos0, q_lens,
-                     tables, fresh, sample0, remaining, eos_ids, temps,
-                     key, kp, vp):
-                toks, kp, vp, lens = jfn(
-                    params, tokens, row_of, off_of, starts, pos0, q_lens,
-                    tables, fresh, sample0, remaining, eos_ids, temps,
-                    jax.random.key_data(key), kp, vp)
+            def call(*a):
+                a = list(a)
+                a[13] = jax.random.key_data(a[13])
+                if spec:
+                    toks, greedy_all, kp, vp, lens = jfn(*a)
+                    return toks, greedy_all, kp, vp, None, None, lens
+                toks, kp, vp, lens = jfn(*a)
                 return toks, kp, vp, None, None, lens
         return call
+
+    def _apply_cow(self):
+        """Two-program path: flush pending copy-on-write page copies as
+        one tiny dispatch BEFORE this step's prefill writes into the
+        copies (the ragged path instead folds the pairs into the unified
+        program — no extra dispatch there)."""
+        if not self._cow_pairs:
+            return
+        R = self.max_batch
+        src = np.zeros((R,), np.int32)
+        dst = np.zeros((R,), np.int32)
+        for j, (s, d) in enumerate(self._cow_pairs[:R]):
+            src[j], dst[j] = s, d
+        del self._cow_pairs[:R]
+        if self._cow_jit is None:
+            def fn(kp, vp, src, dst):
+                kp = kp.at[:, :, dst].set(kp[:, :, src])
+                vp = vp.at[:, :, dst].set(vp[:, :, src])
+                return kp, vp
+            self._cow_jit = jax.jit(fn, donate_argnums=(0, 1))
+            self._jit_programs.append(self._cow_jit)
+        self.dispatches += 1
+        with RecordEvent("serving_cow_dispatch"):
+            self.k_pools, self.v_pools = self._cow_jit(
+                self.k_pools, self.v_pools, jnp.asarray(src),
+                jnp.asarray(dst))
+
+    def _verify(self):
+        """Lazily-built spec-verify program for the two-program path
+        (static [P, spec_k + 1] draft buffer; the ragged path needs no
+        extra program — verify rows are just q_len = k + 1 rows)."""
+        if self._verify_prog is None:
+            cfg, bsz = self.cfg, self.bs
+            mesh, ax = self._mesh, self._mp_axis
+            if mesh is None:
+                jfn = jax.jit(functools.partial(
+                    _verify_chunk, cfg=cfg, bs=bsz),
+                    donate_argnums=(7, 8))
+                self._jit_programs.append(jfn)
+                self._verify_prog = jfn
+            else:
+                from jax.sharding import PartitionSpec as P
+                from ..utils import shard_map
+                pspec, pool_spec = self._tp_pspec, self._tp_pool_spec
+                rep = P()
+
+                def fn(params, draft, pos0, q_lens, tables, temps,
+                       key_data, kp, vp):
+                    return _verify_chunk(
+                        params, draft, pos0, q_lens, tables, temps,
+                        jax.random.wrap_key_data(key_data), kp, vp,
+                        cfg=cfg, bs=bsz, mp_axis=ax)
+                jfn = jax.jit(
+                    shard_map(fn, mesh=mesh,
+                              in_specs=(pspec,) + (rep,) * 6
+                              + (pool_spec, pool_spec),
+                              out_specs=(rep, rep, pool_spec, pool_spec)),
+                    donate_argnums=(7, 8))
+                self._jit_programs.append(jfn)
+                self._verify_prog = (
+                    lambda params, draft, pos0, q_lens, tables, temps,
+                    key, kp, vp: jfn(params, draft, pos0, q_lens, tables,
+                                     temps, jax.random.key_data(key),
+                                     kp, vp))
+        return self._verify_prog
 
     def compiled_cache_entries(self) -> int:
         """Total traced-program cache entries across every jit program
@@ -973,6 +1161,13 @@ class ServingEngine:
                               or 0.0),
             "pool_utilization": float(
                 self._prom.get("kv_pool_utilization") or 0.0),
+            # sharing/speculation health (ISSUE 17): pages referenced by
+            # >1 block table, COW copies, and the spec acceptance pair —
+            # acceptance/proposed IS the speculation health metric
+            "kv_pages_shared": float(int((self.refcount > 1).sum())),
+            "kv_cow_copies_total": float(self.cow_copies),
+            "spec_proposed_total": float(self.spec_proposed),
+            "spec_accepted_total": float(self.spec_accepted),
         }
 
     def snapshot(self) -> Dict:
@@ -995,9 +1190,13 @@ class ServingEngine:
             "health": self._health, "draining": self.draining,
             "engine_steps": self.engine_steps,
             "dispatches": self.dispatches,
-            "free_blocks": len(self.free_blocks),
-            "pool_utilization": (1.0 - len(self.free_blocks) / total
+            "free_blocks": self.free_pages(),
+            "pool_utilization": (1.0 - self.free_pages() / total
                                  if total else 0.0),
+            "kv_pages_shared": int((self.refcount > 1).sum()),
+            "kv_cow_copies_total": self.cow_copies,
+            "spec_proposed_total": self.spec_proposed,
+            "spec_accepted_total": self.spec_accepted,
             "slots": [None if s is None else req(s) for s in self.slots],
             "queue": [req(r) for r in self.queue],
             # last KV page-scale drift poll (FLAGS_numerics, quantized
@@ -1025,6 +1224,122 @@ class ServingEngine:
         # preemptions
         return -(-(len(r.prompt) + r.max_new_tokens - r.folded)
                  // self.bs)
+
+    # -- refcounted pool + prefix cache (ISSUE 17) ---------------------------
+    def free_pages(self) -> int:
+        """Reclaimable pages: the free list PLUS cached-free pages
+        (refcount 0 but still addressable through the prefix cache until
+        evicted for allocation). This is the number pool-leak gates and
+        utilization gauges must use — a cached-free page is not leaked."""
+        return len(self.free_blocks) + len(self._cached_free)
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Allocate n private pages (refcount 1): the free list first,
+        then evict least-recently-freed cached pages (their prefix-cache
+        entries die with them). Caller checked capacity."""
+        out = []
+        for _ in range(n):
+            if self.free_blocks:
+                b = self.free_blocks.pop()
+            else:
+                b, _ = self._cached_free.popitem(last=False)
+                self._drop_cache_entry(b)
+            self.refcount[b] = 1
+            out.append(b)
+        if self._numerics_kv and out:
+            # bump the pages' allocation generation so the numerics
+            # scale-drift poll can tell requantization of LIVE pages
+            # from free->re-admit churn between two polls
+            self._numerics_kv_gen[out] += 1
+        return out
+
+    def _drop_cache_entry(self, b: int) -> None:
+        h = self._page_hash.pop(b, None)
+        if h is not None and self._prefix_cache.get(h) == b:
+            del self._prefix_cache[h]
+
+    def _decref(self, b: int) -> None:
+        """Drop one holder of page b; at refcount 0 a cache-registered
+        page parks in the cached-free LRU (reusable by the next prefix
+        hit until evicted), anything else returns to the free list."""
+        self.refcount[b] -= 1
+        if self.refcount[b] > 0:
+            return
+        self.refcount[b] = 0
+        if self.prefix_share and b in self._page_hash:
+            self._cached_free[b] = True
+            self._cached_free.move_to_end(b)
+        else:
+            self.free_blocks.append(b)
+
+    def _chain_of(self, r: Request) -> List[bytes]:
+        """Chained hashes of the request's FULL prompt pages:
+        h_j = H(h_{j-1} || tokens of page j), so a page hash pins the
+        whole prefix up to it — two requests share page j only when
+        their first (j+1)*bs prompt tokens are identical."""
+        chain = getattr(r, "_chain", None)
+        if chain is None:
+            import hashlib
+            chain = []
+            h = b"\x00" * 16
+            p = np.asarray(r.prompt, np.int32)
+            for j in range(len(p) // self.bs):
+                h = hashlib.blake2b(
+                    h + p[j * self.bs:(j + 1) * self.bs].tobytes(),
+                    digest_size=16).digest()
+                chain.append(h)
+            r._chain = chain
+        return chain
+
+    def _register_pages(self, r: Request) -> None:
+        """Register the request's fully-PREFILLED prompt pages in the
+        prefix cache (their contents are now canonical for the chain
+        hash) and release any fan-out deferral waiting on this owner."""
+        if not self.prefix_share or r.slot < 0:
+            return
+        chain = self._chain_of(r)
+        done_pages = min(int(r.prefill_done), len(r.prompt)) // self.bs
+        for p in range(min(done_pages, len(chain))):
+            h = chain[p]
+            b = int(self.tables[r.slot, p])
+            if b == 0 or h in self._prefix_cache or b in self._page_hash:
+                continue
+            self._prefix_cache[h] = b
+            self._page_hash[b] = h
+        if (chain and r.prefill_done >= len(r.prompt)
+                and self._prefix_pending.get(chain[0]) == r.rid):
+            del self._prefix_pending[chain[0]]
+
+    def _audit_pool(self) -> None:
+        """FLAGS_serving_pool_audit: every live block table must agree
+        with the pool refcounts, and free / cached-free / live pages must
+        partition the pool exactly — a sharing bug fails HERE, loudly,
+        instead of leaking pages silently."""
+        if not self.pool_audit:
+            return
+        expected = np.zeros_like(self.refcount)
+        for s in self.slots:
+            if s is None:
+                continue
+            for b in self.tables[s.slot]:
+                if b:
+                    expected[int(b)] += 1
+        if not np.array_equal(expected, self.refcount):
+            bad = np.nonzero(expected != self.refcount)[0].tolist()
+            raise RuntimeError(
+                f"pool refcount audit failed: pages {bad} expected "
+                f"{expected[bad].tolist()} vs {self.refcount[bad].tolist()}")
+        free = set(self.free_blocks)
+        cached = set(self._cached_free)
+        live = {int(b) for b in np.nonzero(expected)[0]}
+        if (free & cached) or (free & live) or (cached & live):
+            raise RuntimeError(
+                "pool audit: free/cached-free/live overlap "
+                f"{sorted((free & cached) | (free & live) | (cached & live))}")
+        if len(free) + len(cached) + len(live) != self._num_blocks - 1:
+            raise RuntimeError(
+                f"pool audit: {len(free)} free + {len(cached)} cached + "
+                f"{len(live)} live != {self._num_blocks - 1} pool pages")
 
     def _admit(self) -> List[int]:
         """Admit queued requests into free slots while the pool has
@@ -1072,29 +1387,88 @@ class ServingEngine:
                                  blocks_needed=need, binding_cap=cap)
                 self._notify.append(r)
                 continue
-            if need > len(self.free_blocks):
+            # -- prefix sharing: claim the longest hash-chain match of
+            #    already-computed pages BEFORE counting fresh pages
+            shared: List[int] = []
+            if self.prefix_share:
+                chain = self._chain_of(r)
+                if chain and chain[0] in self._prefix_pending:
+                    # fan-out deferral: an identical prefix is being
+                    # prefilled RIGHT NOW by a live owner — admitting
+                    # this sibling would recompute the pages it is about
+                    # to be able to share; wait (entry clears when the
+                    # owner's prefill completes or its slot releases)
+                    break
+                for h in chain:
+                    b = self._prefix_cache.get(h)
+                    if b is None:
+                        break
+                    if self.refcount[b] == 0:
+                        self._cached_free.pop(b, None)
+                    self.refcount[b] += 1
+                    shared.append(int(b))
+            matched = len(shared)
+            S = len(r.prompt)
+            start = matched * self.bs
+            cow = False
+            if shared and start >= S:
+                # FULL prompt cached: recompute exactly one position
+                # (S-1) so this admission still samples a first token —
+                # that write lands INSIDE the last shared page, so with
+                # any other holder it copy-on-writes instead
+                start = S - 1
+                cow = self.refcount[shared[-1]] >= 2
+            need_new = need - matched + (1 if cow else 0)
+            if need_new > self.free_pages():
                 # pool exhaustion: the injected-fault site the resilience
-                # tests arm, then either preempt a decode victim or wait
+                # tests arm, then either preempt a decode victim or wait.
+                # Hand back this attempt's claims first (cached pages
+                # return to the reusable cached-free LRU, live shared
+                # pages just drop one reference).
+                for b in reversed(shared):
+                    self._decref(b)
                 _faults().maybe_fail("serving/pool_exhausted")
                 self._hol_wait_steps += 1
-                if self._try_preempt(r, need):
+                if self._try_preempt(r, need_new):
                     continue  # retry the head against the freed pages
                 break  # head-of-line waits for finishes (no starvation)
             self.queue.pop(0)
             self._hol_wait_steps = 0
-            blocks = [self.free_blocks.pop() for _ in range(need)]
-            if self._numerics_kv:
-                # bump the pages' allocation generation so the numerics
-                # scale-drift poll can tell requantization of LIVE pages
-                # from free->re-admit churn between two polls
-                self._numerics_kv_gen[blocks] += 1
+            blocks = self._alloc_blocks(need_new)
+            pages = list(shared)
+            if cow:
+                src = pages[-1]
+                dst = blocks.pop(0)
+                pages[-1] = dst
+                self._cow_pairs.append((src, dst))
+                self._decref(src)
+                self.cow_copies += 1
+            pages.extend(blocks)
             self.tables[i, :] = 0
-            self.tables[i, :need] = blocks
-            self.lens[i] = 0
+            self.tables[i, :need] = pages
+            # scale-reset mask: inherited (shared non-COW) entries are
+            # zeroed so the in-program fresh-row reset cannot wipe the
+            # canonical pages' quantization scales (a COW destination
+            # stays listed — reset, then scale-copied from its source)
+            n_inherit = matched - (1 if cow else 0)
+            self._reset_tables[i, :] = 0
+            self._reset_tables[i, :need] = pages
+            self._reset_tables[i, :n_inherit] = 0
+            self.lens[i] = start
             r.slot = i
-            r.prefill_done = 0
+            r.prefill_done = start
             self.slots[i] = r
             fresh.append(i)
+            if self.prefix_share:
+                chain = self._chain_of(r)
+                if chain and chain[0] not in self._prefix_cache:
+                    # brand-new prefix: later identical prompts defer
+                    # until this owner's pages are registered
+                    self._prefix_pending[chain[0]] = r.rid
+                if matched:
+                    self._prom.counter_inc(
+                        "kv_prefix_hits_total",
+                        help="admissions that reused cached prefix pages")
         return fresh
 
     def _try_preempt(self, head: Request, need: int) -> bool:
@@ -1125,8 +1499,12 @@ class ServingEngine:
                                     else 0.0,
                                     -(r.max_new_tokens - len(r.output))))
         for v in victims:
-            held = sum(1 for b in self.tables[v.slot] if b != 0)
-            if need <= len(self.free_blocks) + held:
+            # only SOLE-holder pages actually return to the pool when
+            # this victim releases — evicting a request whose pages are
+            # mostly shared frees almost nothing
+            held = sum(1 for b in self.tables[v.slot]
+                       if b != 0 and self.refcount[int(b)] == 1)
+            if need <= self.free_pages() + held:
                 self._preempt(v)
                 return True
         return False
@@ -1144,6 +1522,7 @@ class ServingEngine:
         if fresh:
             r.prompt = np.concatenate(
                 [r.prompt, np.asarray(fresh, np.int32)])
+            r._chain = None  # prompt changed: hash chain is stale
         r.folded = len(r.output)
         r.prefill_done = 0
         r.preemptions += 1
@@ -1157,15 +1536,26 @@ class ServingEngine:
 
     def _release_slot(self, r: Request):
         """Return a running request's pages + slot to the pool (shared by
-        finish/cancel/preempt)."""
+        finish/cancel/preempt). Pages DECREF rather than free: a page
+        another block table still references stays live, and a
+        cache-registered page parks in the cached-free LRU for the next
+        prefix hit. Flags-off this is the old free-list append, in the
+        same sorted order."""
         i = r.slot
         used = {int(b) for b in self.tables[i] if b != 0}
-        self.free_blocks.extend(sorted(used))
+        for b in sorted(used):
+            self._decref(b)
         self.tables[i, :] = 0
+        self._reset_tables[i, :] = 0
         self.lens[i] = 0
         self.slots[i] = None
         self._pending_tok[i] = 0
         r.slot = -1
+        if self._prefix_pending:
+            for h in [h for h, rid in self._prefix_pending.items()
+                      if rid == r.rid]:
+                del self._prefix_pending[h]
+        self._audit_pool()
 
     def _finish(self, r: Request):
         self._release_slot(r)
@@ -1369,6 +1759,10 @@ class ServingEngine:
         alloc[0] = False  # reserved scratch block
         if self.free_blocks:
             alloc[np.asarray(self.free_blocks, np.int64)] = False
+        if self._cached_free:
+            # cached-free prefix pages are reclaimable, not live — their
+            # scales are frozen until eviction or the next prefix hit
+            alloc[np.fromiter(self._cached_free, np.int64)] = False
         live = alloc & (cur > 0.0)  # allocated AND written
         n_live = int(live.sum())
         prev = self._numerics_kv_prev
@@ -1408,6 +1802,7 @@ class ServingEngine:
         tokens_before = self._tokens_total
         finished: List[Request] = []
         self._admit()
+        self._apply_cow()
         self._note_pool_peak()
 
         # ---- one chunked-prefill slice for EVERY prefilling slot (one
@@ -1449,6 +1844,7 @@ class ServingEngine:
             for r in pre:
                 r.prefill_done = his[r.slot]
                 self.lens[r.slot] = his[r.slot]
+                self._register_pages(r)
             for r in completing:
                 tok = self._check_tok(r, int(tok_np[r.slot]))
                 self._pending_tok[r.slot] = tok
@@ -1459,6 +1855,84 @@ class ServingEngine:
         # ---- one decode BURST for every slot in the decode phase
         dec = [r for r in self.slots
                if r is not None and r.prefill_done >= len(r.prompt)]
+        props_by_slot: Dict[int, List[int]] = {}
+        if dec and self.spec_k > 0:
+            for r in dec:
+                if r.temperature != 0:
+                    continue
+                cap = min(self.spec_k,
+                          r.max_new_tokens - len(r.output) - 1)
+                if cap <= 0:
+                    continue
+                ctx = np.concatenate(
+                    [np.asarray(r.prompt, np.int64),
+                     np.asarray(r.output[r.folded:], np.int64)])
+                props: List[int] = []
+                for t in self._proposer(ctx, cap)[:cap]:
+                    if not 0 <= int(t) < self.cfg.vocab_size:
+                        break  # defensive: never embed out-of-vocab
+                    props.append(int(t))
+                if props:
+                    props_by_slot[r.slot] = props
+        if props_by_slot:
+            # ---- speculative verify: ONE [P, k+1] dispatch replaces
+            # the decode burst; temperature > 0 rows ride it with
+            # q_len = 1 (their sampled token comes off the same pass)
+            P, C = self.max_batch, self.spec_k + 1
+            buf = np.zeros((P, C), np.int32)
+            pos0 = np.zeros((P,), np.int32)
+            q_lens = np.zeros((P,), np.int32)
+            tables_v = np.zeros_like(self.tables)
+            temps = np.zeros((P,), np.float32)
+            for r in dec:
+                i = r.slot
+                props = props_by_slot.get(i, [])
+                buf[i, 0] = self._pending_tok[i]
+                buf[i, 1:1 + len(props)] = props
+                pos0[i] = self.lens[i]
+                q_lens[i] = 1 + len(props)
+                tables_v[i] = self.tables[i]
+                temps[i] = r.temperature
+            self._key, sub = jax.random.split(self._key)
+            self.dispatches += 1
+            self.decode_microsteps += 1
+            with RecordEvent("serving_verify_dispatch"):
+                _faults().maybe_fail("serving/dispatch")
+                tok_dev, greedy_dev, self.k_pools, self.v_pools = (
+                    self._verify()(self.params, jnp.asarray(buf),
+                                   jnp.asarray(pos0), jnp.asarray(q_lens),
+                                   jnp.asarray(tables_v),
+                                   jnp.asarray(temps), sub,
+                                   self.k_pools, self.v_pools))
+                tok_np, greedy_np = jax.device_get((tok_dev, greedy_dev))
+            for r in dec:
+                i = r.slot
+                props = props_by_slot.get(i, [])
+                acc = 0
+                for j, p in enumerate(props):
+                    if int(greedy_np[i, j]) != p:
+                        break
+                    acc += 1
+                if props:
+                    self.spec_proposed += len(props)
+                    self.spec_accepted += acc
+                # host-managed lens: the verified prefix commits, the
+                # rejected draft tail rolls back via the block table
+                self.lens[i] = int(pos0[i]) + acc + 1
+                if r.temperature == 0:
+                    emit = props[:acc] + [int(greedy_np[i, acc])]
+                else:
+                    emit = [int(tok_np[i])]
+                for tok in emit:
+                    tok = self._check_tok(r, tok)
+                    self._pending_tok[i] = tok
+                    if self._emit(r, tok):
+                        finished.append(r)
+                        self._finish(r)
+                        break
+            self._step_metrics(t_step0, tokens_before, len(pre),
+                               len(dec), finished)
+            return finished
         if dec:
             remaining = np.zeros((self.max_batch,), np.int32)
             eos_ids = np.full((self.max_batch,), -1, np.int32)
@@ -1553,20 +2027,43 @@ class ServingEngine:
         for i in fresh_slots:
             fresh[i] = True
         cursor = 0
-        for r in dec:  # decode rows: 1 token each, always granted
+        props_by_slot: Dict[int, List[int]] = {}
+        for idx, r in enumerate(dec):  # decode rows: always granted
             i = r.slot
-            q_lens[i] = 1
+            props: List[int] = []
+            if self.spec_k > 0 and r.temperature == 0:
+                # speculative drafts ride the SAME dispatch as q_len =
+                # 1 + k verify rows; cap: the proposer's k, the row's
+                # pre-allocated footprint (k <= remaining - 1 keeps
+                # every draft's KV write inside it), and the token
+                # budget after every later decode row's guaranteed 1
+                room = T - cursor - (len(dec) - idx - 1) - 1
+                cap = min(self.spec_k,
+                          r.max_new_tokens - len(r.output) - 1, room)
+                if cap > 0:
+                    ctx = np.concatenate(
+                        [np.asarray(r.prompt, np.int64),
+                         np.asarray(r.output[r.folded:], np.int64)])
+                    for t in self._proposer(ctx, cap)[:cap]:
+                        if not 0 <= int(t) < self.cfg.vocab_size:
+                            break  # defensive: never embed out-of-vocab
+                        props.append(int(t))
+            q_lens[i] = 1 + len(props)
             pos0[i] = self.lens[i]
             sample0[i] = True
             remaining[i] = r.max_new_tokens - len(r.output)
             if r.eos_id is not None:
                 eos_ids[i] = r.eos_id
             temps[i] = r.temperature
-            tokens[cursor] = self._pending_tok[i]
-            row_of[cursor] = i
-            off_of[cursor] = 0
+            row_toks = [self._pending_tok[i]] + props
+            tokens[cursor:cursor + len(row_toks)] = row_toks
+            row_of[cursor:cursor + len(row_toks)] = i
+            off_of[cursor:cursor + len(row_toks)] = np.arange(len(row_toks))
             starts[i] = cursor
-            cursor += 1
+            cursor += len(row_toks)
+            if props:
+                props_by_slot[i] = props
+        use_spec = bool(props_by_slot)
         grants: Dict[int, int] = {}
         for r in pre:  # prefill chunks share the leftover budget
             i = r.slot
@@ -1593,12 +2090,19 @@ class ServingEngine:
             starts[i] = cursor
             cursor += grant
 
-        K = self._pick_burst(len(pre))
-        if not sample0.any():
-            # every slot is mid-prefill: no row can sample this step, so
-            # the K-1 decode micro-steps would run full forward passes
-            # over all-zero q_lens. K=1 is an already-compiled size.
+        if use_spec:
+            # the verify pass subsumes the burst: up to k+1 tokens per
+            # row already ride pass 1, and the micro-scan cannot extend
+            # a row whose acceptance point is only known on the host
             K = 1
+        else:
+            K = self._pick_burst(len(pre))
+            if not sample0.any():
+                # every slot is mid-prefill: no row can sample this
+                # step, so the K-1 decode micro-steps would run full
+                # forward passes over all-zero q_lens. K=1 is an
+                # already-compiled size.
+                K = 1
         self.decode_microsteps += K
         self._key, sub = jax.random.split(self._key)
         args = (self.params, jnp.asarray(tokens), jnp.asarray(row_of),
@@ -1610,17 +2114,67 @@ class ServingEngine:
                 self.k_pools, self.v_pools)
         if self.kv_quantized:
             args = args + (self.k_scales, self.v_scales)
+        if self.prefix_share:
+            # pending COW pairs ride this dispatch (executed before any
+            # append); idle lanes self-copy the scratch block — a no-op
+            cow_src = np.zeros((R,), np.int32)
+            cow_dst = np.zeros((R,), np.int32)
+            for j, (s, d) in enumerate(self._cow_pairs[:R]):
+                cow_src[j] = s
+                cow_dst[j] = d
+            del self._cow_pairs[:R]
+            args = args + (jnp.asarray(cow_src), jnp.asarray(cow_dst),
+                           jnp.asarray(self._reset_tables))
         self.dispatches += 1
+        greedy_all = None
         with RecordEvent("serving_unified_dispatch"):
             _faults().maybe_fail("serving/dispatch")
-            (toks, self.k_pools, self.v_pools, self.k_scales,
-             self.v_scales, lens) = self._unified(K)(*args)
-            toks = np.asarray(toks)          # [K, R] — ONE host fetch
+            if use_spec:
+                (toks, greedy_all, self.k_pools, self.v_pools,
+                 self.k_scales, self.v_scales, lens) = self._unified(
+                     K, spec=True)(*args)
+                toks, greedy_all = jax.device_get((toks, greedy_all))
+                toks = np.asarray(toks)      # [K, R]; greedy_all: [T]
+                greedy_all = np.asarray(greedy_all)
+            else:
+                (toks, self.k_pools, self.v_pools, self.k_scales,
+                 self.v_scales, lens) = self._unified(K)(*args)
+                toks = np.asarray(toks)      # [K, R] — ONE host fetch
         self.lens = np.array(lens)
         for r in pre:
             r.prefill_done += grants.get(r.slot, 0)
+            self._register_pages(r)
+        if use_spec:
+            for r in dec:
+                i = r.slot
+                props = props_by_slot.get(i)
+                if not props:
+                    continue  # plain row: emitted by the generic walk
+                base = int(starts[i])
+                acc = 0
+                for j, p in enumerate(props):
+                    if int(greedy_all[base + j]) != p:
+                        break
+                    acc += 1
+                self.spec_proposed += len(props)
+                self.spec_accepted += acc
+                # KV rollback: only the verified prefix [pending,
+                # props[:acc]] stays committed; the device wrote (and
+                # returned lens for) all k+1 draft positions, but the
+                # block table simply forgets the rejected tail — those
+                # positions are past lens, never read, rewritten later
+                self.lens[i] = int(pos0[i]) + acc + 1
+                for tok in props[:acc] + [int(greedy_all[base + acc])]:
+                    tok = self._check_tok(r, tok)
+                    self._pending_tok[i] = tok
+                    if self._emit(r, tok):
+                        finished.append(r)
+                        self._finish(r)
+                        break
         for r in dec + [r for r in pre
                         if r.prefill_done >= len(r.prompt)]:
+            if use_spec and props_by_slot.get(r.slot):
+                continue  # spec row: already emitted above
             for t in range(toks.shape[0]):
                 if r.done:
                     break
@@ -1644,7 +2198,7 @@ class ServingEngine:
         if total_blocks:
             self._prom.gauge_max(
                 "kv_pool_utilization_peak",
-                1.0 - len(self.free_blocks) / total_blocks,
+                1.0 - self.free_pages() / total_blocks,
                 help="high-water allocated fraction of the KV pool")
 
     def _step_metrics(self, t_step0, tokens_before, n_pre, n_dec, finished):
@@ -1654,10 +2208,29 @@ class ServingEngine:
         # end-of-step (post-free) pool state; the PEAK gauge is sampled
         # post-admit at the top of step(), where the blocks are held
         total = self._num_blocks - 1
-        util = 1.0 - len(self.free_blocks) / total if total else 0.0
+        util = 1.0 - self.free_pages() / total if total else 0.0
         prom.gauge_set("kv_pool_utilization", util,
                        help="allocated fraction of the paged KV pool")
         prom.gauge_max("kv_pool_utilization_peak", util)
+        if self.prefix_share:
+            prom.gauge_set("kv_pages_shared",
+                           int((self.refcount > 1).sum()),
+                           help="pool pages referenced by >1 block table")
+            prom.counter_inc("kv_cow_copies_total",
+                             self.cow_copies - self._cow_reported,
+                             help="shared KV pages copied on first write")
+            self._cow_reported = self.cow_copies
+        if self.spec_k > 0:
+            prom.counter_inc("spec_proposed_total",
+                             self.spec_proposed - self._spec_prop_reported,
+                             help="draft tokens proposed for verification")
+            prom.counter_inc("spec_accepted_total",
+                             self.spec_accepted - self._spec_acc_reported,
+                             help="draft tokens accepted (exact argmax "
+                                  "match) — accepted/proposed is the "
+                                  "speculation health rate")
+            self._spec_prop_reported = self.spec_proposed
+            self._spec_acc_reported = self.spec_accepted
         prom.gauge_set("queue_depth", len(self.queue))
         prom.gauge_set("running_requests",
                        sum(s is not None for s in self.slots),

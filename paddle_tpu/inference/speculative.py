@@ -1,0 +1,92 @@
+"""Speculative-decoding proposers for the serving engine (ISSUE 17).
+
+The engine's verify path is model-agnostic: any callable
+``proposer(context, k) -> list[int]`` may nominate up to ``k`` draft
+tokens to extend ``context`` (the request's prompt + every emitted
+token). One ragged dispatch then scores all drafts at once — the ragged
+paged-attention kernel already handles mixed per-row ``q_len``s, so a
+verify row (``q_len = k+1``) costs the same machinery as a prefill
+chunk. Under greedy decoding the acceptance rule is EXACT MATCH against
+the model's own argmax at each draft position, which makes speculation a
+pure-speed knob: outputs are bitwise identical to plain decode whether
+the proposer is brilliant or useless, only tokens/step changes.
+
+The default proposer is draft-model-free **prompt lookup / n-gram
+reuse**: find the longest recent suffix of the context that occurred
+earlier in the context and propose the tokens that followed that
+earlier occurrence. Repetitive continuations (code, templated text,
+greedy cycles) accept at high rates; novel text simply accepts 0 and
+costs one extra GEMM column. A learned draft model slots into the same
+callable signature later.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ["ngram_propose", "make_ngram_proposer", "ReplayCache"]
+
+
+def ngram_propose(context, k: int, max_ngram: int = 4,
+                  min_ngram: int = 1) -> List[int]:
+    """Propose up to ``k`` draft tokens by prompt lookup: match the
+    longest (``max_ngram``-bounded) suffix of ``context`` against an
+    earlier occurrence in ``context`` and return the tokens that
+    followed it. Returns ``[]`` when nothing matches — the engine then
+    decodes that row plainly."""
+    ctx = np.asarray(context, np.int64).ravel()
+    n = int(ctx.shape[0])
+    if k <= 0 or n < min_ngram + 1:
+        return []
+    for g in range(min(max_ngram, n - 1), min_ngram - 1, -1):
+        suffix = ctx[n - g:]
+        # latest earlier occurrence wins: recent statistics track the
+        # current continuation better than the prompt's distant past
+        for s in range(n - g - 1, -1, -1):
+            if np.array_equal(ctx[s:s + g], suffix):
+                out = ctx[s + g:s + g + k]
+                if out.size:
+                    return [int(t) for t in out]
+                break  # match flush against the suffix: nothing follows
+    return []
+
+
+def make_ngram_proposer(max_ngram: int = 4, min_ngram: int = 1):
+    """Bind n-gram window bounds into an engine-ready proposer."""
+    def propose(context, k):
+        return ngram_propose(context, k, max_ngram=max_ngram,
+                             min_ngram=min_ngram)
+    return propose
+
+
+class ReplayCache:
+    """History-replay proposer for repeat traffic: remember completed
+    (prompt, output) pairs and, when a live request's context is a
+    remembered prompt extended along its remembered greedy output,
+    propose the remembered continuation. Retried, templated, and
+    fan-out requests — the same traffic prefix sharing multiplies
+    admission for — then verify at ~100% acceptance, while novel
+    requests fall through to ``[]`` (plain decode). The verify rule
+    still guarantees bitwise-greedy outputs either way."""
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._seqs = {}
+
+    def record(self, prompt, output) -> None:
+        if len(self._seqs) >= self.max_entries:
+            self._seqs.pop(next(iter(self._seqs)))
+        self._seqs[tuple(int(t) for t in np.asarray(prompt).ravel())] = [
+            int(t) for t in output]
+
+    def __call__(self, context, k: int) -> List[int]:
+        ctx = [int(t) for t in np.asarray(context).ravel()]
+        for p, out in self._seqs.items():
+            lp = len(p)
+            if len(ctx) >= lp and tuple(ctx[:lp]) == p:
+                done = len(ctx) - lp
+                if ctx[lp:] == out[:done]:
+                    return out[done:done + k]
+        return []
